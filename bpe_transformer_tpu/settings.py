@@ -1,0 +1,27 @@
+"""Global constants for the host-side tokenization stack.
+
+TPU-native rebuild of the reference's constants module
+(`/root/reference/bpe_transformer/settings.py:1-10`).  The GPT-2
+pre-tokenization regex is kept verbatim for token-level parity with the
+reference and with tiktoken's "gpt2" encoding; the output-dir quirk of the
+reference (a path nested *under a file*) is fixed to a real directory.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+#: Canonical text encoding used across the tokenization stack.
+ENCODING: str = "utf-8"
+
+#: GPT-2 pre-tokenization pattern (Radford et al., 2019).  Public regex, also
+#: used by tiktoken's "gpt2" encoding.  Requires the `regex` module (\p{...}).
+GPT2_SPLIT_PATTERN: str = r"""'(?:[sdmt]|ll|ve|re)| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
+
+#: Default directory for trainer artifacts (vocab/merges pickles).
+DEFAULT_OUTPUT_DIR: Path = Path(__file__).resolve().parent.parent / "output"
+
+# Backwards-compatible aliases matching the reference's public names
+# (`settings.py:4` ENCODING_STD, `settings.py:8` PAT).
+ENCODING_STD = ENCODING
+PAT = GPT2_SPLIT_PATTERN

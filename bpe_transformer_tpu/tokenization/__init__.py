@@ -1,0 +1,27 @@
+"""Host-side tokenization stack: pre-tokenization, BPE training, encoding."""
+
+from bpe_transformer_tpu.tokenization.pretokenization import (
+    count_pretokens,
+    find_chunk_boundaries,
+    parallel_pretokenization,
+    pretokenize,
+    pretokenize_text,
+    serial_pretokenization,
+    split_on_special_tokens,
+)
+from bpe_transformer_tpu.tokenization.tokenizer import BPETokenizer, Tokenizer
+from bpe_transformer_tpu.tokenization.trainer import BPETrainer, train_bpe
+
+__all__ = [
+    "BPETokenizer",
+    "BPETrainer",
+    "Tokenizer",
+    "count_pretokens",
+    "find_chunk_boundaries",
+    "parallel_pretokenization",
+    "pretokenize",
+    "pretokenize_text",
+    "serial_pretokenization",
+    "split_on_special_tokens",
+    "train_bpe",
+]

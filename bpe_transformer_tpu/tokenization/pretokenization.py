@@ -1,0 +1,235 @@
+"""Host-side GPT-2-style pre-tokenization.
+
+Behavioral parity target: the reference's preprocessing layer
+(`/root/reference/bpe_transformer/tokenization/preprocessing/pretokenization.py`):
+chunk a file at special-token boundaries so chunks can be counted
+independently, split each chunk on special tokens (dropping them for BPE
+training, keeping them as standalone parts for encoding), and apply the GPT-2
+regex to produce pre-tokens.
+
+Design differences from the reference (same observable behavior):
+
+* Pre-tokens are represented as ``tuple[int, ...]`` of byte values — the
+  natural units the BPE trainer merges.  (The reference reaches the same
+  representation implicitly via ``tuple(bytes)``.)
+* A single code path handles serial and parallel counting; parallel mode
+  fans chunks out over ``multiprocessing.Pool`` (the TPU host VM's many CPU
+  cores are the right place for this — device code never touches text).
+* Chunk decoding always uses ``errors="ignore"`` (the reference's serial
+  path forgot it; we match the *tested* parallel behavior).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+from functools import reduce
+from multiprocessing import Pool, cpu_count
+from pathlib import Path
+from typing import BinaryIO, Iterable
+
+import regex as re
+
+from bpe_transformer_tpu.settings import ENCODING, GPT2_SPLIT_PATTERN
+
+# Compiled once per process; `regex` caches are per-pattern-string anyway but
+# an explicit compile keeps the hot loop free of dict lookups.
+_GPT2_RE = re.compile(GPT2_SPLIT_PATTERN)
+
+Pretoken = tuple[int, ...]
+
+
+def find_chunk_boundaries(
+    file: BinaryIO,
+    desired_num_chunks: int,
+    special_tokens: list[str] | None = None,
+) -> list[int]:
+    """Byte offsets that cut ``file`` into ~equal chunks at safe boundaries.
+
+    A boundary is only placed at the start of a special token (default:
+    newline) so no pre-token ever straddles two chunks.  May return fewer
+    boundaries than requested when guesses collide.  Mirrors the reference's
+    scan-ahead strategy (`pretokenization.py:114-168`).
+    """
+    if special_tokens:
+        needles = [t.encode(ENCODING) for t in special_tokens]
+    else:
+        needles = [b"\n"]
+
+    file.seek(0, os.SEEK_END)
+    file_size = file.tell()
+    file.seek(0)
+
+    chunk_size = file_size // max(desired_num_chunks, 1)
+    guesses = [i * chunk_size for i in range(desired_num_chunks + 1)]
+    guesses[-1] = file_size
+
+    read_ahead = 4096
+    for bi in range(1, len(guesses) - 1):
+        pos = guesses[bi]
+        file.seek(pos)
+        while True:
+            window = file.read(read_ahead)
+            if window == b"":
+                guesses[bi] = file_size
+                break
+            hits = [window.find(n) for n in needles]
+            hits = [h for h in hits if h != -1]
+            if hits:
+                guesses[bi] = pos + min(hits)
+                break
+            pos += read_ahead
+
+    return sorted(set(guesses))
+
+
+def split_on_special_tokens(
+    text: str,
+    special_tokens: list[str] | None = None,
+    *,
+    training: bool = True,
+) -> list[str]:
+    """Split ``text`` at special tokens so BPE never merges across them.
+
+    ``training=True`` drops the special tokens from the output parts;
+    ``training=False`` keeps each special token as its own part (so the
+    encoder can map it straight to its vocab id).  Longer special tokens win
+    over their prefixes (e.g. ``<|eot|><|eot|>`` before ``<|eot|>``).
+    """
+    if not special_tokens:
+        return [text]
+    ordered = sorted(special_tokens, key=len, reverse=True)
+    alternation = "|".join(re.escape(t) for t in ordered)
+    pattern = alternation if training else f"({alternation})"
+    return re.split(pattern, text)
+
+
+def iter_pretoken_strings(text: str) -> Iterable[str]:
+    """Yield GPT-2 pre-token strings of ``text`` in order."""
+    for m in _GPT2_RE.finditer(text):
+        yield m.group()
+
+
+def pretokenize_text(text: str) -> list[bytes]:
+    """GPT-2 pre-tokens of ``text`` as UTF-8 byte strings, in order."""
+    return [s.encode(ENCODING) for s in iter_pretoken_strings(text)]
+
+
+def count_pretokens_in_text(
+    text: str,
+    special_tokens: list[str] | None = None,
+    *,
+    training: bool = True,
+    into: Counter[Pretoken] | None = None,
+) -> Counter[Pretoken]:
+    """Count pre-tokens (as byte-value tuples) in a text string."""
+    counter: Counter[Pretoken] = into if into is not None else Counter()
+    specials = set(special_tokens) if special_tokens else set()
+    for part in split_on_special_tokens(text, special_tokens, training=training):
+        if not part:
+            continue
+        if part in specials:
+            counter[tuple(part.encode(ENCODING))] += 1
+            continue
+        for m in _GPT2_RE.finditer(part):
+            counter[tuple(m.group().encode(ENCODING))] += 1
+    return counter
+
+
+def count_pretokens_in_chunk(
+    file_path: str | Path,
+    start: int,
+    end: int,
+    training: bool = True,
+    special_tokens: list[str] | None = None,
+) -> Counter[Pretoken]:
+    """Pre-token counts of ``file_path[start:end]`` (a worker unit)."""
+    with open(file_path, "rb") as f:
+        f.seek(start)
+        text = f.read(end - start).decode(ENCODING, errors="ignore")
+    return count_pretokens_in_text(text, special_tokens, training=training)
+
+
+def count_pretokens(
+    file_path: str | Path,
+    special_tokens: list[str] | None = None,
+    *,
+    training: bool = True,
+    n_workers: int | None = None,
+    parallel: bool = True,
+) -> Counter[Pretoken]:
+    """Pre-token counts for a whole file, optionally fanned out over processes.
+
+    This is the entry point the BPE trainer uses.  ``n_workers`` defaults to 4
+    and is clamped to the host CPU count, matching the reference's dispatch
+    behavior (`pretokenization.py:73-111`).
+    """
+    if n_workers is None or n_workers <= 0:
+        n_workers = 4
+    n_workers = min(n_workers, cpu_count())
+
+    with open(file_path, "rb") as f:
+        boundaries = find_chunk_boundaries(f, n_workers if parallel else 4, special_tokens)
+
+    spans = list(zip(boundaries[:-1], boundaries[1:]))
+    if not parallel or n_workers == 1 or len(spans) <= 1:
+        total: Counter[Pretoken] = Counter()
+        for start, end in spans:
+            count_pretokens_in_chunk_into(total, file_path, start, end, training, special_tokens)
+        return total
+
+    args = [(file_path, start, end, training, special_tokens) for start, end in spans]
+    with Pool(processes=n_workers) as pool:
+        per_chunk = pool.starmap(count_pretokens_in_chunk, args)
+    return reduce(lambda a, b: a + b, per_chunk, Counter())
+
+
+def count_pretokens_in_chunk_into(
+    counter: Counter[Pretoken],
+    file_path: str | Path,
+    start: int,
+    end: int,
+    training: bool = True,
+    special_tokens: list[str] | None = None,
+) -> None:
+    """In-place serial variant of :func:`count_pretokens_in_chunk`."""
+    with open(file_path, "rb") as f:
+        f.seek(start)
+        text = f.read(end - start).decode(ENCODING, errors="ignore")
+    count_pretokens_in_text(text, special_tokens, training=training, into=counter)
+
+
+# Reference-compatible aliases (`pretokenization.py:41,73,255`).
+def pretokenize(
+    file_path: str | Path,
+    training: bool = True,
+    parallel_processing: bool = True,
+    n_workers: int | None = 4,
+    special_tokens: list[str] | None = None,
+) -> Counter[Pretoken]:
+    return count_pretokens(
+        file_path,
+        special_tokens,
+        training=training,
+        n_workers=n_workers,
+        parallel=parallel_processing,
+    )
+
+
+def parallel_pretokenization(
+    file_path: str | Path,
+    n_workers: int | None = None,
+    training: bool = True,
+    special_tokens: list[str] | None = None,
+) -> Counter[Pretoken]:
+    return count_pretokens(
+        file_path, special_tokens, training=training, n_workers=n_workers, parallel=True
+    )
+
+
+def serial_pretokenization(
+    file_path: str | Path,
+    training: bool = True,
+    special_tokens: list[str] | None = None,
+) -> Counter[Pretoken]:
+    return count_pretokens(file_path, special_tokens, training=training, parallel=False)

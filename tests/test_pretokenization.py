@@ -1,0 +1,107 @@
+"""Pre-tokenization behavior: GPT-2 regex splits, special tokens, chunking."""
+
+from collections import Counter
+
+import pytest
+
+from bpe_transformer_tpu.tokenization import (
+    count_pretokens,
+    find_chunk_boundaries,
+    pretokenize_text,
+    split_on_special_tokens,
+)
+from bpe_transformer_tpu.tokenization.pretokenization import count_pretokens_in_text
+
+
+def test_gpt2_regex_basic():
+    assert pretokenize_text("Hello, how are you?") == [
+        b"Hello", b",", b" how", b" are", b" you", b"?",
+    ]
+
+
+def test_gpt2_regex_contractions_numbers_whitespace():
+    assert pretokenize_text("I'll pay 100 dollars!!  ") == [
+        b"I", b"'ll", b" pay", b" 100", b" dollars", b"!!", b"  ",
+    ]
+
+
+def test_gpt2_regex_unicode():
+    assert pretokenize_text("Héllò 🙃") == ["Héllò".encode(), " 🙃".encode()]
+
+
+def test_split_specials_training_drops_them():
+    parts = split_on_special_tokens(
+        "a<|endoftext|>b", ["<|endoftext|>"], training=True
+    )
+    assert parts == ["a", "b"]
+
+
+def test_split_specials_encoding_keeps_them():
+    parts = split_on_special_tokens(
+        "a<|endoftext|>b", ["<|endoftext|>"], training=False
+    )
+    assert parts == ["a", "<|endoftext|>", "b"]
+
+
+def test_split_overlapping_specials_longest_wins():
+    parts = split_on_special_tokens(
+        "x<|eot|><|eot|>y",
+        ["<|eot|>", "<|eot|><|eot|>"],
+        training=False,
+    )
+    assert parts == ["x", "<|eot|><|eot|>", "y"]
+
+
+def test_count_pretokens_in_text_drops_specials_when_training():
+    counts = count_pretokens_in_text(
+        "hi<|endoftext|>hi", ["<|endoftext|>"], training=True
+    )
+    assert counts == Counter({tuple(b"hi"): 2})
+
+
+def test_count_pretokens_in_text_keeps_specials_when_encoding():
+    counts = count_pretokens_in_text(
+        "hi<|endoftext|>hi", ["<|endoftext|>"], training=False
+    )
+    assert counts[tuple(b"<|endoftext|>")] == 1
+    assert counts[tuple(b"hi")] == 2
+
+
+def test_chunk_boundaries_cover_file_and_land_on_separators(tmp_path):
+    path = tmp_path / "data.txt"
+    path.write_text("one\ntwo\nthree\nfour\nfive\nsix\nseven\neight\n")
+    size = path.stat().st_size
+    with open(path, "rb") as f:
+        bounds = find_chunk_boundaries(f, 4)
+    assert bounds[0] == 0
+    assert bounds[-1] == size
+    assert bounds == sorted(set(bounds))
+    data = path.read_bytes()
+    for b in bounds[1:-1]:
+        assert data[b : b + 1] == b"\n"
+
+
+def test_parallel_and_serial_counts_agree(tiny_corpus):
+    serial = count_pretokens(tiny_corpus, ["<|endoftext|>"], parallel=False)
+    parallel = count_pretokens(
+        tiny_corpus, ["<|endoftext|>"], parallel=True, n_workers=2
+    )
+    assert serial == parallel
+    assert sum(serial.values()) > 0
+    assert tuple(b"<|endoftext|>") not in serial  # training mode drops specials
+
+
+@pytest.mark.parametrize("n_chunks", [1, 3, 16])
+def test_chunking_never_changes_counts(tiny_corpus, n_chunks):
+    from bpe_transformer_tpu.tokenization.pretokenization import (
+        count_pretokens_in_chunk,
+    )
+
+    with open(tiny_corpus, "rb") as f:
+        bounds = find_chunk_boundaries(f, n_chunks, ["<|endoftext|>"])
+    total = Counter()
+    for start, end in zip(bounds[:-1], bounds[1:]):
+        total += count_pretokens_in_chunk(
+            tiny_corpus, start, end, True, ["<|endoftext|>"]
+        )
+    assert total == count_pretokens(tiny_corpus, ["<|endoftext|>"], parallel=False)

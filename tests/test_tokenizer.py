@@ -1,0 +1,194 @@
+"""Tokenizer: tiktoken id-level parity, roundtrips, streaming memory bound."""
+
+from __future__ import annotations
+
+import os
+import resource
+import sys
+
+import pytest
+
+from bpe_transformer_tpu.tokenization import BPETokenizer, train_bpe
+from bpe_transformer_tpu.tokenization.gpt2 import load_gpt2_merges, load_gpt2_vocab
+
+try:
+    import tiktoken
+
+    HAVE_TIKTOKEN = True
+except Exception:  # pragma: no cover
+    HAVE_TIKTOKEN = False
+
+requires_tiktoken = pytest.mark.skipif(not HAVE_TIKTOKEN, reason="tiktoken missing")
+
+
+@pytest.fixture(scope="module")
+def tiktoken_gpt2(reference_fixtures):
+    """tiktoken's gpt2 encoding, built offline from the fixture artifacts
+    (the canonical `get_encoding("gpt2")` downloads them, and this
+    environment has no egress)."""
+    if not HAVE_TIKTOKEN:
+        pytest.skip("tiktoken missing")
+    vocab = load_gpt2_vocab(reference_fixtures / "gpt2_vocab.json")
+    mergeable = {
+        token: idx for idx, token in vocab.items() if token != b"<|endoftext|>"
+    }
+    return tiktoken.Encoding(
+        name="gpt2-offline",
+        pat_str=r"""'(?:[sdmt]|ll|ve|re)| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+""",
+        mergeable_ranks=mergeable,
+        special_tokens={"<|endoftext|>": 50256},
+    )
+
+
+@pytest.fixture(scope="module")
+def gpt2_tokenizer(reference_fixtures) -> BPETokenizer:
+    vocab = load_gpt2_vocab(reference_fixtures / "gpt2_vocab.json")
+    merges = load_gpt2_merges(reference_fixtures / "gpt2_merges.txt")
+    return BPETokenizer(vocab, merges, special_tokens=["<|endoftext|>"])
+
+
+@pytest.fixture(scope="module")
+def gpt2_tokenizer_plain(reference_fixtures) -> BPETokenizer:
+    vocab = load_gpt2_vocab(reference_fixtures / "gpt2_vocab.json")
+    merges = load_gpt2_merges(reference_fixtures / "gpt2_merges.txt")
+    return BPETokenizer(vocab, merges)
+
+
+SIMPLE_STRINGS = [
+    "",
+    "s",
+    "🙃",
+    "Hello, how are you?",
+    "Héllò hôw are ü? 🙃",
+    "   leading spaces and\ttabs\n\nnewlines  ",
+    "numbers 12345 and punct!!!",
+]
+
+
+@pytest.mark.parametrize("text", SIMPLE_STRINGS)
+def test_roundtrip(gpt2_tokenizer_plain, text):
+    assert gpt2_tokenizer_plain.decode(gpt2_tokenizer_plain.encode(text)) == text
+
+
+@requires_tiktoken
+@pytest.mark.parametrize("text", SIMPLE_STRINGS)
+def test_matches_tiktoken(gpt2_tokenizer_plain, tiktoken_gpt2, text):
+    assert gpt2_tokenizer_plain.encode(text) == tiktoken_gpt2.encode(text)
+
+
+def test_ascii_tokenization(gpt2_tokenizer):
+    ids = gpt2_tokenizer.encode("Hello, how are you?")
+    pieces = [gpt2_tokenizer.decode([i]) for i in ids]
+    assert pieces == ["Hello", ",", " how", " are", " you", "?"]
+
+
+def test_special_tokens_preserved(gpt2_tokenizer):
+    text = "Héllò hôw <|endoftext|><|endoftext|> are ü? 🙃<|endoftext|>"
+    ids = gpt2_tokenizer.encode(text)
+    pieces = [gpt2_tokenizer.decode([i]) for i in ids]
+    assert pieces.count("<|endoftext|>") == 3
+    assert gpt2_tokenizer.decode(ids) == text
+
+
+@requires_tiktoken
+def test_special_tokens_match_tiktoken(gpt2_tokenizer, tiktoken_gpt2):
+    text = "Héllò hôw <|endoftext|><|endoftext|> are ü? 🙃<|endoftext|>"
+    expected = tiktoken_gpt2.encode(text, allowed_special={"<|endoftext|>"})
+    assert gpt2_tokenizer.encode(text) == expected
+
+
+def test_overlapping_special_tokens(reference_fixtures):
+    vocab = load_gpt2_vocab(reference_fixtures / "gpt2_vocab.json")
+    merges = load_gpt2_merges(reference_fixtures / "gpt2_merges.txt")
+    tok = BPETokenizer(
+        vocab, merges, special_tokens=["<|endoftext|>", "<|endoftext|><|endoftext|>"]
+    )
+    text = "Hello, how <|endoftext|><|endoftext|> are you?<|endoftext|>"
+    ids = tok.encode(text)
+    pieces = [tok.decode([i]) for i in ids]
+    assert pieces.count("<|endoftext|>") == 1
+    assert pieces.count("<|endoftext|><|endoftext|>") == 1
+    assert tok.decode(ids) == text
+
+
+@requires_tiktoken
+@pytest.mark.parametrize(
+    "fixture_name",
+    [
+        "address.txt",
+        "german.txt",
+        "tinystories_sample.txt",
+        "special_token_trailing_newlines.txt",
+        "special_token_double_newlines_non_whitespace.txt",
+    ],
+)
+def test_corpus_matches_tiktoken(gpt2_tokenizer, tiktoken_gpt2, reference_fixtures, fixture_name):
+    text = (reference_fixtures / fixture_name).read_text(encoding="utf-8")
+    expected = tiktoken_gpt2.encode(text, allowed_special={"<|endoftext|>"})
+    ids = gpt2_tokenizer.encode(text)
+    assert ids == expected
+    assert gpt2_tokenizer.decode(ids) == text
+
+
+def test_decode_unknown_id_is_replacement(gpt2_tokenizer):
+    assert gpt2_tokenizer.decode([10 ** 9]) == "�"
+
+
+def test_encode_iterable_matches_encode(gpt2_tokenizer, reference_fixtures):
+    path = reference_fixtures / "tinystories_sample.txt"
+    with open(path, encoding="utf-8") as f:
+        streamed = list(gpt2_tokenizer.encode_iterable(f))
+    text = path.read_text(encoding="utf-8")
+    assert streamed == gpt2_tokenizer.encode(text)
+
+
+def test_encode_iterable_parallel_matches_serial(gpt2_tokenizer, reference_fixtures):
+    path = reference_fixtures / "tinystories_sample.txt"
+    with open(path, encoding="utf-8") as f:
+        serial = list(gpt2_tokenizer.encode_iterable(f))
+    with open(path, encoding="utf-8") as f:
+        parallel = list(gpt2_tokenizer.encode_iterable(f, n_workers=2))
+    assert serial == parallel
+
+
+def test_trained_tokenizer_roundtrip(tiny_corpus):
+    vocab, merges = train_bpe(
+        input_path=tiny_corpus, vocab_size=400, special_tokens=["<|endoftext|>"]
+    )
+    tok = BPETokenizer(vocab, merges, special_tokens=["<|endoftext|>"])
+    text = tiny_corpus.read_text(encoding="utf-8")
+    assert tok.decode(tok.encode(text)) == text
+
+
+@pytest.mark.skipif(
+    not sys.platform.startswith("linux"), reason="rlimit support is linux-only"
+)
+def test_encode_iterable_memory_bounded(gpt2_tokenizer, tmp_path, reference_fixtures):
+    """Streaming encode of a ~5 MB corpus must not grow the address space by
+    more than 1 MB (reference bound, test_tokenizer.py:416-429)."""
+    base = (reference_fixtures / "tinystories_sample.txt").read_text(encoding="utf-8")
+    big_path = tmp_path / "big.txt"
+    with open(big_path, "w", encoding="utf-8") as f:
+        written = 0
+        while written < 5_000_000:
+            f.write(base)
+            written += len(base)
+
+    # Warm the caches/lazy tables outside the limited region.
+    gpt2_tokenizer.encode("warmup text so lazy structures exist\n")
+
+    import psutil
+
+    process = psutil.Process(os.getpid())
+    prev = resource.getrlimit(resource.RLIMIT_AS)
+    resource.setrlimit(
+        resource.RLIMIT_AS, (process.memory_info().rss + int(1e6), prev[1])
+    )
+    try:
+        count = 0
+        with open(big_path, encoding="utf-8") as f:
+            for _ in gpt2_tokenizer.encode_iterable(f):
+                count += 1
+        assert count > 0
+    finally:
+        resource.setrlimit(resource.RLIMIT_AS, prev)

@@ -1,0 +1,107 @@
+"""BPE trainer: exact merge-order parity with the reference fixture + speed."""
+
+import time
+
+import pytest
+
+from bpe_transformer_tpu.tokenization import BPETrainer, train_bpe
+from bpe_transformer_tpu.tokenization.gpt2 import decode_gpt2_token
+
+
+def _load_reference_merges(path):
+    merges = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            left, right = line.rstrip().split(" ")
+            merges.append((decode_gpt2_token(left), decode_gpt2_token(right)))
+    return merges
+
+
+def test_train_bpe_exact_merge_parity(reference_fixtures):
+    """Pinned: identical ordered merges + vocab on corpus.en, vocab 500.
+
+    This locks the greedy tie-breaking (count desc, then lexicographically
+    greater pair bytes) and leftmost-non-overlapping merge semantics to the
+    reference's published fixture.
+    """
+    vocab, merges = train_bpe(
+        input_path=reference_fixtures / "corpus.en",
+        vocab_size=500,
+        special_tokens=["<|endoftext|>"],
+    )
+    expected = _load_reference_merges(
+        reference_fixtures / "train-bpe-reference-merges.txt"
+    )
+    assert merges == expected
+
+    import json
+
+    with open(reference_fixtures / "train-bpe-reference-vocab.json") as f:
+        ref_vocab_json = json.load(f)
+    ref_vocab = {
+        idx: decode_gpt2_token(tok) for tok, idx in ref_vocab_json.items()
+    }
+    assert set(vocab.keys()) == set(ref_vocab.keys())
+    assert set(vocab.values()) == set(ref_vocab.values())
+
+
+def test_train_bpe_speed(reference_fixtures):
+    """Reference bound: corpus.en to vocab 500 in < 1.5 s."""
+    start = time.time()
+    train_bpe(
+        input_path=reference_fixtures / "corpus.en",
+        vocab_size=500,
+        special_tokens=["<|endoftext|>"],
+    )
+    assert time.time() - start < 1.5
+
+
+def test_special_tokens_never_merged(tiny_corpus):
+    vocab, merges = train_bpe(
+        input_path=tiny_corpus, vocab_size=400, special_tokens=["<|endoftext|>"]
+    )
+    for token_bytes in vocab.values():
+        if token_bytes == b"<|endoftext|>":
+            continue
+        assert b"<|" not in token_bytes
+    # The special token occupies id 256, directly after the byte alphabet.
+    assert vocab[256] == b"<|endoftext|>"
+
+
+def test_vocab_growth_and_merge_consistency(tiny_corpus):
+    vocab, merges = train_bpe(input_path=tiny_corpus, vocab_size=300)
+    assert len(vocab) == 300
+    assert len(merges) == 300 - 256
+    # Every merge's concatenation must be a vocab entry, ids appended in order.
+    for i, (left, right) in enumerate(merges):
+        assert vocab[256 + i] == left + right
+
+
+def test_merges_stop_when_no_pairs_left(tmp_path):
+    path = tmp_path / "tiny.txt"
+    path.write_text("ab ab ab\n")
+    vocab, merges = train_bpe(input_path=path, vocab_size=400)
+    # Only a handful of merges are possible; trainer must stop early.
+    assert len(vocab) < 400
+    assert len(merges) == len(vocab) - 256
+
+
+def test_vocab_size_below_256_rejected():
+    with pytest.raises(ValueError):
+        BPETrainer(vocab_size=100)
+
+
+def test_trainer_artifacts_roundtrip(tiny_corpus, tmp_path):
+    trainer = BPETrainer(vocab_size=300, special_tokens=["<|endoftext|>"])
+    trainer.train(tiny_corpus)
+    trainer.save_trainer(tmp_path / "artifacts")
+
+    from bpe_transformer_tpu.tokenization import BPETokenizer
+
+    tok = BPETokenizer.from_files(
+        tmp_path / "artifacts" / "vocab.pkl",
+        tmp_path / "artifacts" / "merges.pkl",
+        special_tokens=["<|endoftext|>"],
+    )
+    assert tok.vocab == trainer.vocab
+    assert tok.merges == trainer.merges
