@@ -1,0 +1,5 @@
+"""Checkpoint/resume: host-side pytree serialization."""
+
+from bpe_transformer_tpu.checkpointing.checkpoint import load_checkpoint, save_checkpoint
+
+__all__ = ["load_checkpoint", "save_checkpoint"]

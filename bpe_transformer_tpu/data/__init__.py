@@ -1,0 +1,10 @@
+"""Host-side data pipeline: memmap token files + batch sampling."""
+
+from bpe_transformer_tpu.data.dataset import (
+    BatchLoader,
+    get_batch,
+    load_token_file,
+    tokenize_to_memmap,
+)
+
+__all__ = ["BatchLoader", "get_batch", "load_token_file", "tokenize_to_memmap"]

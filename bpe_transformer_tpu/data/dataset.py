@@ -1,0 +1,98 @@
+"""Token datasets and batch sampling (host side, feeding the device).
+
+Reference contract: `run_get_batch` (`/root/reference/tests/adapters.py:
+401-421`) — uniform-random start offsets in ``[0, len - ctx)``, labels are
+inputs shifted by one, pinned statistically by `test_data.py:10-72`.
+
+TPU-first data path: a tokenized corpus lives on disk as a flat binary token
+file opened with ``np.memmap`` (no RAM copy of the corpus); the host sampler
+gathers ``(B, ctx)`` windows and the training loop hands them to the device
+(``jax.device_put`` with a batch-sharded ``NamedSharding`` in the
+data-parallel case, so each chip receives only its shard).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+
+def tokenize_to_memmap(
+    tokenizer,
+    text_path: str | Path,
+    out_path: str | Path,
+    dtype: str = "uint16",
+) -> np.ndarray:
+    """Stream-encode ``text_path`` and write a flat binary token file.
+
+    ``uint16`` covers vocabularies up to 65,535 (all BASELINE configs);
+    pass ``uint32`` beyond that.  Returns a read-only memmap of the result.
+    """
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    dt = np.dtype(dtype)
+    buffer: list[int] = []
+    with open(text_path, encoding="utf-8") as src, open(out_path, "wb") as dst:
+        for token_id in tokenizer.encode_iterable(src):
+            buffer.append(token_id)
+            if len(buffer) >= 1 << 20:
+                np.asarray(buffer, dtype=dt).tofile(dst)
+                buffer.clear()
+        if buffer:
+            np.asarray(buffer, dtype=dt).tofile(dst)
+    return load_token_file(out_path, dtype)
+
+
+def load_token_file(path: str | Path, dtype: str = "uint16") -> np.ndarray:
+    """Open a flat binary token file as a read-only memmap."""
+    return np.memmap(path, dtype=np.dtype(dtype), mode="r")
+
+
+def get_batch(
+    dataset: np.ndarray,
+    batch_size: int,
+    context_length: int,
+    rng: np.random.Generator | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Sample ``(inputs, labels)`` of shape ``(B, ctx)`` (int64).
+
+    Start indices are uniform over ``[0, len(dataset) - ctx)``; labels are
+    the next-token shift.  Works directly on a memmap: only the sampled
+    windows are materialized.
+    """
+    if rng is None:
+        rng = np.random.default_rng()
+    n_starts = len(dataset) - context_length
+    if n_starts <= 0:
+        raise ValueError(
+            f"dataset of {len(dataset)} tokens too short for context {context_length}"
+        )
+    starts = rng.integers(0, n_starts, size=batch_size)
+    offsets = np.arange(context_length + 1)
+    windows = np.asarray(dataset[starts[:, None] + offsets[None, :]], dtype=np.int64)
+    return windows[:, :-1], windows[:, 1:]
+
+
+class BatchLoader:
+    """Seeded, stateful batch stream over a token memmap."""
+
+    def __init__(
+        self,
+        dataset: np.ndarray,
+        batch_size: int,
+        context_length: int,
+        seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.context_length = context_length
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> tuple[np.ndarray, np.ndarray]:
+        return get_batch(
+            self.dataset, self.batch_size, self.context_length, self._rng
+        )

@@ -1,0 +1,31 @@
+"""Model families: transformer LM as param pytrees + pure forward fns."""
+
+from bpe_transformer_tpu.models.config import (
+    GPT2_MEDIUM,
+    GPT2_SMALL_32K,
+    TINYSTORIES_4L,
+    TINYSTORIES_12L,
+    TS_TEST_CONFIG,
+    ModelConfig,
+)
+from bpe_transformer_tpu.models.transformer import (
+    forward,
+    init_params,
+    params_from_state_dict,
+    state_dict_from_params,
+    transformer_block,
+)
+
+__all__ = [
+    "GPT2_MEDIUM",
+    "GPT2_SMALL_32K",
+    "ModelConfig",
+    "TINYSTORIES_4L",
+    "TINYSTORIES_12L",
+    "TS_TEST_CONFIG",
+    "forward",
+    "init_params",
+    "params_from_state_dict",
+    "state_dict_from_params",
+    "transformer_block",
+]

@@ -1,0 +1,114 @@
+"""Typed model/training configuration.
+
+The model schema is a superset of the reference's JSON config fixture
+(`/root/reference/tests/fixtures/ts_tests/model_config.json:1-13`), including
+its ablation flags, so reference configs load unchanged via
+:meth:`ModelConfig.from_json`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int
+    context_length: int
+    d_model: int
+    num_layers: int
+    num_heads: int
+    d_ff: int
+    rope_theta: float = 10000.0
+    # Ablation flags (reference schema; defaults = the tested architecture).
+    remove_rmsnorm: bool = False
+    use_post_norm: bool = False
+    remove_rope: bool = False
+    ffn_type: str | None = None  # None -> SwiGLU; "silu" -> 2-matrix SiLU FFN
+    # TPU execution knobs (not part of the reference schema).
+    activation_dtype: str = "float32"  # "bfloat16" for the perf path
+    remat: bool = False  # rematerialize each block on the backward pass
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.num_heads
+
+    def __post_init__(self):
+        if self.d_model % self.num_heads:
+            raise ValueError(
+                f"d_model={self.d_model} not divisible by num_heads={self.num_heads}"
+            )
+
+    @classmethod
+    def from_json(cls, path: str | Path) -> "ModelConfig":
+        with open(path) as f:
+            raw: dict[str, Any] = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    def to_json(self, path: str | Path) -> None:
+        payload = dataclasses.asdict(self)
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2)
+
+
+#: The reference test fixture architecture (model_config.json).
+TS_TEST_CONFIG = ModelConfig(
+    vocab_size=10_000,
+    context_length=16,
+    d_model=64,
+    num_layers=3,
+    num_heads=4,
+    d_ff=128,
+    rope_theta=10000.0,
+)
+
+#: BASELINE.json config 1: TinyStories 4L/256d single-chip model.
+TINYSTORIES_4L = ModelConfig(
+    vocab_size=10_000,
+    context_length=256,
+    d_model=256,
+    num_layers=4,
+    num_heads=8,
+    d_ff=683,
+    rope_theta=10000.0,
+)
+
+#: BASELINE.json config 2: TinyStories 12L/512d data-parallel model.
+TINYSTORIES_12L = ModelConfig(
+    vocab_size=10_000,
+    context_length=512,
+    d_model=512,
+    num_layers=12,
+    num_heads=8,
+    d_ff=1365,
+    rope_theta=10000.0,
+)
+
+#: BASELINE.json config 3: GPT-2-small-class model with 32k vocab.
+GPT2_SMALL_32K = ModelConfig(
+    vocab_size=32_000,
+    context_length=1024,
+    d_model=768,
+    num_layers=12,
+    num_heads=12,
+    d_ff=2048,
+    rope_theta=10000.0,
+    activation_dtype="bfloat16",
+)
+
+#: BASELINE.json config 5: GPT-2-medium-class model (FSDP target).
+GPT2_MEDIUM = ModelConfig(
+    vocab_size=32_000,
+    context_length=1024,
+    d_model=1024,
+    num_layers=24,
+    num_heads=16,
+    d_ff=2731,
+    rope_theta=10000.0,
+    activation_dtype="bfloat16",
+    remat=True,
+)

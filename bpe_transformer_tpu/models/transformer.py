@@ -1,0 +1,254 @@
+"""Transformer language model: param pytree + pure jitted forward.
+
+Architecture (the reference's tested contract, `/root/reference/tests/
+adapters.py:209-361`): token embeddings -> N pre-norm blocks
+(RMSNorm -> causal MHA with RoPE -> residual; RMSNorm -> SwiGLU -> residual)
+-> final RMSNorm -> untied LM head.
+
+TPU-first design: parameters are a plain nested dict of arrays (a pytree —
+no module system), the forward pass is a pure function traced once under
+``jax.jit``, blocks optionally rematerialize (``jax.checkpoint``) to trade
+FLOPs for HBM, and activations can run in bfloat16 while norms/softmax/loss
+accumulate in float32.  The torch-style flat state-dict key schema
+(`adapters.py:307-353`) is supported bidirectionally so reference
+checkpoints map 1:1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from bpe_transformer_tpu.models.config import ModelConfig
+from bpe_transformer_tpu.ops.core import (
+    embedding,
+    linear,
+    multihead_self_attention,
+    rmsnorm,
+    silu,
+    swiglu,
+)
+from bpe_transformer_tpu.ops.rope import rope_tables
+
+Params = dict
+
+
+# --------------------------------------------------------------------- init
+
+
+def init_params(
+    rng: jax.Array, config: ModelConfig, dtype=jnp.float32
+) -> Params:
+    """Initialize a parameter pytree (truncated-normal projections, unit norms)."""
+
+    def dense(key, d_out, d_in, std=0.02):
+        return (
+            jax.random.truncated_normal(key, -3.0, 3.0, (d_out, d_in), jnp.float32)
+            * std
+        ).astype(dtype)
+
+    d, ff, v = config.d_model, config.d_ff, config.vocab_size
+    keys = jax.random.split(rng, 2 + config.num_layers)
+    layers = []
+    for i in range(config.num_layers):
+        k = jax.random.split(keys[2 + i], 7)
+        layers.append(
+            {
+                "attn": {
+                    "q_proj": dense(k[0], d, d),
+                    "k_proj": dense(k[1], d, d),
+                    "v_proj": dense(k[2], d, d),
+                    "output_proj": dense(k[3], d, d),
+                },
+                "ln1": jnp.ones((d,), dtype),
+                "ln2": jnp.ones((d,), dtype),
+                "ffn": {
+                    "w1": dense(k[4], ff, d),
+                    "w2": dense(k[5], d, ff),
+                    "w3": dense(k[6], ff, d),
+                },
+            }
+        )
+    return {
+        "token_embeddings": dense(keys[0], v, d),
+        "layers": layers,
+        "ln_final": jnp.ones((d,), dtype),
+        "lm_head": dense(keys[1], v, d),
+    }
+
+
+# ------------------------------------------------------------------ forward
+
+
+def _ffn(x: Array, ffn_params: dict, config: ModelConfig) -> Array:
+    if config.ffn_type in (None, "swiglu"):
+        return swiglu(x, ffn_params["w1"], ffn_params["w2"], ffn_params["w3"])
+    if config.ffn_type == "silu":
+        return linear(silu(linear(x, ffn_params["w1"])), ffn_params["w2"])
+    raise ValueError(f"unknown ffn_type: {config.ffn_type!r}")
+
+
+def _maybe_norm(x: Array, weight: Array, config: ModelConfig) -> Array:
+    if config.remove_rmsnorm:
+        return x
+    return rmsnorm(x, weight)
+
+
+def _attention(
+    x: Array,
+    attn_params: dict,
+    config: ModelConfig,
+    rope_cos_sin: tuple[Array, Array] | None,
+    positions: Array,
+) -> Array:
+    return multihead_self_attention(
+        x,
+        attn_params["q_proj"],
+        attn_params["k_proj"],
+        attn_params["v_proj"],
+        attn_params["output_proj"],
+        config.num_heads,
+        positions=positions,
+        rope_cos_sin=rope_cos_sin,
+        causal=True,
+    )
+
+
+def transformer_block(
+    x: Array,
+    block_params: dict,
+    config: ModelConfig,
+    rope_cos_sin: tuple[Array, Array] | None,
+    positions: Array,
+) -> Array:
+    """One block; pre-norm by default, post-norm under the ablation flag."""
+    if config.use_post_norm:
+        x = _maybe_norm(
+            x + _attention(x, block_params["attn"], config, rope_cos_sin, positions),
+            block_params["ln1"],
+            config,
+        )
+        return _maybe_norm(
+            x + _ffn(x, block_params["ffn"], config), block_params["ln2"], config
+        )
+    h = _maybe_norm(x, block_params["ln1"], config)
+    x = x + _attention(h, block_params["attn"], config, rope_cos_sin, positions)
+    h = _maybe_norm(x, block_params["ln2"], config)
+    return x + _ffn(h, block_params["ffn"], config)
+
+
+def forward(
+    params: Params,
+    token_ids: Array,
+    config: ModelConfig,
+    positions: Array | None = None,
+) -> Array:
+    """Logits ``(batch, seq, vocab)`` for ``token_ids (batch, seq)``.
+
+    ``seq`` may be anything up to ``config.context_length`` (truncated-input
+    behavior pinned by `test_transformer_lm_truncated_input`).
+    """
+    seq_len = token_ids.shape[-1]
+    if seq_len > config.context_length:
+        raise ValueError(
+            f"sequence length {seq_len} exceeds context_length "
+            f"{config.context_length} (RoPE tables are sized to the context)"
+        )
+    if positions is None:
+        positions = jnp.arange(seq_len)
+
+    act_dtype = jnp.dtype(config.activation_dtype)
+    # Mixed precision: master params may be float32 while compute runs in
+    # ``activation_dtype`` — cast the weights entering matmuls so bf16
+    # actually reaches the MXU.  Norm weights stay in the compute dtype too;
+    # rmsnorm internally accumulates in float32 either way.
+    compute_params = params
+    if act_dtype != jnp.float32:
+        compute_params = jax.tree_util.tree_map(
+            lambda p: p.astype(act_dtype), params
+        )
+
+    x = embedding(compute_params["token_embeddings"], token_ids).astype(act_dtype)
+
+    rope_cos_sin = None
+    if not config.remove_rope:
+        cos, sin = rope_tables(
+            config.d_head, config.context_length, config.rope_theta
+        )
+        rope_cos_sin = (cos.astype(act_dtype), sin.astype(act_dtype))
+
+    block = transformer_block
+    if config.remat:
+        block = jax.checkpoint(
+            transformer_block, static_argnums=(2,), policy=None
+        )
+    for block_params in compute_params["layers"]:
+        x = block(x, block_params, config, rope_cos_sin, positions)
+
+    x = _maybe_norm(x, compute_params["ln_final"], config)
+    # LM head always runs in float32 for stable logits/loss.
+    return linear(x.astype(jnp.float32), params["lm_head"].astype(jnp.float32))
+
+
+# ------------------------------------------------- torch state-dict interop
+
+
+def params_from_state_dict(state_dict: dict, num_layers: int) -> Params:
+    """Build the param pytree from flat torch-style keys (numpy/jnp values).
+
+    Key schema: `adapters.py:307-353` (``token_embeddings.weight``,
+    ``layers.{i}.attn.{q,k,v,output}_proj.weight``, ``layers.{i}.ln{1,2}.weight``,
+    ``layers.{i}.ffn.w{1,2,3}.weight``, ``ln_final.weight``, ``lm_head.weight``).
+    """
+
+    def get(key):
+        return jnp.asarray(state_dict[key])
+
+    layers = []
+    for i in range(num_layers):
+        p = f"layers.{i}."
+        layers.append(
+            {
+                "attn": {
+                    "q_proj": get(p + "attn.q_proj.weight"),
+                    "k_proj": get(p + "attn.k_proj.weight"),
+                    "v_proj": get(p + "attn.v_proj.weight"),
+                    "output_proj": get(p + "attn.output_proj.weight"),
+                },
+                "ln1": get(p + "ln1.weight"),
+                "ln2": get(p + "ln2.weight"),
+                "ffn": {
+                    "w1": get(p + "ffn.w1.weight"),
+                    "w2": get(p + "ffn.w2.weight"),
+                    "w3": get(p + "ffn.w3.weight"),
+                },
+            }
+        )
+    return {
+        "token_embeddings": get("token_embeddings.weight"),
+        "layers": layers,
+        "ln_final": get("ln_final.weight"),
+        "lm_head": get("lm_head.weight"),
+    }
+
+
+def state_dict_from_params(params: Params) -> dict:
+    """Flatten the param pytree back to the torch-style key schema."""
+    out = {
+        "token_embeddings.weight": params["token_embeddings"],
+        "ln_final.weight": params["ln_final"],
+        "lm_head.weight": params["lm_head"],
+    }
+    for i, layer in enumerate(params["layers"]):
+        p = f"layers.{i}."
+        out[p + "attn.q_proj.weight"] = layer["attn"]["q_proj"]
+        out[p + "attn.k_proj.weight"] = layer["attn"]["k_proj"]
+        out[p + "attn.v_proj.weight"] = layer["attn"]["v_proj"]
+        out[p + "attn.output_proj.weight"] = layer["attn"]["output_proj"]
+        out[p + "ln1.weight"] = layer["ln1"]
+        out[p + "ln2.weight"] = layer["ln2"]
+        out[p + "ffn.w1.weight"] = layer["ffn"]["w1"]
+        out[p + "ffn.w2.weight"] = layer["ffn"]["w2"]
+        out[p + "ffn.w3.weight"] = layer["ffn"]["w3"]
+    return out

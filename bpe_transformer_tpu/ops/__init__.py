@@ -1,0 +1,38 @@
+"""Device-side tensor ops (pure jnp / XLA)."""
+
+from bpe_transformer_tpu.ops.core import (
+    causal_mask,
+    embedding,
+    linear,
+    merge_heads,
+    multihead_self_attention,
+    rmsnorm,
+    scaled_dot_product_attention,
+    silu,
+    softmax,
+    split_heads,
+    swiglu,
+)
+from bpe_transformer_tpu.ops.grad import clip_by_global_norm, global_norm
+from bpe_transformer_tpu.ops.losses import cross_entropy
+from bpe_transformer_tpu.ops.rope import apply_rope, rope, rope_tables
+
+__all__ = [
+    "apply_rope",
+    "causal_mask",
+    "clip_by_global_norm",
+    "cross_entropy",
+    "embedding",
+    "global_norm",
+    "linear",
+    "merge_heads",
+    "multihead_self_attention",
+    "rmsnorm",
+    "rope",
+    "rope_tables",
+    "scaled_dot_product_attention",
+    "silu",
+    "softmax",
+    "split_heads",
+    "swiglu",
+]

@@ -1,0 +1,150 @@
+"""Core tensor ops: the building blocks of the transformer, as pure jnp.
+
+Each op mirrors a spec-only component of the reference test contract
+(`/root/reference/tests/adapters.py`): linear (M1), embedding (M2), rmsnorm
+(M3), silu (M4), swiglu (M5), softmax (M6), scaled-dot-product attention
+(M7), multi-head self-attention with/without RoPE (M8).
+
+TPU notes: weights follow the torch ``(d_out, d_in)`` row-major layout so
+reference checkpoints map 1:1; matmuls are einsums the XLA TPU backend tiles
+onto the MXU; normalization/softmax accumulate in float32 regardless of the
+activation dtype (bf16-safe); masks are boolean with True = keep.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from bpe_transformer_tpu.ops.rope import apply_rope, rope_tables
+
+#: Large negative filler for masked attention scores.  Finite (not -inf) so
+#: fully-masked rows produce a uniform distribution instead of NaNs.
+MASK_VALUE = -1e30
+
+
+def linear(x: Array, weight: Array) -> Array:
+    """``y = x @ W.T`` with torch-layout ``W: (d_out, d_in)``; no bias."""
+    return jnp.einsum("...i,oi->...o", x, weight)
+
+
+def embedding(weight: Array, token_ids: Array) -> Array:
+    """Row gather from ``(vocab_size, d_model)``."""
+    return jnp.take(weight, token_ids, axis=0)
+
+
+def rmsnorm(x: Array, weight: Array, eps: float = 1e-5) -> Array:
+    """Root-mean-square norm with affine scale; accumulates in float32."""
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * weight
+
+
+def silu(x: Array) -> Array:
+    """``x * sigmoid(x)``."""
+    return x * jax.nn.sigmoid(x)
+
+
+def swiglu(x: Array, w1: Array, w2: Array, w3: Array) -> Array:
+    """SwiGLU FFN: ``w2(silu(w1 x) * (w3 x))``.
+
+    ``w1, w3: (d_ff, d_model)``, ``w2: (d_model, d_ff)``.
+    """
+    return linear(silu(linear(x, w1)) * linear(x, w3), w2)
+
+
+def softmax(x: Array, axis: int = -1) -> Array:
+    """Shift-stabilized softmax along ``axis``; float32 accumulation."""
+    x32 = x.astype(jnp.float32)
+    shifted = x32 - jax.lax.stop_gradient(x32.max(axis=axis, keepdims=True))
+    exp = jnp.exp(shifted)
+    return (exp / exp.sum(axis=axis, keepdims=True)).astype(x.dtype)
+
+
+def scaled_dot_product_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    mask: Array | None = None,
+) -> Array:
+    """Attention over the last two axes; boolean ``mask`` keeps True entries.
+
+    Shapes: ``q (..., Sq, d)``, ``k (..., Sk, d)``, ``v (..., Sk, dv)``,
+    ``mask (..., Sq, Sk)`` broadcastable.
+    """
+    d_k = q.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) / jnp.sqrt(
+        jnp.asarray(d_k, dtype=q.dtype)
+    )
+    if mask is not None:
+        scores = jnp.where(mask, scores, MASK_VALUE)
+    weights = softmax(scores, axis=-1)
+    return jnp.einsum("...qk,...kv->...qv", weights, v)
+
+
+def causal_mask(seq_len: int, dtype=bool) -> Array:
+    """Lower-triangular ``(seq, seq)`` keep-mask."""
+    return jnp.tril(jnp.ones((seq_len, seq_len), dtype=dtype))
+
+
+def split_heads(x: Array, num_heads: int) -> Array:
+    """``(..., S, H*dh) -> (..., H, S, dh)`` with head-major row layout.
+
+    Matches the reference weight convention where projection rows are the
+    concatenation of per-head blocks (`adapters.py:237-251`).
+    """
+    *batch, seq, dm = x.shape
+    x = x.reshape(*batch, seq, num_heads, dm // num_heads)
+    return jnp.moveaxis(x, -2, -3)
+
+
+def merge_heads(x: Array) -> Array:
+    """``(..., H, S, dh) -> (..., S, H*dh)``."""
+    x = jnp.moveaxis(x, -3, -2)
+    *batch, seq, h, dh = x.shape
+    return x.reshape(*batch, seq, h * dh)
+
+
+def multihead_self_attention(
+    x: Array,
+    q_w: Array,
+    k_w: Array,
+    v_w: Array,
+    o_w: Array,
+    num_heads: int,
+    *,
+    positions: Array | None = None,
+    rope_theta: float | None = None,
+    max_seq_len: int | None = None,
+    rope_cos_sin: tuple[Array, Array] | None = None,
+    causal: bool = True,
+) -> Array:
+    """Causal multi-head self-attention, optionally with RoPE on Q/K.
+
+    All four projections are single fused matmuls over the head-concat
+    weight layout.  RoPE (when enabled) is applied per head at
+    ``d_head = d_model // num_heads``.
+    """
+    seq_len = x.shape[-2]
+    q = split_heads(linear(x, q_w), num_heads)
+    k = split_heads(linear(x, k_w), num_heads)
+    v = split_heads(linear(x, v_w), num_heads)
+
+    if rope_cos_sin is not None or rope_theta is not None:
+        if positions is None:
+            positions = jnp.arange(seq_len)
+        if rope_cos_sin is None:
+            d_head = q.shape[-1]
+            rope_cos_sin = rope_tables(
+                d_head, max_seq_len or seq_len, rope_theta, dtype=jnp.float32
+            )
+        cos, sin = rope_cos_sin
+        # positions broadcast over the head axis: (..., S) -> (..., 1, S)
+        pos = jnp.expand_dims(positions, axis=-2)
+        q = apply_rope(q, pos, cos, sin)
+        k = apply_rope(k, pos, cos, sin)
+
+    mask = causal_mask(seq_len) if causal else None
+    attended = scaled_dot_product_attention(q, k, v, mask)
+    return linear(merge_heads(attended), o_w)

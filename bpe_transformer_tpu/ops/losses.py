@@ -1,0 +1,27 @@
+"""Training losses as pure XLA ops (optax-free).
+
+Reference contract: `run_cross_entropy` (`/root/reference/tests/
+adapters.py:440-455`) — mean cross-entropy over examples, stable at 1000x
+logit scale (pinned by `test_nn_utils.py:27-59`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+from jax.scipy.special import logsumexp
+
+
+def cross_entropy(logits: Array, targets: Array) -> Array:
+    """Mean negative log-likelihood of ``targets`` under ``logits``.
+
+    ``logits: (..., vocab)``, ``targets: (...)`` integer class ids.  Uses
+    logsumexp (float32 accumulation) so arbitrarily scaled logits stay
+    finite.
+    """
+    logits32 = logits.astype(jnp.float32)
+    target_logit = jnp.take_along_axis(
+        logits32, targets[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    nll = logsumexp(logits32, axis=-1) - target_logit
+    return nll.mean()

@@ -1,0 +1,12 @@
+"""Hand-rolled optimizers and schedules (pure XLA ops, optax-free)."""
+
+from bpe_transformer_tpu.optim.adamw import AdamWState, adamw_init, adamw_update
+from bpe_transformer_tpu.optim.schedule import cosine_schedule, cosine_schedule_jax
+
+__all__ = [
+    "AdamWState",
+    "adamw_init",
+    "adamw_update",
+    "cosine_schedule",
+    "cosine_schedule_jax",
+]

@@ -1,0 +1,49 @@
+"""Learning-rate schedules as pure functions of the integer step.
+
+Reference contract: `run_get_lr_cosine_schedule` (`/root/reference/tests/
+adapters.py:477-502`), pinned by 25 exact values in `test_optimizer.py:52-95`:
+linear warmup to ``max_lr`` at ``warmup_iters``, cosine decay to ``min_lr``
+at ``cosine_cycle_iters``, constant after.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def cosine_schedule(
+    it: int,
+    max_learning_rate: float,
+    min_learning_rate: float,
+    warmup_iters: int,
+    cosine_cycle_iters: int,
+) -> float:
+    """Host-side (python float) schedule value at iteration ``it``."""
+    if it < warmup_iters:
+        return it / warmup_iters * max_learning_rate
+    if it <= cosine_cycle_iters:
+        progress = (it - warmup_iters) / (cosine_cycle_iters - warmup_iters)
+        return min_learning_rate + 0.5 * (1.0 + math.cos(math.pi * progress)) * (
+            max_learning_rate - min_learning_rate
+        )
+    return min_learning_rate
+
+
+def cosine_schedule_jax(
+    it,
+    max_learning_rate: float,
+    min_learning_rate: float,
+    warmup_iters: int,
+    cosine_cycle_iters: int,
+):
+    """Traced variant for use inside a jitted train step (``it``: int array)."""
+    import jax.numpy as jnp
+
+    it = it.astype(jnp.float32)
+    warm = it / warmup_iters * max_learning_rate
+    progress = (it - warmup_iters) / (cosine_cycle_iters - warmup_iters)
+    cos_val = min_learning_rate + 0.5 * (1.0 + jnp.cos(jnp.pi * progress)) * (
+        max_learning_rate - min_learning_rate
+    )
+    out = jnp.where(it < warmup_iters, warm, cos_val)
+    return jnp.where(it > cosine_cycle_iters, min_learning_rate, out)

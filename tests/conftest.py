@@ -9,10 +9,20 @@ distributed backend.
 import os
 from pathlib import Path
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for the test suite regardless of ambient configuration: numeric
+# parity tolerances assume f32 host matmuls, and the virtual 8-device mesh
+# only exists on the host platform.  (Benchmarks run on TPU via bench.py.)
+# This container's site customization imports jax at interpreter boot and
+# force-selects an accelerator platform via jax.config, so an env var alone
+# is not enough — override the config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,78 @@
+"""Batch sampler statistics + memmap tokenize/load pipeline."""
+
+import math
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from bpe_transformer_tpu.data import (
+    BatchLoader,
+    get_batch,
+    load_token_file,
+    tokenize_to_memmap,
+)
+
+
+def test_get_batch_shapes_shift_and_uniformity():
+    """Reference contract (`test_data.py:10-72`): shapes, y = x+1 shift, and
+    uniform start indices within ±5 sigma over 1000 draws."""
+    dataset = np.arange(0, 100)
+    context_length = 7
+    batch_size = 32
+    rng = np.random.default_rng(1234)
+
+    starting = Counter()
+    num_iters = 1000
+    for _ in range(num_iters):
+        x, y = get_batch(dataset, batch_size, context_length, rng)
+        assert x.shape == (batch_size, context_length)
+        assert y.shape == (batch_size, context_length)
+        np.testing.assert_array_equal(x + 1, y)
+        starting.update(x[:, 0].tolist())
+
+    n_starts = len(dataset) - context_length
+    assert max(starting) == n_starts - 1
+    assert min(starting) == 0
+    expected = num_iters * batch_size / n_starts
+    sigma = math.sqrt(
+        num_iters * batch_size * (1 / n_starts) * (1 - 1 / n_starts)
+    )
+    for idx, count in starting.items():
+        assert expected - 5 * sigma < count < expected + 5 * sigma, idx
+
+
+def test_get_batch_rejects_short_dataset():
+    with pytest.raises(ValueError):
+        get_batch(np.arange(5), batch_size=2, context_length=10)
+
+
+def test_batch_loader_deterministic_with_seed():
+    data = np.arange(1000)
+    a = BatchLoader(data, 4, 16, seed=7)
+    b = BatchLoader(data, 4, 16, seed=7)
+    xa, ya = next(a)
+    xb, yb = next(b)
+    np.testing.assert_array_equal(xa, xb)
+    np.testing.assert_array_equal(ya, yb)
+
+
+def test_tokenize_to_memmap_roundtrip(tmp_path, tiny_corpus):
+    from bpe_transformer_tpu.tokenization import BPETokenizer, train_bpe
+
+    vocab, merges = train_bpe(tiny_corpus, 300, ["<|endoftext|>"])
+    tok = BPETokenizer(vocab, merges, ["<|endoftext|>"])
+
+    out = tmp_path / "tokens.bin"
+    mm = tokenize_to_memmap(tok, tiny_corpus, out, dtype="uint16")
+    assert out.exists()
+
+    text = tiny_corpus.read_text(encoding="utf-8")
+    expected = tok.encode(text)
+    np.testing.assert_array_equal(np.asarray(mm), expected)
+
+    # reload and sample
+    reloaded = load_token_file(out, "uint16")
+    x, y = get_batch(reloaded, 8, 32, np.random.default_rng(0))
+    assert x.dtype == np.int64
+    np.testing.assert_array_equal(x[:, 1:], y[:, :-1])

@@ -1,0 +1,222 @@
+"""Core-op numerics: reference snapshots where derivable, torch oracles else."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from bpe_transformer_tpu.ops import (
+    clip_by_global_norm,
+    cross_entropy,
+    embedding,
+    linear,
+    rmsnorm,
+    rope,
+    scaled_dot_product_attention,
+    silu,
+    softmax,
+    swiglu,
+)
+
+
+def _t2n(t):
+    return t.detach().cpu().numpy()
+
+
+# ----------------------------------------------------------- torch oracles
+
+
+def test_linear_matches_torch():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((128, 64), dtype=np.float32)
+    x = rng.standard_normal((4, 12, 64), dtype=np.float32)
+    expected = _t2n(torch.from_numpy(x) @ torch.from_numpy(w).T)
+    np.testing.assert_allclose(
+        np.asarray(linear(jnp.asarray(x), jnp.asarray(w))), expected, atol=1e-5
+    )
+
+
+def test_embedding_matches_torch():
+    rng = np.random.default_rng(1)
+    table = rng.standard_normal((100, 16), dtype=np.float32)
+    ids = rng.integers(0, 100, size=(4, 7))
+    expected = _t2n(F.embedding(torch.from_numpy(ids), torch.from_numpy(table)))
+    np.testing.assert_allclose(
+        np.asarray(embedding(jnp.asarray(table), jnp.asarray(ids))), expected
+    )
+
+
+def test_silu_matches_torch():
+    x = np.linspace(-6, 6, 101, dtype=np.float32).reshape(1, -1)
+    expected = _t2n(F.silu(torch.from_numpy(x)))
+    np.testing.assert_allclose(np.asarray(silu(jnp.asarray(x))), expected, atol=1e-6)
+
+
+def test_softmax_matches_torch_and_is_overflow_safe():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((3, 5)).astype(np.float32)
+    expected = _t2n(F.softmax(torch.from_numpy(x), dim=-1))
+    np.testing.assert_allclose(
+        np.asarray(softmax(jnp.asarray(x), axis=-1)), expected, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(softmax(jnp.asarray(x) + 100.0, axis=-1)), expected, atol=1e-6
+    )
+    # other axes too
+    expected0 = _t2n(F.softmax(torch.from_numpy(x), dim=0))
+    np.testing.assert_allclose(
+        np.asarray(softmax(jnp.asarray(x), axis=0)), expected0, atol=1e-6
+    )
+
+
+def test_rmsnorm_matches_torch():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((4, 12, 64)).astype(np.float32)
+    w = rng.standard_normal(64).astype(np.float32)
+    xt = torch.from_numpy(x)
+    expected = _t2n(
+        xt * torch.rsqrt(xt.pow(2).mean(-1, keepdim=True) + 1e-5) * torch.from_numpy(w)
+    )
+    np.testing.assert_allclose(
+        np.asarray(rmsnorm(jnp.asarray(x), jnp.asarray(w), eps=1e-5)),
+        expected,
+        atol=1e-6,
+    )
+
+
+def test_swiglu_matches_torch():
+    rng = np.random.default_rng(4)
+    d_model, d_ff = 64, 128
+    x = rng.standard_normal((4, 12, d_model)).astype(np.float32)
+    w1 = rng.standard_normal((d_ff, d_model)).astype(np.float32) * 0.1
+    w2 = rng.standard_normal((d_model, d_ff)).astype(np.float32) * 0.1
+    w3 = rng.standard_normal((d_ff, d_model)).astype(np.float32) * 0.1
+    xt = torch.from_numpy(x)
+    expected = _t2n(
+        (F.silu(xt @ torch.from_numpy(w1).T) * (xt @ torch.from_numpy(w3).T))
+        @ torch.from_numpy(w2).T
+    )
+    actual = np.asarray(
+        swiglu(jnp.asarray(x), jnp.asarray(w1), jnp.asarray(w2), jnp.asarray(w3))
+    )
+    np.testing.assert_allclose(actual, expected, atol=1e-5)
+
+
+def test_cross_entropy_matches_torch_and_is_overflow_safe():
+    rng = np.random.default_rng(5)
+    logits = rng.random((8, 5)).astype(np.float32)
+    targets = rng.integers(0, 5, size=8)
+    expected = _t2n(
+        F.cross_entropy(torch.from_numpy(logits), torch.from_numpy(targets))
+    )
+    np.testing.assert_allclose(
+        np.asarray(cross_entropy(jnp.asarray(logits), jnp.asarray(targets))),
+        expected,
+        atol=1e-4,
+    )
+    big = logits * 1000.0
+    expected_big = _t2n(
+        F.cross_entropy(torch.from_numpy(big), torch.from_numpy(targets))
+    )
+    np.testing.assert_allclose(
+        np.asarray(cross_entropy(jnp.asarray(big), jnp.asarray(targets))),
+        expected_big,
+        atol=1e-4,
+    )
+
+
+def test_gradient_clipping_matches_torch():
+    rng = np.random.default_rng(6)
+    grads = {
+        "a": rng.standard_normal((5, 5)).astype(np.float32),
+        "b": {"c": rng.standard_normal(7).astype(np.float32)},
+    }
+    max_norm = 1e-2
+    params_t = [
+        torch.nn.Parameter(torch.zeros(5, 5)),
+        torch.nn.Parameter(torch.zeros(7)),
+    ]
+    params_t[0].grad = torch.from_numpy(grads["a"].copy())
+    params_t[1].grad = torch.from_numpy(grads["b"]["c"].copy())
+    torch.nn.utils.clip_grad_norm_(params_t, max_norm)
+
+    clipped, norm = clip_by_global_norm(
+        {"a": jnp.asarray(grads["a"]), "b": {"c": jnp.asarray(grads["b"]["c"])}},
+        max_norm,
+    )
+    np.testing.assert_allclose(
+        np.asarray(clipped["a"]), _t2n(params_t[0].grad), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(clipped["b"]["c"]), _t2n(params_t[1].grad), atol=1e-6
+    )
+    assert float(norm) > max_norm  # this fixture definitely clips
+
+
+def test_gradient_clipping_noop_below_budget():
+    g = {"a": jnp.asarray(np.full((2, 2), 1e-4, dtype=np.float32))}
+    clipped, _ = clip_by_global_norm(g, max_norm=10.0)
+    np.testing.assert_allclose(np.asarray(clipped["a"]), np.asarray(g["a"]))
+
+
+# --------------------------------------------- reference snapshot parity
+
+
+def _seeded_qkvm():
+    torch.manual_seed(1)
+    q = torch.randn(4, 12, 64)
+    torch.manual_seed(2)
+    k = torch.randn(4, 16, 64)
+    torch.manual_seed(3)
+    v = torch.randn(4, 16, 64)
+    torch.manual_seed(5)
+    mask = torch.randn(4, 12, 16) > 0.5
+    return q, k, v, mask
+
+
+def test_sdpa_matches_reference_snapshot(reference_snapshots):
+    expected = dict(np.load(reference_snapshots / "test_scaled_dot_product_attention.npz"))[
+        "array"
+    ]
+    q, k, v, mask = _seeded_qkvm()
+    actual = scaled_dot_product_attention(
+        jnp.asarray(_t2n(q)), jnp.asarray(_t2n(k)), jnp.asarray(_t2n(v)),
+        jnp.asarray(_t2n(mask)),
+    )
+    np.testing.assert_allclose(np.asarray(actual), expected, atol=1e-6, rtol=1e-4)
+
+
+def test_sdpa_4d_matches_reference_snapshot(reference_snapshots):
+    expected = dict(
+        np.load(reference_snapshots / "test_4d_scaled_dot_product_attention.npz")
+    )["array"]
+    q, k, v, mask = _seeded_qkvm()
+    reshape = lambda t, s: jnp.asarray(_t2n(t)).reshape(s)
+    actual = scaled_dot_product_attention(
+        reshape(q, (2, 2, 12, 64)),
+        reshape(k, (2, 2, 16, 64)),
+        reshape(v, (2, 2, 16, 64)),
+        jnp.asarray(_t2n(mask)).reshape(2, 2, 12, 16),
+    )
+    np.testing.assert_allclose(np.asarray(actual), expected, atol=1e-6, rtol=1e-4)
+
+
+def test_rope_matches_reference_snapshot(reference_snapshots):
+    expected = dict(np.load(reference_snapshots / "test_rope.npz"))["array"]
+    torch.manual_seed(4)
+    x = torch.randn(4, 12, 64)
+    actual = rope(
+        jnp.asarray(_t2n(x)), jnp.arange(12), theta=10000.0, max_seq_len=12
+    )
+    np.testing.assert_allclose(np.asarray(actual), expected, atol=1e-6, rtol=1e-4)
+
+
+def test_sdpa_fully_masked_rows_are_finite():
+    q, k, v, _ = _seeded_qkvm()
+    mask = jnp.zeros((4, 12, 16), dtype=bool)  # everything masked
+    out = scaled_dot_product_attention(
+        jnp.asarray(_t2n(q)), jnp.asarray(_t2n(k)), jnp.asarray(_t2n(v)), mask
+    )
+    assert np.isfinite(np.asarray(out)).all()

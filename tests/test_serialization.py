@@ -1,0 +1,86 @@
+"""Checkpoint save/load roundtrips: params, optimizer state, iteration."""
+
+import io
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bpe_transformer_tpu.checkpointing import load_checkpoint, save_checkpoint
+from bpe_transformer_tpu.models import TS_TEST_CONFIG, forward, init_params
+from bpe_transformer_tpu.optim import adamw_init, adamw_update
+
+
+def _train_a_bit(params, state, steps=3):
+    def loss_fn(p, ids):
+        logits = forward(p, ids, TS_TEST_CONFIG)
+        return logits.mean()
+
+    ids = jnp.zeros((2, 8), dtype=jnp.int32)
+    for _ in range(steps):
+        grads = jax.grad(loss_fn)(params, ids)
+        params, state = adamw_update(params, grads, state, lr=1e-3)
+    return params, state
+
+
+def _assert_trees_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        a,
+        b,
+    )
+
+
+def test_checkpoint_roundtrip_path(tmp_path):
+    params = init_params(jax.random.PRNGKey(0), TS_TEST_CONFIG)
+    state = adamw_init(params)
+    params, state = _train_a_bit(params, state)
+
+    path = tmp_path / "ckpt.pkl"
+    save_checkpoint(path, params=params, opt_state=state, iteration=3)
+    payload = load_checkpoint(path)
+
+    assert payload["iteration"] == 3
+    _assert_trees_equal(payload["params"], params)
+    _assert_trees_equal(payload["opt_state"], state)
+
+
+def test_checkpoint_roundtrip_filelike():
+    params = {"w": jnp.arange(6.0).reshape(2, 3)}
+    state = adamw_init(params)
+    buf = io.BytesIO()
+    save_checkpoint(buf, params=params, opt_state=state, iteration=17)
+    buf.seek(0)
+    payload = load_checkpoint(buf)
+    assert payload["iteration"] == 17
+    _assert_trees_equal(payload["params"], params)
+
+
+def test_checkpoint_resume_continues_identically(tmp_path):
+    """Train 3 steps, checkpoint, train 3 more; reload + 3 must match."""
+    params = init_params(jax.random.PRNGKey(1), TS_TEST_CONFIG)
+    state = adamw_init(params)
+    params, state = _train_a_bit(params, state, steps=3)
+    save_checkpoint(tmp_path / "mid.pkl", params=params, opt_state=state, iteration=3)
+
+    final_params, _ = _train_a_bit(params, state, steps=3)
+
+    payload = load_checkpoint(tmp_path / "mid.pkl")
+    from bpe_transformer_tpu.optim.adamw import AdamWState
+
+    restored_state = AdamWState(*payload["opt_state"])
+    resumed_params, _ = _train_a_bit(payload["params"], restored_state, steps=3)
+    _assert_trees_equal(final_params, resumed_params)
+
+
+def test_checkpoint_extra_metadata(tmp_path):
+    save_checkpoint(
+        tmp_path / "c.pkl",
+        params={"w": jnp.ones(2)},
+        iteration=5,
+        extra={"val_loss": 1.25, "config": {"d_model": 64}},
+    )
+    payload = load_checkpoint(tmp_path / "c.pkl")
+    assert payload["extra"]["val_loss"] == 1.25
+    assert payload["opt_state"] is None
