@@ -1,0 +1,188 @@
+"""Headline benchmark: LM pretraining throughput, JAX/TPU vs PyTorch-CPU.
+
+Measures tokens/sec of the full training step (forward, loss, backward,
+clip, cosine schedule, AdamW) on the flagship TinyStories 4L/256d model
+(BASELINE.json config 1), on whatever accelerator JAX selects (the real TPU
+chip under the driver), then measures the identical model/step implemented
+in PyTorch on the host CPU — the reference's only execution substrate — and
+reports the ratio.  North star: >= 10x (BASELINE.json).
+
+Prints exactly one JSON line on stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH = 32
+WARMUP_STEPS = 20
+MEASURE_STEPS = 200
+TORCH_MEASURE_STEPS = 3
+
+
+def bench_jax() -> tuple[float, dict]:
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from bpe_transformer_tpu.models import TINYSTORIES_4L, init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.training.train_step import TrainHParams, make_train_step
+
+    config = dataclasses.replace(TINYSTORIES_4L, activation_dtype="bfloat16")
+    hparams = TrainHParams()
+    params = init_params(jax.random.PRNGKey(0), config)
+    opt_state = adamw_init(params)
+    step = make_train_step(config, hparams)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, config.vocab_size, size=(BATCH, config.context_length))
+    x = jnp.asarray(ids)
+    y = jnp.asarray(np.roll(ids, -1, axis=1))
+
+    # A value fetch is the only reliable execution barrier on every backend
+    # (block_until_ready has proven unreliable on relayed remote devices).
+    sync = lambda: float(jax.device_get(metrics["loss"]))
+
+    for _ in range(WARMUP_STEPS):
+        params, opt_state, metrics = step(params, opt_state, x, y)
+    sync()
+
+    start = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        params, opt_state, metrics = step(params, opt_state, x, y)
+    sync()
+    elapsed = time.perf_counter() - start
+
+    tokens_per_sec = MEASURE_STEPS * BATCH * config.context_length / elapsed
+    info = {
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "loss": float(metrics["loss"]),
+        "steps_per_sec": MEASURE_STEPS / elapsed,
+    }
+    return tokens_per_sec, info
+
+
+def bench_torch_cpu() -> float:
+    """The identical model + update in PyTorch on the host CPU (the
+    reference's execution substrate; it defines the same architecture via
+    its test contract but never ships a training loop)."""
+    import torch
+    import torch.nn.functional as F
+
+    from bpe_transformer_tpu.models import TINYSTORIES_4L as C
+
+    torch.manual_seed(0)
+    dh = C.d_model // C.num_heads
+
+    class Block(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            mk = lambda o, i: torch.nn.Linear(i, o, bias=False)
+            self.q, self.k, self.v, self.o = (mk(C.d_model, C.d_model) for _ in range(4))
+            self.w1, self.w3 = mk(C.d_ff, C.d_model), mk(C.d_ff, C.d_model)
+            self.w2 = mk(C.d_model, C.d_ff)
+            self.ln1 = torch.nn.Parameter(torch.ones(C.d_model))
+            self.ln2 = torch.nn.Parameter(torch.ones(C.d_model))
+
+        @staticmethod
+        def rms(x, w):
+            return x * torch.rsqrt(x.pow(2).mean(-1, keepdim=True) + 1e-5) * w
+
+        def forward(self, x, rope_cos, rope_sin, mask):
+            b, s, d = x.shape
+            h = self.rms(x, self.ln1)
+            split = lambda t: t(h).view(b, s, C.num_heads, dh).transpose(1, 2)
+            q, k, v = split(self.q), split(self.k), split(self.v)
+
+            def rope(t):
+                te, to = t[..., 0::2], t[..., 1::2]
+                out = torch.empty_like(t)
+                out[..., 0::2] = te * rope_cos - to * rope_sin
+                out[..., 1::2] = te * rope_sin + to * rope_cos
+                return out
+
+            q, k = rope(q), rope(k)
+            scores = q @ k.transpose(-1, -2) / dh**0.5
+            scores = scores.masked_fill(~mask, float("-inf"))
+            a = (F.softmax(scores, dim=-1) @ v).transpose(1, 2).reshape(b, s, d)
+            x = x + self.o(a)
+            h = self.rms(x, self.ln2)
+            return x + self.w2(F.silu(self.w1(h)) * self.w3(h))
+
+    class LM(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = torch.nn.Embedding(C.vocab_size, C.d_model)
+            self.blocks = torch.nn.ModuleList(Block() for _ in range(C.num_layers))
+            self.ln_f = torch.nn.Parameter(torch.ones(C.d_model))
+            self.head = torch.nn.Linear(C.d_model, C.vocab_size, bias=False)
+
+        def forward(self, ids, cos, sin, mask):
+            x = self.emb(ids)
+            for blk in self.blocks:
+                x = blk(x, cos, sin, mask)
+            x = Block.rms(x, self.ln_f)
+            return self.head(x)
+
+    model = LM()
+    opt = torch.optim.AdamW(model.parameters(), lr=3e-4, weight_decay=0.01)
+    s = C.context_length
+    inv = C.rope_theta ** (-torch.arange(0, dh, 2, dtype=torch.float32) / dh)
+    ang = torch.arange(s, dtype=torch.float32)[:, None] * inv[None, :]
+    cos, sin = torch.cos(ang), torch.sin(ang)
+    mask = torch.tril(torch.ones(s, s, dtype=torch.bool))
+
+    rng = np.random.default_rng(0)
+    ids = torch.from_numpy(rng.integers(0, C.vocab_size, size=(BATCH, s)))
+    labels = torch.roll(ids, -1, dims=1)
+
+    def one_step():
+        opt.zero_grad()
+        logits = model(ids, cos, sin, mask)
+        loss = F.cross_entropy(logits.view(-1, C.vocab_size), labels.view(-1))
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+        opt.step()
+
+    one_step()  # warmup
+    start = time.perf_counter()
+    for _ in range(TORCH_MEASURE_STEPS):
+        one_step()
+    elapsed = time.perf_counter() - start
+    return TORCH_MEASURE_STEPS * BATCH * s / elapsed
+
+
+def main() -> int:
+    tokens_per_sec, info = bench_jax()
+    try:
+        baseline = bench_torch_cpu()
+    except Exception as exc:  # torch missing/broken: report absolute only
+        print(f"torch baseline failed: {exc}", file=sys.stderr)
+        baseline = None
+
+    result = {
+        "metric": "train_tokens_per_sec_per_chip (TinyStories 4L/256d, B=32)",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tokens_per_sec / baseline, 2) if baseline else None,
+    }
+    print(
+        f"jax: {tokens_per_sec:,.0f} tok/s on {info['device']} "
+        f"({info['steps_per_sec']:.2f} steps/s, loss {info['loss']:.3f}); "
+        f"torch-cpu baseline: {baseline and round(baseline, 1)} tok/s",
+        file=sys.stderr,
+    )
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""Multi-chip parallelism: meshes, shardings, and collective train steps."""
+
+from bpe_transformer_tpu.parallel.mesh import (
+    batch_sharding,
+    initialize_distributed,
+    make_mesh,
+    replicated,
+)
+from bpe_transformer_tpu.parallel.sharding import (
+    param_shardings,
+    param_specs,
+    shard_params,
+)
+from bpe_transformer_tpu.parallel.train_step import (
+    make_dp_train_step,
+    make_gspmd_train_step,
+    shard_batch,
+)
+
+__all__ = [
+    "batch_sharding",
+    "initialize_distributed",
+    "make_dp_train_step",
+    "make_gspmd_train_step",
+    "make_mesh",
+    "param_shardings",
+    "param_specs",
+    "replicated",
+    "shard_batch",
+    "shard_params",
+]

@@ -1,0 +1,81 @@
+"""Device meshes: the substrate for all multi-chip execution.
+
+TPU-native scaling happens by laying a logical ``jax.sharding.Mesh`` over
+the chip slice and annotating arrays with ``NamedSharding`` partition specs;
+XLA then inserts the collectives (psum/all-gather/reduce-scatter) and routes
+them over ICI.  The reference has no distributed backend at all (SURVEY §2.4
+— host multiprocessing only), so this subsystem is designed TPU-first rather
+than ported.
+
+Axis conventions used across the framework:
+
+* ``data``  — batch (data-parallel) axis; gradients are psum'd over it, and
+  FSDP parameter shards also live along it.
+* ``model`` — tensor-parallel axis for attention heads / FFN columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(
+    axes: dict[str, int] | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a mesh from ``{axis_name: size}`` (defaults to 1-D data axis).
+
+    ``mesh_utils.create_device_mesh`` picks a device ordering that keeps
+    neighboring mesh coordinates physically adjacent on the ICI torus.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {"data": len(devices)}
+    names = tuple(axes)
+    shape = tuple(axes.values())
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"mesh {dict(axes)} needs {int(np.prod(shape))} devices, "
+            f"have {len(devices)}"
+        )
+    if len(devices) == 1:
+        device_array = np.asarray(devices).reshape(shape)
+    else:
+        device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(device_array, names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dimension along the data axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bring-up: join the jax.distributed cluster.
+
+    On Cloud TPU pods the arguments are auto-detected from the metadata
+    server; explicit values support other launchers.  After this returns,
+    ``jax.devices()`` spans every host's chips and meshes built from it
+    communicate over ICI within a slice and DCN across slices.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs.update(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,122 @@
+"""Parameter partition specs: DP (replicated), FSDP, and tensor parallelism.
+
+The rules map the transformer's param pytree onto mesh axes:
+
+* **dp** — every parameter replicated; only the batch is split.  Gradients
+  are averaged over the ``data`` axis (psum over ICI).
+* **fsdp** — each parameter's largest divisible dimension is sharded along
+  the ``data`` axis (ZeRO-3-style); XLA all-gathers weights per layer and
+  reduce-scatters gradients.
+* **tp** — attention heads and FFN hidden columns split along the ``model``
+  axis (Megatron-style pairings: row-parallel up-projections, column-parallel
+  down-projections, vocab-parallel embeddings/head).
+
+Specs compose: ``fsdp_tp`` applies TP first, then shards a remaining
+dimension along ``data``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+#: Minimum leaf size worth sharding under FSDP (tiny norms stay replicated).
+FSDP_MIN_SIZE = 4096
+
+
+def _path_str(path) -> str:
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def _tp_spec(name: str, ndim: int) -> list:
+    """Tensor-parallel assignment for one parameter (list of axis names/None)."""
+    spec: list = [None] * ndim
+    if ndim != 2:
+        return spec
+    # Row-parallel (split d_out): QKV head blocks, FFN up-projections,
+    # vocab-parallel embedding and LM head.
+    if any(
+        key in name
+        for key in ("q_proj", "k_proj", "v_proj", "w1", "w3", "token_embeddings", "lm_head")
+    ):
+        spec[0] = "model"
+    # Column-parallel (split d_in, contracted away -> psum): attention output
+    # projection and FFN down-projection.
+    elif any(key in name for key in ("output_proj", "w2")):
+        spec[1] = "model"
+    return spec
+
+
+def _fsdp_extend(spec: list, shape: tuple, axis_size: int, fsdp_axis: str) -> list:
+    """Shard the largest still-unsharded, divisible dim along the FSDP axis."""
+    candidates = [
+        (dim_size, i)
+        for i, dim_size in enumerate(shape)
+        if spec[i] is None and dim_size % axis_size == 0
+    ]
+    if candidates:
+        _, best = max(candidates)
+        spec[best] = fsdp_axis
+    return spec
+
+
+def param_specs(
+    params,
+    mesh: Mesh,
+    strategy: str = "dp",
+    *,
+    fsdp_axis: str = "data",
+    tp_axis: str = "model",
+):
+    """Pytree of ``PartitionSpec`` for ``params`` under a strategy.
+
+    Strategies: ``dp`` | ``fsdp`` | ``tp`` | ``fsdp_tp``.
+    """
+    if strategy not in ("dp", "fsdp", "tp", "fsdp_tp"):
+        raise ValueError(f"unknown parallel strategy: {strategy!r}")
+    use_tp = "tp" in strategy and tp_axis in mesh.shape
+    use_fsdp = "fsdp" in strategy and fsdp_axis in mesh.shape
+    fsdp_size = mesh.shape.get(fsdp_axis, 1)
+    tp_size = mesh.shape.get(tp_axis, 1)
+
+    def rule(path, leaf):
+        name = _path_str(path)
+        spec: list = [None] * leaf.ndim
+        if use_tp:
+            spec = _tp_spec(name, leaf.ndim)
+            # Drop TP assignments that don't divide evenly.
+            spec = [
+                a if (a != tp_axis or leaf.shape[i] % tp_size == 0) else None
+                for i, a in enumerate(spec)
+            ]
+        if use_fsdp and leaf.size >= FSDP_MIN_SIZE:
+            spec = _fsdp_extend(spec, leaf.shape, fsdp_size, fsdp_axis)
+        return P(*spec)
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def param_shardings(params, mesh: Mesh, strategy: str = "dp", **kwargs):
+    """Pytree of ``NamedSharding`` for ``params``."""
+    specs = param_specs(params, mesh, strategy, **kwargs)
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def shard_params(params, mesh: Mesh, strategy: str = "dp", **kwargs):
+    """Place ``params`` on the mesh under the strategy's shardings."""
+    shardings = param_shardings(params, mesh, strategy, **kwargs)
+    return jax.device_put(params, shardings)

@@ -1,0 +1,92 @@
+"""Multi-chip train steps: explicit-collective DP and GSPMD FSDP/TP.
+
+Two complementary executions of the same update body
+(`training.train_step.train_step_fn`):
+
+* :func:`make_dp_train_step` — ``jax.shard_map`` over a 1-D ``data`` mesh.
+  Every chip holds full replicas; the batch is split along ``data``; each
+  chip computes local gradients and a single ``lax.pmean`` all-reduce (ICI)
+  makes them global before the identical AdamW update runs everywhere.
+  This is the BASELINE.json north-star collective, written explicitly.
+
+* :func:`make_gspmd_train_step` — ``jax.jit`` with ``NamedSharding``
+  in/out shardings for ``dp`` / ``fsdp`` / ``tp`` / ``fsdp_tp`` strategies
+  (specs from `parallel.sharding`).  XLA's SPMD partitioner derives the
+  all-gather / reduce-scatter / psum schedule from the annotations — the
+  idiomatic TPU path that scales from v4-8 data parallelism to
+  GPT-2-medium FSDP on v5p-16 (BASELINE configs 2/3/5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from bpe_transformer_tpu.models.config import ModelConfig
+from bpe_transformer_tpu.optim.adamw import AdamWState
+from bpe_transformer_tpu.parallel.sharding import param_shardings
+from bpe_transformer_tpu.training.train_step import TrainHParams, train_step_fn
+
+P = PartitionSpec
+
+
+def make_dp_train_step(
+    config: ModelConfig,
+    hparams: TrainHParams,
+    mesh: Mesh,
+    axis: str = "data",
+) -> Callable:
+    """Data-parallel step with an explicit gradient all-reduce over ``axis``.
+
+    Batch arrays must be sharded (or shardable) along their leading dim;
+    params/opt-state are replicated.  The global batch size must divide the
+    mesh axis size.
+    """
+    mapped = jax.shard_map(
+        train_step_fn(config, hparams, reduce_axis=axis),
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1))
+
+
+def make_gspmd_train_step(
+    config: ModelConfig,
+    hparams: TrainHParams,
+    mesh: Mesh,
+    strategy: str = "fsdp",
+    example_params=None,
+) -> Callable:
+    """Sharding-annotated jit step; XLA derives the collective schedule.
+
+    ``example_params`` (an abstract or concrete params pytree) is needed to
+    build per-leaf shardings.  Returns a step with donated params/opt-state.
+    """
+    if example_params is None:
+        raise ValueError("example_params is required to derive shardings")
+    p_sh = param_shardings(example_params, mesh, strategy)
+    replicated = NamedSharding(mesh, P())
+    opt_sh = AdamWState(step=replicated, m=p_sh, v=p_sh)
+    batch_sh = NamedSharding(mesh, P("data")) if "data" in mesh.shape else replicated
+    metrics_sh = {"loss": replicated, "lr": replicated, "grad_norm": replicated}
+
+    return jax.jit(
+        train_step_fn(config, hparams),
+        in_shardings=(p_sh, opt_sh, batch_sh, batch_sh),
+        out_shardings=(p_sh, opt_sh, metrics_sh),
+        donate_argnums=(0, 1),
+    )
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Place a host batch on the mesh, split along the data axis.
+
+    On meshes without that axis (e.g. pure tensor parallelism) the batch is
+    replicated instead, matching make_gspmd_train_step's fallback."""
+    spec = P(axis) if axis in mesh.shape else P()
+    return jax.device_put(batch, NamedSharding(mesh, spec))

@@ -1,0 +1,21 @@
+"""Training: jitted steps, the loop, sampling, and the CLI."""
+
+from bpe_transformer_tpu.training.loop import LoopConfig, train
+from bpe_transformer_tpu.training.sampling import generate_ids, generate_text
+from bpe_transformer_tpu.training.train_step import (
+    TrainHParams,
+    make_eval_step,
+    make_loss_fn,
+    make_train_step,
+)
+
+__all__ = [
+    "LoopConfig",
+    "TrainHParams",
+    "generate_ids",
+    "generate_text",
+    "make_eval_step",
+    "make_loss_fn",
+    "make_train_step",
+    "train",
+]

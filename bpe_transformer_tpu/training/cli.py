@@ -1,0 +1,267 @@
+"""Command-line interface: tokenizer training, corpus tokenization, LM
+training/eval, and text generation.
+
+The reference ships no CLI at all (SURVEY §5, config/flag system: "No
+CLI/argparse anywhere"); this is the framework's real entry point:
+
+    bpe-tpu train-tokenizer --input corpus.txt --vocab-size 10000 --output-dir tok/
+    bpe-tpu tokenize --input corpus.txt --tokenizer-dir tok/ --output tokens.bin
+    bpe-tpu train --data tokens.bin --val-data val.bin --preset tinystories-4l \
+                  --steps 5000 --batch-size 64 --checkpoint-dir ckpt/
+    bpe-tpu generate --checkpoint ckpt/latest.ckpt --tokenizer-dir tok/ \
+                     --prompt "Once upon a time"
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from bpe_transformer_tpu.models import config as model_configs
+from bpe_transformer_tpu.models.config import ModelConfig
+
+PRESETS = {
+    "ts-test": model_configs.TS_TEST_CONFIG,
+    "tinystories-4l": model_configs.TINYSTORIES_4L,
+    "tinystories-12l": model_configs.TINYSTORIES_12L,
+    "gpt2-small-32k": model_configs.GPT2_SMALL_32K,
+    "gpt2-medium": model_configs.GPT2_MEDIUM,
+}
+
+
+def _specials(args) -> list[str]:
+    """Resolve --special-token: appended values replace the default rather
+    than extending it (argparse appends onto list defaults)."""
+    return args.special_token if args.special_token else ["<|endoftext|>"]
+
+
+def _load_model_config(args) -> ModelConfig:
+    if args.model_config:
+        return ModelConfig.from_json(args.model_config)
+    return PRESETS[args.preset]
+
+
+def cmd_train_tokenizer(args) -> int:
+    from bpe_transformer_tpu.tokenization import BPETrainer
+
+    trainer = BPETrainer(
+        vocab_size=args.vocab_size, special_tokens=_specials(args)
+    )
+    trainer.train(args.input, n_workers=args.workers)
+    trainer.save_trainer(Path(args.output_dir))
+    print(
+        f"trained vocab of {len(trainer.vocab)} tokens "
+        f"({len(trainer.merges)} merges) -> {args.output_dir}"
+    )
+    return 0
+
+
+def _load_tokenizer(tokenizer_dir: str, special_tokens: list[str]):
+    from bpe_transformer_tpu.tokenization import BPETokenizer
+
+    d = Path(tokenizer_dir)
+    return BPETokenizer.from_files(
+        d / "vocab.pkl", d / "merges.pkl", special_tokens=special_tokens
+    )
+
+
+def cmd_tokenize(args) -> int:
+    from bpe_transformer_tpu.data import tokenize_to_memmap
+
+    tokenizer = _load_tokenizer(args.tokenizer_dir, _specials(args))
+    tokens = tokenize_to_memmap(tokenizer, args.input, args.output, args.dtype)
+    print(f"wrote {len(tokens):,} tokens ({args.dtype}) -> {args.output}")
+    return 0
+
+
+def cmd_train(args) -> int:
+    from bpe_transformer_tpu.data import load_token_file
+    from bpe_transformer_tpu.training.loop import LoopConfig, train
+    from bpe_transformer_tpu.training.train_step import TrainHParams
+
+    model_config = _load_model_config(args)
+    hparams = TrainHParams(
+        max_learning_rate=args.lr,
+        min_learning_rate=args.min_lr if args.min_lr is not None else args.lr / 10,
+        warmup_iters=args.warmup,
+        cosine_cycle_iters=args.lr_cycle if args.lr_cycle else args.steps,
+        weight_decay=args.weight_decay,
+        grad_clip_norm=args.grad_clip,
+    )
+    mesh_axes = None
+    if args.mesh:
+        mesh_axes = {
+            name: int(size)
+            for name, size in (part.split("=") for part in args.mesh.split(","))
+        }
+    loop = LoopConfig(
+        steps=args.steps,
+        batch_size=args.batch_size,
+        log_every=args.log_every,
+        eval_every=args.eval_every,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_dir=args.checkpoint_dir,
+        seed=args.seed,
+        parallel=args.parallel,
+        mesh_axes=mesh_axes,
+    )
+    train_data = load_token_file(args.data, args.dtype)
+    val_data = load_token_file(args.val_data, args.dtype) if args.val_data else None
+    summary = train(
+        model_config,
+        hparams,
+        loop,
+        train_data,
+        val_data,
+        resume_from=args.resume,
+    )
+    print(json.dumps({k: v for k, v in summary.items() if k != "history"}))
+    return 0
+
+
+def cmd_eval(args) -> int:
+    import jax.numpy as jnp
+
+    from bpe_transformer_tpu.checkpointing import load_checkpoint
+    from bpe_transformer_tpu.data import get_batch, load_token_file
+    from bpe_transformer_tpu.training.train_step import make_eval_step
+
+    model_config = _load_model_config(args)
+    payload = load_checkpoint(args.checkpoint)
+    eval_step = make_eval_step(model_config)
+    data = load_token_file(args.data, args.dtype)
+    rng = np.random.default_rng(args.seed)
+    losses = []
+    for _ in range(args.batches):
+        x, y = get_batch(data, args.batch_size, model_config.context_length, rng)
+        losses.append(float(eval_step(payload["params"], jnp.asarray(x), jnp.asarray(y))))
+    print(json.dumps({"val_loss": float(np.mean(losses)), "batches": args.batches}))
+    return 0
+
+
+def cmd_generate(args) -> int:
+    from bpe_transformer_tpu.checkpointing import load_checkpoint
+    from bpe_transformer_tpu.training.sampling import generate_text
+
+    model_config = _load_model_config(args)
+    payload = load_checkpoint(args.checkpoint)
+    tokenizer = _load_tokenizer(args.tokenizer_dir, _specials(args))
+    text = generate_text(
+        payload["params"],
+        model_config,
+        tokenizer,
+        prompt=args.prompt,
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        seed=args.seed,
+    )
+    print(text)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="bpe-tpu", description="TPU-native BPE + transformer LM framework"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("train-tokenizer", help="train a BPE tokenizer")
+    p.add_argument("--input", required=True)
+    p.add_argument("--vocab-size", type=int, required=True)
+    p.add_argument("--special-token", action="append", default=None,
+                   help='repeatable; default: ["<|endoftext|>"]')
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--workers", type=int, default=None)
+    p.set_defaults(fn=cmd_train_tokenizer)
+
+    p = sub.add_parser("tokenize", help="encode a corpus to a binary token file")
+    p.add_argument("--input", required=True)
+    p.add_argument("--tokenizer-dir", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--dtype", default="uint16", choices=["uint16", "uint32"])
+    p.add_argument("--special-token", action="append", default=None,
+                   help='repeatable; default: ["<|endoftext|>"]')
+    p.set_defaults(fn=cmd_tokenize)
+
+    p = sub.add_parser("train", help="pretrain a transformer LM")
+    p.add_argument("--data", required=True)
+    p.add_argument("--val-data", default=None)
+    p.add_argument("--dtype", default="uint16", choices=["uint16", "uint32"])
+    p.add_argument("--preset", default="tinystories-4l", choices=sorted(PRESETS))
+    p.add_argument("--model-config", default=None, help="JSON config path")
+    p.add_argument("--steps", type=int, default=1000)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--min-lr", type=float, default=None)
+    p.add_argument("--warmup", type=int, default=100)
+    p.add_argument("--lr-cycle", type=int, default=None)
+    p.add_argument("--weight-decay", type=float, default=0.01)
+    p.add_argument("--grad-clip", type=float, default=1.0)
+    p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--eval-every", type=int, default=500)
+    p.add_argument("--checkpoint-every", type=int, default=1000)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--resume", default=None)
+    p.add_argument(
+        "--parallel",
+        default=None,
+        choices=["dp", "fsdp", "tp", "fsdp_tp"],
+        help="multi-chip strategy (default: single device)",
+    )
+    p.add_argument(
+        "--mesh",
+        default=None,
+        help='mesh axes, e.g. "data=8" or "data=4,model=2"',
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("eval", help="evaluate a checkpoint's loss")
+    p.add_argument("--checkpoint", required=True)
+    p.add_argument("--data", required=True)
+    p.add_argument("--dtype", default="uint16", choices=["uint16", "uint32"])
+    p.add_argument("--preset", default="tinystories-4l", choices=sorted(PRESETS))
+    p.add_argument("--model-config", default=None)
+    p.add_argument("--batches", type=int, default=16)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_eval)
+
+    p = sub.add_parser("generate", help="sample text from a checkpoint")
+    p.add_argument("--checkpoint", required=True)
+    p.add_argument("--tokenizer-dir", required=True)
+    p.add_argument("--preset", default="tinystories-4l", choices=sorted(PRESETS))
+    p.add_argument("--model-config", default=None)
+    p.add_argument("--prompt", default="")
+    p.add_argument("--max-new-tokens", type=int, default=128)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--special-token", action="append", default=None,
+                   help='repeatable; default: ["<|endoftext|>"]')
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_generate)
+
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    # Honor JAX_PLATFORMS even on hosts whose site boot pre-selects a
+    # platform through jax.config (config wins over the env var once set).
+    import os
+
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

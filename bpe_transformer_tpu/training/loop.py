@@ -1,0 +1,190 @@
+"""The training loop: tokenized memmap -> sharded jitted steps -> checkpoints.
+
+The reference has no training loop at all (SURVEY §3.5 — it is implied by
+the union of its adapters); this makes it real, TPU-first: one jitted update
+(single-chip, explicit-DP, or GSPMD-sharded), host work limited to batch
+sampling and metric readback, periodic eval and preemption-safe checkpoints,
+and tokens/sec/chip accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import shutil
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from bpe_transformer_tpu.checkpointing import load_checkpoint, save_checkpoint
+from bpe_transformer_tpu.data.dataset import get_batch
+from bpe_transformer_tpu.models.config import ModelConfig
+from bpe_transformer_tpu.models.transformer import init_params
+from bpe_transformer_tpu.optim.adamw import AdamWState, adamw_init
+from bpe_transformer_tpu.training.train_step import (
+    TrainHParams,
+    make_eval_step,
+    make_train_step,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoopConfig:
+    steps: int = 1000
+    batch_size: int = 32
+    log_every: int = 50
+    eval_every: int = 500
+    eval_batches: int = 8
+    checkpoint_every: int = 1000
+    checkpoint_dir: str | None = None
+    seed: int = 0
+    #: None -> single device; "dp" -> shard_map psum; "fsdp"/"tp"/"fsdp_tp"
+    #: -> GSPMD with those shardings.
+    parallel: str | None = None
+    mesh_axes: dict | None = None  # e.g. {"data": 8} or {"data": 4, "model": 2}
+
+
+def train(
+    model_config: ModelConfig,
+    hparams: TrainHParams,
+    loop: LoopConfig,
+    train_data: np.ndarray,
+    val_data: np.ndarray | None = None,
+    resume_from: str | Path | None = None,
+    log_fn=print,
+) -> dict:
+    """Run the loop; returns a summary dict (final/eval losses, throughput)."""
+    # Imported here, not at module top: parallel.train_step reuses the
+    # update body from training.train_step, so a top-level import would be
+    # circular through the package __init__s.
+    from bpe_transformer_tpu.parallel import (
+        make_dp_train_step,
+        make_gspmd_train_step,
+        make_mesh,
+        shard_batch,
+        shard_params,
+    )
+
+    rng = np.random.default_rng(loop.seed)
+
+    mesh = None
+    if loop.parallel is not None:
+        mesh = make_mesh(loop.mesh_axes)
+
+    start_iteration = 0
+    if resume_from is not None:
+        payload = load_checkpoint(resume_from)
+        params = payload["params"]
+        opt_state = (
+            AdamWState(*payload["opt_state"])
+            if payload["opt_state"] is not None
+            else adamw_init(params)
+        )
+        start_iteration = payload["iteration"]
+        log_fn(f"resumed from {resume_from} at iteration {start_iteration}")
+    else:
+        params = init_params(jax.random.PRNGKey(loop.seed), model_config)
+        opt_state = None  # built after placement
+
+    if mesh is not None and loop.parallel != "dp":
+        params = shard_params(params, mesh, loop.parallel)
+    if opt_state is None:
+        opt_state = adamw_init(params)
+
+    if mesh is None:
+        step_fn = make_train_step(model_config, hparams)
+        place = lambda b: b
+    elif loop.parallel == "dp":
+        step_fn = make_dp_train_step(model_config, hparams, mesh)
+        place = lambda b: shard_batch(b, mesh)
+    else:
+        step_fn = make_gspmd_train_step(
+            model_config, hparams, mesh, loop.parallel, example_params=params
+        )
+        place = lambda b: shard_batch(b, mesh)
+
+    eval_step = make_eval_step(model_config)
+    n_chips = len(jax.devices()) if mesh is not None else 1
+    tokens_per_step = loop.batch_size * model_config.context_length
+
+    def run_eval() -> float:
+        if val_data is None:
+            return float("nan")
+        eval_rng = np.random.default_rng(loop.seed + 1)
+        losses = []
+        for _ in range(loop.eval_batches):
+            ex, ey = get_batch(
+                val_data, loop.batch_size, model_config.context_length, eval_rng
+            )
+            ex, ey = place((jax.numpy.asarray(ex), jax.numpy.asarray(ey)))
+            losses.append(float(eval_step(params, ex, ey)))
+        return float(np.mean(losses))
+
+    history: list[dict] = []
+    window_start = time.perf_counter()
+    window_tokens = 0
+    last_loss = float("nan")
+    val_loss = float("nan")
+
+    for iteration in range(start_iteration, loop.steps):
+        x, y = get_batch(
+            train_data, loop.batch_size, model_config.context_length, rng
+        )
+        x, y = place((jax.numpy.asarray(x), jax.numpy.asarray(y)))
+        params, opt_state, metrics = step_fn(params, opt_state, x, y)
+        window_tokens += tokens_per_step
+
+        is_last = iteration + 1 == loop.steps
+        if (iteration + 1) % loop.log_every == 0 or is_last:
+            last_loss = float(metrics["loss"])  # device sync point
+            elapsed = time.perf_counter() - window_start
+            tok_per_sec = window_tokens / max(elapsed, 1e-9)
+            record = {
+                "step": iteration + 1,
+                "loss": last_loss,
+                "lr": float(metrics["lr"]),
+                "grad_norm": float(metrics["grad_norm"]),
+                "tokens_per_sec": tok_per_sec,
+                "tokens_per_sec_per_chip": tok_per_sec / n_chips,
+            }
+            history.append(record)
+            log_fn(
+                f"step {record['step']:>6d}  loss {record['loss']:.4f}  "
+                f"lr {record['lr']:.2e}  gnorm {record['grad_norm']:.3f}  "
+                f"tok/s {record['tokens_per_sec']:,.0f}"
+            )
+            window_start = time.perf_counter()
+            window_tokens = 0
+
+        if val_data is not None and (
+            (iteration + 1) % loop.eval_every == 0 or is_last
+        ):
+            val_loss = run_eval()
+            log_fn(f"step {iteration + 1:>6d}  val_loss {val_loss:.4f}")
+
+        if loop.checkpoint_dir is not None and (
+            (iteration + 1) % loop.checkpoint_every == 0 or is_last
+        ):
+            ckpt_path = Path(loop.checkpoint_dir) / f"step_{iteration + 1:08d}.ckpt"
+            save_checkpoint(
+                ckpt_path,
+                params=params,
+                opt_state=opt_state,
+                iteration=iteration + 1,
+                extra={"val_loss": val_loss, "train_loss": last_loss},
+            )
+            # latest.ckpt is a byte copy — don't pay device_get + pickle twice.
+            shutil.copyfile(ckpt_path, Path(loop.checkpoint_dir) / "latest.ckpt")
+
+    summary = {
+        "steps": loop.steps,
+        "final_train_loss": last_loss,
+        "final_val_loss": val_loss,
+        "history": history,
+    }
+    if loop.checkpoint_dir is not None:
+        with open(Path(loop.checkpoint_dir) / "summary.json", "w") as f:
+            json.dump(summary, f, indent=2)
+    return summary

@@ -1,0 +1,110 @@
+"""Autoregressive sampling from a trained LM.
+
+A capability the reference never implements (its contract stops at logits);
+included so the framework is usable end-to-end: tokenize a prompt, decode
+with temperature/top-k sampling, detokenize.
+
+Implementation: fixed-shape decode — the prompt lives in a ``context_length``
+buffer and every step re-runs the jitted forward on the full buffer, reading
+the logit row at the current position (causal masking makes the padding
+beyond it irrelevant).  One compile, static shapes, no KV-cache state to
+shard; a cached-KV decode path is a later optimization.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bpe_transformer_tpu.models.config import ModelConfig
+from bpe_transformer_tpu.models.transformer import forward
+
+
+@partial(jax.jit, static_argnames=("config", "temperature", "top_k"))
+def _sample_step(params, buf, length, key, *, config, temperature, top_k):
+    logits = forward(params, buf[None, :], config)[0, length - 1]
+    if temperature == 0.0:
+        return jnp.argmax(logits)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits)[-top_k]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits)
+
+
+def generate_ids(
+    params,
+    config: ModelConfig,
+    prompt_ids: list[int],
+    max_new_tokens: int = 128,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    seed: int = 0,
+    stop_id: int | None = None,
+) -> list[int]:
+    """Sample token ids continuing ``prompt_ids`` (sliding-window context)."""
+    ctx = config.context_length
+    prompt = list(prompt_ids)[-ctx:]
+    if not prompt:
+        raise ValueError("prompt must contain at least one token")
+    buf = np.zeros(ctx, dtype=np.int32)
+    buf[: len(prompt)] = prompt
+    length = len(prompt)
+    key = jax.random.PRNGKey(seed)
+
+    out: list[int] = []
+    buf_dev = jnp.asarray(buf)
+    for _ in range(max_new_tokens):
+        key, sub = jax.random.split(key)
+        next_id = int(
+            _sample_step(
+                params,
+                buf_dev,
+                length,
+                sub,
+                config=config,
+                temperature=temperature,
+                top_k=top_k,
+            )
+        )
+        out.append(next_id)
+        if stop_id is not None and next_id == stop_id:
+            break
+        if length < ctx:
+            buf_dev = buf_dev.at[length].set(next_id)
+            length += 1
+        else:
+            buf_dev = jnp.roll(buf_dev, -1).at[ctx - 1].set(next_id)
+    return out
+
+
+def generate_text(
+    params,
+    config: ModelConfig,
+    tokenizer,
+    prompt: str = "",
+    max_new_tokens: int = 128,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    seed: int = 0,
+) -> str:
+    """Encode ``prompt``, sample a continuation, return prompt + decode."""
+    prompt_ids = tokenizer.encode(prompt) if prompt else [0]
+    stop_id = None
+    specials = getattr(tokenizer, "special_tokens", None) or []
+    if specials:
+        stop_id = tokenizer.encode(specials[0])[0]
+    new_ids = generate_ids(
+        params,
+        config,
+        prompt_ids,
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+        top_k=top_k,
+        seed=seed,
+        stop_id=stop_id,
+    )
+    return prompt + tokenizer.decode(new_ids)

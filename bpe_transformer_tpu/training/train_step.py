@@ -1,0 +1,99 @@
+"""Jitted train/eval steps: forward, loss, backward, clip, schedule, AdamW.
+
+The whole update is one traced computation (SURVEY §3.4-3.5: the reference
+implies but never implements this loop): host touches only batch feed and
+metric readback.  Multi-chip variants live in
+``bpe_transformer_tpu.parallel.train_step``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from bpe_transformer_tpu.models.config import ModelConfig
+from bpe_transformer_tpu.models.transformer import forward
+from bpe_transformer_tpu.ops.grad import clip_by_global_norm
+from bpe_transformer_tpu.ops.losses import cross_entropy
+from bpe_transformer_tpu.optim.adamw import AdamWState, adamw_update
+from bpe_transformer_tpu.optim.schedule import cosine_schedule_jax
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainHParams:
+    """Optimization hyperparameters (host-side constants baked into the jit)."""
+
+    max_learning_rate: float = 3e-4
+    min_learning_rate: float = 3e-5
+    warmup_iters: int = 100
+    cosine_cycle_iters: int = 10_000
+    betas: tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.01
+    grad_clip_norm: float = 1.0
+
+
+def make_loss_fn(config: ModelConfig) -> Callable:
+    def loss_fn(params, x, y):
+        logits = forward(params, x, config)
+        return cross_entropy(logits, y)
+
+    return loss_fn
+
+
+def train_step_fn(
+    config: ModelConfig,
+    hparams: TrainHParams,
+    reduce_axis: str | None = None,
+) -> Callable:
+    """The un-jitted update body ``(params, opt_state, x, y) ->
+    (params, opt_state, metrics)`` shared by every execution mode.
+
+    ``reduce_axis`` names a mapped mesh axis to pmean loss/grads over —
+    that single hook is all data parallelism adds to the update."""
+    loss_fn = make_loss_fn(config)
+
+    def step(params, opt_state: AdamWState, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        if reduce_axis is not None:
+            grads = jax.lax.pmean(grads, reduce_axis)
+            loss = jax.lax.pmean(loss, reduce_axis)
+        grads, grad_norm = clip_by_global_norm(grads, hparams.grad_clip_norm)
+        lr = cosine_schedule_jax(
+            opt_state.step,
+            hparams.max_learning_rate,
+            hparams.min_learning_rate,
+            hparams.warmup_iters,
+            hparams.cosine_cycle_iters,
+        )
+        params, opt_state = adamw_update(
+            params,
+            grads,
+            opt_state,
+            lr,
+            betas=hparams.betas,
+            eps=hparams.eps,
+            weight_decay=hparams.weight_decay,
+        )
+        metrics = {
+            "loss": loss.astype(jnp.float32),
+            "lr": lr.astype(jnp.float32),
+            "grad_norm": grad_norm,
+        }
+        return params, opt_state, metrics
+
+    return step
+
+
+def make_train_step(config: ModelConfig, hparams: TrainHParams) -> Callable:
+    """Single-device jitted train step with buffer donation (params and opt
+    state update in place in HBM)."""
+    return jax.jit(train_step_fn(config, hparams), donate_argnums=(0, 1))
+
+
+def make_eval_step(config: ModelConfig) -> Callable:
+    loss_fn = make_loss_fn(config)
+    return jax.jit(loss_fn)

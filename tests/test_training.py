@@ -1,0 +1,178 @@
+"""End-to-end training loop: loss decreases, resume is exact, CLI drives it."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from bpe_transformer_tpu.models import ModelConfig
+from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+from bpe_transformer_tpu.training.cli import main as cli_main
+
+TINY = ModelConfig(
+    vocab_size=256,
+    context_length=32,
+    d_model=64,
+    num_layers=2,
+    num_heads=4,
+    d_ff=128,
+)
+HP = TrainHParams(
+    max_learning_rate=1e-3,
+    min_learning_rate=1e-4,
+    warmup_iters=5,
+    cosine_cycle_iters=60,
+)
+
+
+@pytest.fixture(scope="module")
+def byte_data():
+    """A byte-level corpus with obvious structure the tiny LM can learn."""
+    rng = np.random.default_rng(0)
+    text = b"hello world. " * 4000
+    return np.frombuffer(text, dtype=np.uint8).astype(np.uint16)
+
+
+def test_loss_decreases(byte_data, tmp_path):
+    loop = LoopConfig(
+        steps=60,
+        batch_size=16,
+        log_every=10,
+        eval_every=30,
+        eval_batches=2,
+        checkpoint_every=60,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    summary = train(TINY, HP, loop, byte_data, byte_data, log_fn=lambda *_: None)
+    first = summary["history"][0]["loss"]
+    last = summary["final_train_loss"]
+    assert last < first * 0.7, (first, last)
+    assert np.isfinite(summary["final_val_loss"])
+    assert (tmp_path / "ckpt" / "latest.ckpt").exists()
+    assert (tmp_path / "ckpt" / "summary.json").exists()
+
+
+def test_resume_continues(byte_data, tmp_path):
+    ckpt_dir = tmp_path / "ckpt"
+    loop_a = LoopConfig(
+        steps=10, batch_size=8, log_every=5, checkpoint_every=10,
+        checkpoint_dir=str(ckpt_dir),
+    )
+    train(TINY, HP, loop_a, byte_data, log_fn=lambda *_: None)
+
+    loop_b = dataclasses.replace(loop_a, steps=20)
+    summary = train(
+        TINY, HP, loop_b, byte_data,
+        resume_from=ckpt_dir / "latest.ckpt", log_fn=lambda *_: None,
+    )
+    assert summary["history"][-1]["step"] == 20
+
+
+def test_dp_training_runs(byte_data):
+    loop = LoopConfig(
+        steps=8, batch_size=16, log_every=4, parallel="dp", mesh_axes={"data": 8}
+    )
+    summary = train(TINY, HP, loop, byte_data, log_fn=lambda *_: None)
+    assert np.isfinite(summary["final_train_loss"])
+
+
+def test_cli_end_to_end(tmp_path, tiny_corpus, capsys):
+    """The full user journey: train-tokenizer -> tokenize -> train -> eval ->
+    generate, all through the CLI."""
+    tok_dir = tmp_path / "tok"
+    assert (
+        cli_main(
+            [
+                "train-tokenizer",
+                "--input", str(tiny_corpus),
+                "--vocab-size", "300",
+                "--output-dir", str(tok_dir),
+            ]
+        )
+        == 0
+    )
+    tokens_path = tmp_path / "tokens.bin"
+    assert (
+        cli_main(
+            [
+                "tokenize",
+                "--input", str(tiny_corpus),
+                "--tokenizer-dir", str(tok_dir),
+                "--output", str(tokens_path),
+            ]
+        )
+        == 0
+    )
+    cfg_path = tmp_path / "model.json"
+    dataclasses.replace(TINY, vocab_size=300).to_json(cfg_path)
+    ckpt_dir = tmp_path / "ckpt"
+    assert (
+        cli_main(
+            [
+                "train",
+                "--data", str(tokens_path),
+                "--val-data", str(tokens_path),
+                "--model-config", str(cfg_path),
+                "--steps", "12",
+                "--batch-size", "8",
+                "--log-every", "6",
+                "--eval-every", "12",
+                "--checkpoint-every", "12",
+                "--checkpoint-dir", str(ckpt_dir),
+                "--warmup", "2",
+            ]
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert np.isfinite(summary["final_train_loss"])
+
+    assert (
+        cli_main(
+            [
+                "eval",
+                "--checkpoint", str(ckpt_dir / "latest.ckpt"),
+                "--data", str(tokens_path),
+                "--model-config", str(cfg_path),
+                "--batches", "2",
+                "--batch-size", "4",
+            ]
+        )
+        == 0
+    )
+    eval_out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert np.isfinite(eval_out["val_loss"])
+
+    assert (
+        cli_main(
+            [
+                "generate",
+                "--checkpoint", str(ckpt_dir / "latest.ckpt"),
+                "--tokenizer-dir", str(tok_dir),
+                "--model-config", str(cfg_path),
+                "--prompt", "the quick",
+                "--max-new-tokens", "8",
+                "--temperature", "0.8",
+            ]
+        )
+        == 0
+    )
+    gen_out = capsys.readouterr().out
+    assert gen_out.startswith("the quick")
+
+
+def test_generate_greedy_and_topk(byte_data):
+    import jax
+
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.training import generate_ids
+
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    greedy_a = generate_ids(params, TINY, [1, 2, 3], 5, temperature=0.0)
+    greedy_b = generate_ids(params, TINY, [1, 2, 3], 5, temperature=0.0)
+    assert greedy_a == greedy_b
+    sampled = generate_ids(params, TINY, [1, 2, 3], 5, temperature=1.0, top_k=5, seed=1)
+    assert len(sampled) == 5
+    assert all(0 <= t < TINY.vocab_size for t in sampled)
