@@ -1,0 +1,87 @@
+"""BASELINE config 4: Pallas fused attention vs XLA baseline at long seq.
+
+Run on a TPU host:  python benchmarks/bench_attention.py
+Prints one JSON line per sequence length with both timings and the speedup.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bpe_transformer_tpu.kernels.pallas.flash_attention import (
+    _xla_attention,
+    flash_attention,
+)
+from bpe_transformer_tpu.ops.rope import apply_rope, rope_tables
+
+BATCH, HEADS, D_HEAD = 1, 8, 64
+SEQ_LENS = (1024, 4096, 16384)
+ITERS = 20
+
+
+def _sync(x) -> float:
+    # Value fetch: the only reliable barrier on relayed remote backends.
+    return float(jax.device_get(x.reshape(-1)[0]))
+
+
+def _bench(fn, *args) -> float:
+    jitted = jax.jit(fn)
+    _sync(jitted(*args))
+    start = time.perf_counter()
+    out = None
+    for _ in range(ITERS):
+        out = jitted(*args)
+    _sync(out)
+    return (time.perf_counter() - start) / ITERS
+
+
+def main() -> int:
+    rng = np.random.default_rng(0)
+    cos, sin = rope_tables(D_HEAD, max(SEQ_LENS))
+    on_tpu = jax.default_backend() == "tpu"
+
+    for seq in SEQ_LENS:
+        shape = (BATCH, HEADS, seq, D_HEAD)
+        q, k, v = (
+            jnp.asarray(rng.standard_normal(shape), dtype=jnp.bfloat16)
+            for _ in range(3)
+        )
+        pos = jnp.arange(seq)[None, None, :]
+
+        def roped(attn):
+            def fn(q, k, v):
+                c, s = cos.astype(q.dtype), sin.astype(q.dtype)
+                return attn(apply_rope(q, pos, c, s), apply_rope(k, pos, c, s), v)
+
+            return fn
+
+        t_xla = _bench(roped(lambda q, k, v: _xla_attention(q, k, v, True)), q, k, v)
+        t_flash = _bench(
+            roped(
+                lambda q, k, v: flash_attention(q, k, v, True, 512, 512, not on_tpu)
+            ),
+            q, k, v,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": f"rope+causal_attention seq={seq} (B=1,H=8,D=64,bf16)",
+                    "xla_ms": round(t_xla * 1e3, 3),
+                    "pallas_ms": round(t_flash * 1e3, 3),
+                    "speedup": round(t_xla / t_flash, 2),
+                    "device": str(jax.devices()[0]),
+                }
+            )
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

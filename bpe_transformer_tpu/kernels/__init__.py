@@ -1,0 +1,1 @@
+"""Accelerator kernels (Pallas/Mosaic for TPU)."""

@@ -1,0 +1,11 @@
+"""Shared kernel runtime helpers."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret_mode() -> bool:
+    """Pallas TPU kernels run in interpret mode on non-TPU backends
+    (CPU tests, debugging); compiled Mosaic otherwise."""
+    return jax.default_backend() != "tpu"

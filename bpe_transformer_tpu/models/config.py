@@ -27,10 +27,12 @@ class ModelConfig:
     remove_rmsnorm: bool = False
     use_post_norm: bool = False
     remove_rope: bool = False
-    ffn_type: str | None = None  # None -> SwiGLU; "silu" -> 2-matrix SiLU FFN
+    ffn_type: str | None = None  # None -> SwiGLU; "silu"/"gelu" -> 2-matrix FFN
     # TPU execution knobs (not part of the reference schema).
     activation_dtype: str = "float32"  # "bfloat16" for the perf path
     remat: bool = False  # rematerialize each block on the backward pass
+    attention_impl: str = "xla"  # "xla" (materialized) | "flash" (Pallas)
+    flash_block_size: int = 256  # q/k tile size for the flash kernel
 
     @property
     def d_head(self) -> int:

@@ -86,6 +86,10 @@ def _ffn(x: Array, ffn_params: dict, config: ModelConfig) -> Array:
         return swiglu(x, ffn_params["w1"], ffn_params["w2"], ffn_params["w3"])
     if config.ffn_type == "silu":
         return linear(silu(linear(x, ffn_params["w1"])), ffn_params["w2"])
+    if config.ffn_type == "gelu":
+        from bpe_transformer_tpu.kernels.pallas.gelu import gelu
+
+        return linear(gelu(linear(x, ffn_params["w1"])), ffn_params["w2"])
     raise ValueError(f"unknown ffn_type: {config.ffn_type!r}")
 
 
@@ -102,6 +106,19 @@ def _attention(
     rope_cos_sin: tuple[Array, Array] | None,
     positions: Array,
 ) -> Array:
+    attention_fn = None
+    if config.attention_impl == "flash":
+        from bpe_transformer_tpu.kernels.pallas.flash_attention import (
+            flash_attention,
+        )
+        from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
+
+        block = config.flash_block_size
+        attention_fn = lambda q, k, v: flash_attention(
+            q, k, v, True, block, block, interpret_mode()
+        )
+    elif config.attention_impl != "xla":
+        raise ValueError(f"unknown attention_impl: {config.attention_impl!r}")
     return multihead_self_attention(
         x,
         attn_params["q_proj"],
@@ -112,6 +129,7 @@ def _attention(
         positions=positions,
         rope_cos_sin=rope_cos_sin,
         causal=True,
+        attention_fn=attention_fn,
     )
 
 

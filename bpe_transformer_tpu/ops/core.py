@@ -119,12 +119,15 @@ def multihead_self_attention(
     max_seq_len: int | None = None,
     rope_cos_sin: tuple[Array, Array] | None = None,
     causal: bool = True,
+    attention_fn=None,
 ) -> Array:
     """Causal multi-head self-attention, optionally with RoPE on Q/K.
 
     All four projections are single fused matmuls over the head-concat
     weight layout.  RoPE (when enabled) is applied per head at
-    ``d_head = d_model // num_heads``.
+    ``d_head = d_model // num_heads``.  ``attention_fn(q, k, v)`` swaps the
+    materialized-scores attention for a fused kernel (e.g. Pallas flash
+    attention); the callable owns its own (causal) masking.
     """
     seq_len = x.shape[-2]
     q = split_heads(linear(x, q_w), num_heads)
@@ -145,6 +148,9 @@ def multihead_self_attention(
         q = apply_rope(q, pos, cos, sin)
         k = apply_rope(k, pos, cos, sin)
 
-    mask = causal_mask(seq_len) if causal else None
-    attended = scaled_dot_product_attention(q, k, v, mask)
+    if attention_fn is not None:
+        attended = attention_fn(q, k, v)
+    else:
+        mask = causal_mask(seq_len) if causal else None
+        attended = scaled_dot_product_attention(q, k, v, mask)
     return linear(merge_heads(attended), o_w)

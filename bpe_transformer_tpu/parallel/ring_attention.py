@@ -1,0 +1,112 @@
+"""Ring attention: exact causal attention over sequence-sharded inputs.
+
+Long-context / context-parallelism subsystem (first-class here; entirely
+absent from the reference, whose max context is 16 tokens — SURVEY §5).
+
+Each device holds a contiguous sequence shard of Q, K, V.  K/V blocks rotate
+around the mesh axis with ``jax.lax.ppermute`` (nearest-neighbor ICI hops —
+the collective XLA lowers to an ICI ring); every device folds each visiting
+K/V block into its queries' online-softmax state (running max, denominator,
+f32 accumulator — the same math as the Pallas flash kernel, at shard
+granularity).  After ``axis_size`` steps every query has attended to every
+key with O(S_local) memory per device: sequence length scales linearly with
+the number of chips.
+
+Causal masking uses global positions; blocks strictly above a query shard's
+diagonal are folded in as no-ops via a predicated select (the classic ring
+load-imbalance — a zig-zag schedule is the known follow-up optimization).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from bpe_transformer_tpu.ops.core import MASK_VALUE as NEG_INF
+
+P = PartitionSpec
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Attention on sequence shards; call INSIDE shard_map over ``axis_name``.
+
+    Shapes (per device): ``q, k, v: (..., S_local, D)``; the global sequence
+    is the concatenation of shards in mesh-axis order.
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    s_local = q.shape[-2]
+    d = q.shape[-1]
+    scale = 1.0 / (d**0.5)
+
+    q32 = q.astype(jnp.float32) * scale
+    stat_shape = (*q.shape[:-1], 1)
+    m = jnp.full(stat_shape, NEG_INF, jnp.float32)
+    l = jnp.zeros(stat_shape, jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
+
+    rows = jnp.arange(s_local)[:, None]
+    cols = jnp.arange(s_local)[None, :]
+
+    k_cur, v_cur = k, v
+    for step in range(n):
+        src = (me - step) % n  # which shard's K/V we hold this step
+
+        scores = jnp.einsum(
+            "...qd,...kd->...qk", q32, k_cur.astype(jnp.float32)
+        )
+        if causal:
+            # global row index = me*S+r, global col = src*S+c
+            keep = (me * s_local + rows) >= (src * s_local + cols)
+            scores = jnp.where(keep, scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "...qk,...kv->...qv", p, v_cur.astype(jnp.float32)
+        )
+
+        if causal:
+            # Blocks fully above our diagonal fold in as no-ops.  step 0 is
+            # our own (diagonal) block, so state is always seeded validly.
+            visible = src <= me
+            m = jnp.where(visible, m_new, m)
+            l = jnp.where(visible, l_new, l)
+            acc = jnp.where(visible, acc_new, acc)
+        else:
+            m, l, acc = m_new, l_new, acc_new
+
+        if step < n - 1:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis: str = "data", causal: bool = True):
+    """Wrap :func:`ring_self_attention` for callers outside shard_map.
+
+    Returns ``fn(q, k, v)`` over global ``(B, H, S, D)`` arrays; S is split
+    along ``axis``.
+    """
+    spec = P(None, None, axis, None)
+    mapped = jax.shard_map(
+        partial(ring_self_attention, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return mapped

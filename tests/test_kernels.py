@@ -1,0 +1,187 @@
+"""Pallas kernels (interpret mode on CPU) + ring attention parity."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bpe_transformer_tpu.kernels.pallas.flash_attention import (
+    _xla_attention,
+    flash_attention,
+)
+from bpe_transformer_tpu.kernels.pallas.gelu import gelu, gelu_reference
+from bpe_transformer_tpu.parallel import make_mesh
+from bpe_transformer_tpu.parallel.ring_attention import make_ring_attention
+
+
+# ------------------------------------------------------------------- gelu
+
+
+@pytest.mark.parametrize("shape", [(7,), (33, 17), (2, 3, 130)])
+def test_gelu_matches_reference_formula(shape):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(shape).astype(np.float32) * 3)
+    out = gelu(x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(gelu_reference(x)), atol=1e-6
+    )
+
+
+def test_gelu_matches_torch_tanh_gelu():
+    import torch
+    import torch.nn.functional as F
+
+    x = np.linspace(-5, 5, 257, dtype=np.float32)
+    ours = np.asarray(gelu(jnp.asarray(x)))
+    theirs = F.gelu(torch.from_numpy(x), approximate="tanh").numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+# -------------------------------------------------------- flash attention
+
+
+@pytest.mark.parametrize(
+    "batch,heads,seq,d,causal",
+    [
+        (2, 2, 128, 64, True),
+        (1, 4, 256, 64, True),
+        (2, 2, 128, 64, False),
+        (1, 1, 200, 32, True),  # seq not divisible by block, odd head dim
+    ],
+)
+def test_flash_attention_matches_xla(batch, heads, seq, d, causal):
+    rng = np.random.default_rng(1)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((batch, heads, seq, d)).astype(np.float32)
+    )
+    q, k, v = mk(), mk(), mk()
+    out = flash_attention(q, k, v, causal, 128, 128, True)
+    expected = _xla_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+def test_flash_attention_gradients_match_xla():
+    rng = np.random.default_rng(2)
+    shape = (1, 2, 128, 32)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal(shape).astype(np.float32)) for _ in range(3)
+    )
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, True, 128, 128, True).sum()
+
+    def loss_xla(q, k, v):
+        return _xla_attention(q, k, v, True).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_xla):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_flash_attention_bf16():
+    rng = np.random.default_rng(3)
+    shape = (1, 2, 128, 64)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal(shape), dtype=jnp.bfloat16)
+        for _ in range(3)
+    )
+    out = flash_attention(q, k, v, True, 128, 128, True)
+    expected = _xla_attention(q, k, v, True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32),
+        np.asarray(expected, dtype=np.float32),
+        atol=3e-2,
+    )
+
+
+# ---------------------------------------------------------- ring attention
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_full(causal):
+    mesh = make_mesh({"data": 8})
+    rng = np.random.default_rng(4)
+    shape = (2, 2, 8 * 16, 32)  # seq 128 split 8 ways -> 16 per device
+    q, k, v = (
+        jnp.asarray(rng.standard_normal(shape).astype(np.float32)) for _ in range(3)
+    )
+    ring = make_ring_attention(mesh, "data", causal)
+    out = ring(q, k, v)
+    expected = _xla_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+def test_ring_attention_gradients_flow():
+    mesh = make_mesh({"data": 8})
+    rng = np.random.default_rng(5)
+    shape = (1, 2, 64, 16)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal(shape).astype(np.float32)) for _ in range(3)
+    )
+    ring = make_ring_attention(mesh, "data", True)
+
+    g_ring = jax.grad(lambda q_: ring(q_, k, v).sum())(q)
+    g_full = jax.grad(lambda q_: _xla_attention(q_, k, v, True).sum())(q)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_full), atol=2e-5)
+
+
+# ------------------------------------------------- model kernel integration
+
+
+def test_model_flash_attention_matches_xla_impl():
+    import dataclasses
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, forward, init_params
+
+    cfg = dataclasses.replace(TS_TEST_CONFIG, vocab_size=512)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 512, size=(2, 16)))
+    base = forward(params, ids, cfg)
+    flash_cfg = dataclasses.replace(cfg, attention_impl="flash")
+    flashed = forward(params, ids, flash_cfg)
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(flashed), atol=2e-4, rtol=1e-3
+    )
+
+
+def test_model_gelu_ffn_trains():
+    import dataclasses
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, init_params
+    from bpe_transformer_tpu.training import TrainHParams, make_train_step
+    from bpe_transformer_tpu.optim import adamw_init
+
+    cfg = dataclasses.replace(TS_TEST_CONFIG, vocab_size=512, ffn_type="gelu")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    step = make_train_step(cfg, TrainHParams(warmup_iters=1, cosine_cycle_iters=5))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 512, size=(4, 16)))
+    y = jnp.asarray(rng.integers(0, 512, size=(4, 16)))
+    params, _, metrics = step(params, adamw_init(params), x, y)
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["grad_norm"]))
+
+
+def test_gelu_large_inputs_finite():
+    """exp-based tanh must not overflow: gelu(11) == 11, not NaN."""
+    x = jnp.asarray([11.0, 50.0, 1000.0, -1000.0], dtype=jnp.float32)
+    out = np.asarray(gelu(x))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out[:3], np.asarray(x[:3]), rtol=1e-6)
+    assert out[3] == 0.0
+
+
+def test_flash_attention_asymmetric_blocks():
+    """seq not divisible by block_q alone must still produce every row."""
+    rng = np.random.default_rng(7)
+    shape = (1, 2, 100, 32)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal(shape).astype(np.float32)) for _ in range(3)
+    )
+    out = flash_attention(q, k, v, True, 64, 256, True)
+    expected = _xla_attention(q, k, v, True)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
