@@ -105,9 +105,9 @@ def _attention(
     config: ModelConfig,
     rope_cos_sin: tuple[Array, Array] | None,
     positions: Array,
+    attention_fn=None,
 ) -> Array:
-    attention_fn = None
-    if config.attention_impl == "flash":
+    if attention_fn is None and config.attention_impl == "flash":
         from bpe_transformer_tpu.kernels.pallas.flash_attention import (
             flash_attention,
         )
@@ -117,7 +117,7 @@ def _attention(
         attention_fn = lambda q, k, v: flash_attention(
             q, k, v, True, block, block, interpret_mode()
         )
-    elif config.attention_impl != "xla":
+    elif attention_fn is None and config.attention_impl != "xla":
         raise ValueError(f"unknown attention_impl: {config.attention_impl!r}")
     return multihead_self_attention(
         x,
@@ -139,11 +139,19 @@ def transformer_block(
     config: ModelConfig,
     rope_cos_sin: tuple[Array, Array] | None,
     positions: Array,
+    attention_fn=None,
 ) -> Array:
-    """One block; pre-norm by default, post-norm under the ablation flag."""
+    """One block; pre-norm by default, post-norm under the ablation flag.
+
+    ``attention_fn(q, k, v)`` overrides the config-selected attention (used
+    by the sequence-parallel path to substitute ring attention).
+    """
     if config.use_post_norm:
         x = _maybe_norm(
-            x + _attention(x, block_params["attn"], config, rope_cos_sin, positions),
+            x
+            + _attention(
+                x, block_params["attn"], config, rope_cos_sin, positions, attention_fn
+            ),
             block_params["ln1"],
             config,
         )
@@ -151,7 +159,9 @@ def transformer_block(
             x + _ffn(x, block_params["ffn"], config), block_params["ln2"], config
         )
     h = _maybe_norm(x, block_params["ln1"], config)
-    x = x + _attention(h, block_params["attn"], config, rope_cos_sin, positions)
+    x = x + _attention(
+        h, block_params["attn"], config, rope_cos_sin, positions, attention_fn
+    )
     h = _maybe_norm(x, block_params["ln2"], config)
     return x + _ffn(h, block_params["ffn"], config)
 
@@ -161,6 +171,7 @@ def forward(
     token_ids: Array,
     config: ModelConfig,
     positions: Array | None = None,
+    attention_fn=None,
 ) -> Array:
     """Logits ``(batch, seq, vocab)`` for ``token_ids (batch, seq)``.
 
@@ -198,11 +209,12 @@ def forward(
 
     block = transformer_block
     if config.remat:
+        # config and attention_fn are non-array (static) arguments.
         block = jax.checkpoint(
-            transformer_block, static_argnums=(2,), policy=None
+            transformer_block, static_argnums=(2, 5), policy=None
         )
     for block_params in compute_params["layers"]:
-        x = block(x, block_params, config, rope_cos_sin, positions)
+        x = block(x, block_params, config, rope_cos_sin, positions, attention_fn)
 
     x = _maybe_norm(x, compute_params["ln_final"], config)
     # LM head always runs in float32 for stable logits/loss.

@@ -11,6 +11,15 @@ from bpe_transformer_tpu.parallel.sharding import (
     param_specs,
     shard_params,
 )
+from bpe_transformer_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    ring_self_attention,
+)
+from bpe_transformer_tpu.parallel.sp import (
+    make_sp_train_step,
+    shard_sp_batch,
+    sp_forward,
+)
 from bpe_transformer_tpu.parallel.train_step import (
     make_dp_train_step,
     make_gspmd_train_step,
@@ -19,6 +28,11 @@ from bpe_transformer_tpu.parallel.train_step import (
 
 __all__ = [
     "batch_sharding",
+    "make_ring_attention",
+    "make_sp_train_step",
+    "ring_self_attention",
+    "shard_sp_batch",
+    "sp_forward",
     "initialize_distributed",
     "make_dp_train_step",
     "make_gspmd_train_step",

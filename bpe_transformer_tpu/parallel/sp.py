@@ -1,0 +1,116 @@
+"""Sequence-parallel (context-parallel) training: ring attention in the loop.
+
+Long sequences are sharded along a ``seq`` mesh axis (in addition to the
+``data`` batch axis): every chip holds a slice of every sequence, activation
+memory scales as O(S / n_seq), and attention runs as the ring schedule from
+`parallel.ring_attention` (K/V shards rotating over ICI).  Everything else
+in the block (norms, FFN, projections) is token-local, so only attention and
+the loss/grad reductions touch collectives:
+
+* attention: ``ppermute`` ring over ``seq``;
+* loss and gradients: ``pmean`` over both ``data`` and ``seq``.
+
+This subsystem has no reference counterpart at all (max context there is 16
+tokens) — it exists because long-context is first-class in the TPU build.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from bpe_transformer_tpu.models.config import ModelConfig
+from bpe_transformer_tpu.models.transformer import forward
+from bpe_transformer_tpu.ops.grad import clip_by_global_norm
+from bpe_transformer_tpu.ops.losses import cross_entropy
+from bpe_transformer_tpu.optim.adamw import AdamWState, adamw_update
+from bpe_transformer_tpu.optim.schedule import cosine_schedule_jax
+from bpe_transformer_tpu.parallel.ring_attention import ring_self_attention
+from bpe_transformer_tpu.training.train_step import TrainHParams
+
+P = PartitionSpec
+
+
+def sp_forward(
+    params,
+    local_token_ids: jax.Array,
+    config: ModelConfig,
+    seq_axis: str,
+) -> jax.Array:
+    """Forward over a local sequence shard; call INSIDE shard_map.
+
+    Positions are global (shard offset + local index) so RoPE sees the true
+    token positions; attention is the exact ring schedule over ``seq_axis``.
+    """
+    s_local = local_token_ids.shape[-1]
+    offset = jax.lax.axis_index(seq_axis) * s_local
+    positions = offset + jnp.arange(s_local)
+    attention_fn = partial(ring_self_attention, axis_name=seq_axis, causal=True)
+    return forward(
+        params, local_token_ids, config, positions=positions, attention_fn=attention_fn
+    )
+
+
+def make_sp_train_step(
+    config: ModelConfig,
+    hparams: TrainHParams,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+) -> Callable:
+    """Train step over a 2-D (data x seq) mesh: batch split on ``data``,
+    every sequence split on ``seq``; params/opt-state replicated.
+
+    The global batch must divide the data axis and ``context_length`` must
+    divide the seq axis.
+    """
+
+    def local_step(params, opt_state: AdamWState, x, y):
+        def loss_fn(p):
+            logits = sp_forward(p, x, config, seq_axis)
+            return cross_entropy(logits, y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # Equal-size shards: the global mean is the mean of shard means.
+        grads = jax.lax.pmean(grads, (data_axis, seq_axis))
+        loss = jax.lax.pmean(loss, (data_axis, seq_axis))
+
+        grads, grad_norm = clip_by_global_norm(grads, hparams.grad_clip_norm)
+        lr = cosine_schedule_jax(
+            opt_state.step,
+            hparams.max_learning_rate,
+            hparams.min_learning_rate,
+            hparams.warmup_iters,
+            hparams.cosine_cycle_iters,
+        )
+        params, opt_state = adamw_update(
+            params, grads, opt_state, lr,
+            betas=hparams.betas, eps=hparams.eps,
+            weight_decay=hparams.weight_decay,
+        )
+        metrics = {
+            "loss": loss.astype(jnp.float32),
+            "lr": lr.astype(jnp.float32),
+            "grad_norm": grad_norm,
+        }
+        return params, opt_state, metrics
+
+    batch_spec = P(data_axis, seq_axis)
+    mapped = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(), batch_spec, batch_spec),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1))
+
+
+def shard_sp_batch(batch, mesh: Mesh, data_axis: str = "data", seq_axis: str = "seq"):
+    """Place ``(B, S)`` batch arrays split over (data, seq)."""
+    sharding = NamedSharding(mesh, P(data_axis, seq_axis))
+    return jax.device_put(batch, sharding)

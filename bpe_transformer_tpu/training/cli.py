@@ -210,7 +210,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--parallel",
         default=None,
-        choices=["dp", "fsdp", "tp", "fsdp_tp"],
+        choices=["dp", "sp", "fsdp", "tp", "fsdp_tp"],
         help="multi-chip strategy (default: single device)",
     )
     p.add_argument(

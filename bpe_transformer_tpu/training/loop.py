@@ -40,8 +40,9 @@ class LoopConfig:
     checkpoint_every: int = 1000
     checkpoint_dir: str | None = None
     seed: int = 0
-    #: None -> single device; "dp" -> shard_map psum; "fsdp"/"tp"/"fsdp_tp"
-    #: -> GSPMD with those shardings.
+    #: None -> single device; "dp" -> shard_map psum; "sp" -> context
+    #: parallelism (ring attention over a data x seq mesh);
+    #: "fsdp"/"tp"/"fsdp_tp" -> GSPMD with those shardings.
     parallel: str | None = None
     mesh_axes: dict | None = None  # e.g. {"data": 8} or {"data": 4, "model": 2}
 
@@ -63,15 +64,33 @@ def train(
         make_dp_train_step,
         make_gspmd_train_step,
         make_mesh,
+        make_sp_train_step,
         shard_batch,
         shard_params,
+        shard_sp_batch,
     )
 
     rng = np.random.default_rng(loop.seed)
 
     mesh = None
     if loop.parallel is not None:
-        mesh = make_mesh(loop.mesh_axes)
+        mesh_axes = loop.mesh_axes
+        if mesh_axes is None and loop.parallel == "sp":
+            # sp needs a seq axis; default to pure context parallelism.
+            mesh_axes = {"data": 1, "seq": len(jax.devices())}
+        mesh = make_mesh(mesh_axes)
+        if loop.parallel == "sp":
+            seq_size = mesh.shape.get("seq")
+            if seq_size is None:
+                raise ValueError(
+                    'parallel="sp" requires a mesh with a "seq" axis, e.g. '
+                    '--mesh data=2,seq=4'
+                )
+            if model_config.context_length % seq_size:
+                raise ValueError(
+                    f"context_length {model_config.context_length} must be "
+                    f"divisible by the seq mesh axis ({seq_size})"
+                )
 
     start_iteration = 0
     if resume_from is not None:
@@ -88,7 +107,7 @@ def train(
         params = init_params(jax.random.PRNGKey(loop.seed), model_config)
         opt_state = None  # built after placement
 
-    if mesh is not None and loop.parallel != "dp":
+    if mesh is not None and loop.parallel not in ("dp", "sp"):
         params = shard_params(params, mesh, loop.parallel)
     if opt_state is None:
         opt_state = adamw_init(params)
@@ -99,6 +118,9 @@ def train(
     elif loop.parallel == "dp":
         step_fn = make_dp_train_step(model_config, hparams, mesh)
         place = lambda b: shard_batch(b, mesh)
+    elif loop.parallel == "sp":
+        step_fn = make_sp_train_step(model_config, hparams, mesh)
+        place = lambda b: shard_sp_batch(b, mesh)
     else:
         step_fn = make_gspmd_train_step(
             model_config, hparams, mesh, loop.parallel, example_params=params

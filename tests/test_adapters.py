@@ -1,0 +1,238 @@
+"""The reference-compatibility seam, exercised the way the reference's
+CS336-derived suite drives it: torch tensors in, torch tensors out."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+from bpe_transformer_tpu.compat import (
+    get_adamw_cls,
+    get_tokenizer,
+    run_cross_entropy,
+    run_get_batch,
+    run_get_lr_cosine_schedule,
+    run_gradient_clipping,
+    run_linear,
+    run_load_checkpoint,
+    run_rope,
+    run_save_checkpoint,
+    run_scaled_dot_product_attention,
+    run_silu,
+    run_softmax,
+    run_swiglu,
+    run_train_bpe,
+    run_transformer_block,
+    run_transformer_lm,
+)
+from tests.test_model import CFG, random_state_dict, torch_block, torch_lm
+
+
+def test_linear_adapter():
+    w = torch.randn(16, 8)
+    x = torch.randn(3, 5, 8)
+    np.testing.assert_allclose(
+        run_linear(8, 16, w, x).numpy(), (x @ w.T).numpy(), atol=1e-5
+    )
+
+
+def test_silu_softmax_adapters():
+    x = torch.randn(4, 7)
+    np.testing.assert_allclose(
+        run_silu(x).numpy(), F.silu(x).numpy(), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        run_softmax(x + 100, dim=-1).numpy(), F.softmax(x, dim=-1).numpy(), atol=1e-6
+    )
+
+
+def test_sdpa_adapter_matches_reference_snapshot(reference_snapshots):
+    expected = dict(
+        np.load(reference_snapshots / "test_scaled_dot_product_attention.npz")
+    )["array"]
+    torch.manual_seed(1)
+    q = torch.randn(4, 12, 64)
+    torch.manual_seed(2)
+    k = torch.randn(4, 16, 64)
+    torch.manual_seed(3)
+    v = torch.randn(4, 16, 64)
+    torch.manual_seed(5)
+    mask = torch.randn(4, 12, 16) > 0.5
+    out = run_scaled_dot_product_attention(q, k, v, mask)
+    np.testing.assert_allclose(out.numpy(), expected, atol=1e-6, rtol=1e-4)
+
+
+def test_rope_adapter_matches_reference_snapshot(reference_snapshots):
+    expected = dict(np.load(reference_snapshots / "test_rope.npz"))["array"]
+    torch.manual_seed(4)
+    x = torch.randn(4, 12, 64)
+    out = run_rope(64, 10000.0, 12, x, torch.arange(12))
+    np.testing.assert_allclose(out.numpy(), expected, atol=1e-6, rtol=1e-4)
+
+
+def test_swiglu_adapter():
+    d, ff = 64, 128
+    torch.manual_seed(0)
+    w1, w3 = torch.randn(ff, d) * 0.1, torch.randn(ff, d) * 0.1
+    w2 = torch.randn(d, ff) * 0.1
+    x = torch.randn(2, 5, d)
+    expected = (F.silu(x @ w1.T) * (x @ w3.T)) @ w2.T
+    np.testing.assert_allclose(
+        run_swiglu(d, ff, w1, w2, w3, x).numpy(), expected.numpy(), atol=1e-5
+    )
+
+
+def test_transformer_block_adapter_matches_oracle():
+    sd = random_state_dict(CFG)
+    block_weights = {
+        k[len("layers.0."):]: v for k, v in sd.items() if k.startswith("layers.0.")
+    }
+    torch.manual_seed(7)
+    x = torch.randn(4, 12, CFG.d_model)
+    expected = torch_block(x, block_weights, CFG.num_heads, CFG.rope_theta)
+    out = run_transformer_block(
+        CFG.d_model, CFG.num_heads, CFG.d_ff, 16, CFG.rope_theta, block_weights, x
+    )
+    np.testing.assert_allclose(out.numpy(), expected.numpy(), atol=2e-5, rtol=1e-4)
+
+
+def test_transformer_lm_adapter_matches_oracle():
+    sd = random_state_dict(CFG)
+    torch.manual_seed(42)
+    indices = torch.randint(0, CFG.vocab_size, (4, 12))
+    expected = torch_lm(indices, sd, CFG)
+    out = run_transformer_lm(
+        CFG.vocab_size, 16, CFG.d_model, CFG.num_layers, CFG.num_heads,
+        CFG.d_ff, CFG.rope_theta, sd, indices,
+    )
+    np.testing.assert_allclose(out.numpy(), expected.numpy(), atol=1e-4, rtol=1e-2)
+
+
+def test_cross_entropy_adapter():
+    logits = torch.rand(8, 5) * 1000
+    targets = torch.randint(0, 5, (8,))
+    expected = F.cross_entropy(logits, targets)
+    np.testing.assert_allclose(
+        run_cross_entropy(logits, targets).numpy(), expected.numpy(), atol=1e-4
+    )
+
+
+def test_gradient_clipping_adapter_in_place():
+    torch.manual_seed(0)
+    tensors = [torch.randn(5, 5) for _ in range(3)]
+    max_norm = 1e-2
+
+    ours = tuple(nn.Parameter(t.clone()) for t in tensors)
+    ours[-1].requires_grad_(False)
+    torch.cat([p for p in ours]).sum().backward()
+    run_gradient_clipping(ours, max_norm)
+
+    theirs = tuple(nn.Parameter(t.clone()) for t in tensors)
+    theirs[-1].requires_grad_(False)
+    torch.cat([p for p in theirs]).sum().backward()
+    torch.nn.utils.clip_grad_norm_(theirs, max_norm)
+
+    for a, b in zip(ours, theirs):
+        if a.grad is not None:
+            np.testing.assert_allclose(a.grad.numpy(), b.grad.numpy(), atol=1e-6)
+
+
+def _optimize(opt_class) -> torch.Tensor:
+    """The reference's 1000-step optimizer trace (`test_optimizer.py:7-26`)."""
+    torch.manual_seed(42)
+    model = nn.Linear(3, 2, bias=False)
+    opt = opt_class(
+        model.parameters(), lr=1e-3, weight_decay=0.01, betas=(0.9, 0.999), eps=1e-8
+    )
+    for _ in range(1000):
+        opt.zero_grad()
+        x = torch.rand(model.in_features)
+        y_hat = model(x)
+        y = torch.tensor([x[0] + x[1], -x[2]])
+        ((y - y_hat) ** 2).sum().backward()
+        opt.step()
+    return model.weight.detach()
+
+
+def test_adamw_cls_matches_torch():
+    expected = _optimize(torch.optim.AdamW)
+    actual = _optimize(get_adamw_cls())
+    assert torch.allclose(actual, expected, atol=1e-4)
+
+
+def test_lr_schedule_adapter():
+    assert run_get_lr_cosine_schedule(0, 1.0, 0.1, 7, 21) == 0
+    assert run_get_lr_cosine_schedule(7, 1.0, 0.1, 7, 21) == 1.0
+    assert run_get_lr_cosine_schedule(24, 1.0, 0.1, 7, 21) == 0.1
+
+
+def test_get_batch_adapter():
+    dataset = np.arange(100)
+    x, y = run_get_batch(dataset, 8, 7, "cpu")
+    assert x.dtype == torch.int64
+    assert x.shape == (8, 7)
+    np.testing.assert_allclose((x + 1).numpy(), y.numpy())
+    with pytest.raises((RuntimeError, AssertionError)):
+        run_get_batch(dataset, 8, 7, "cuda:99")
+
+
+class _Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(20, 30)
+        self.fc2 = nn.Linear(30, 5)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_checkpoint_adapter_roundtrip(tmp_path):
+    torch.manual_seed(0)
+    model = _Net()
+    opt = get_adamw_cls()(model.parameters(), lr=1e-3, weight_decay=0.01,
+                          betas=(0.9, 0.999), eps=1e-8)
+    for _ in range(5):
+        opt.zero_grad()
+        ((model(torch.rand(20)) - torch.rand(5)) ** 2).sum().backward()
+        opt.step()
+
+    path = tmp_path / "ckpt.pt"
+    run_save_checkpoint(model, opt, iteration=5, out=path)
+
+    fresh_model = _Net()
+    fresh_opt = get_adamw_cls()(fresh_model.parameters(), lr=1e-3,
+                                weight_decay=0.01, betas=(0.9, 0.999), eps=1e-8)
+    assert run_load_checkpoint(path, fresh_model, fresh_opt) == 5
+
+    for key, value in model.state_dict().items():
+        np.testing.assert_allclose(
+            value.numpy(), fresh_model.state_dict()[key].numpy()
+        )
+    # Optimizer internal state must also roundtrip (moments, step counts).
+    orig_state = opt.state_dict()["state"]
+    new_state = fresh_opt.state_dict()["state"]
+    assert set(orig_state.keys()) == set(new_state.keys())
+    for k in orig_state:
+        for sub, val in orig_state[k].items():
+            np.testing.assert_allclose(
+                np.asarray(val), np.asarray(new_state[k][sub])
+            )
+    # And training must continue identically after the restore.
+    torch.manual_seed(1)
+    x, y = torch.rand(20), torch.rand(5)
+    for m, o in ((model, opt), (fresh_model, fresh_opt)):
+        o.zero_grad()
+        ((m(x) - y) ** 2).sum().backward()
+        o.step()
+    for key, value in model.state_dict().items():
+        np.testing.assert_allclose(
+            value.numpy(), fresh_model.state_dict()[key].numpy(), atol=1e-7
+        )
+
+
+def test_train_bpe_and_tokenizer_adapters(tiny_corpus):
+    vocab, merges = run_train_bpe(tiny_corpus, 300, ["<|endoftext|>"])
+    tok = get_tokenizer(vocab, merges, ["<|endoftext|>"])
+    text = "the quick brown fox<|endoftext|>"
+    assert tok.decode(tok.encode(text)) == text

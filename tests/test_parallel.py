@@ -134,3 +134,52 @@ def test_dp_forward_inference_sharded():
     xs = shard_batch(x, mesh)
     logits = jax.jit(lambda p, t: forward(p, t, CFG))(params, xs)
     assert logits.shape == (16, 8, CFG.vocab_size)
+
+
+def test_sp_step_matches_single_device():
+    """Context-parallel (ring attention) training step == single-device step."""
+    from bpe_transformer_tpu.parallel import make_sp_train_step, shard_sp_batch
+
+    params, opt_state, x, y = _setup()
+    single = make_train_step(CFG, HP)
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    params2, opt_state2, x2, y2 = _setup()
+    step = make_sp_train_step(CFG, HP, mesh)
+    x2, y2 = shard_sp_batch((x2, y2), mesh)
+    p2, s2, m2 = step(params2, opt_state2, x2, y2)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        p1,
+        p2,
+    )
+
+
+def test_sp_forward_matches_full_forward():
+    from bpe_transformer_tpu.parallel import sp_forward
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({"seq": 8})
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, CFG.vocab_size, size=(2, CFG.context_length))
+    )
+    full = forward(params, ids, CFG)
+
+    mapped = jax.shard_map(
+        partial(sp_forward, config=CFG, seq_axis="seq"),
+        mesh=mesh,
+        in_specs=(P(), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    )
+    sharded = mapped(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(full), np.asarray(sharded), atol=2e-4, rtol=1e-3
+    )
