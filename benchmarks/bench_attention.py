@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from bpe_transformer_tpu.kernels.pallas.flash_attention import (
     _xla_attention,
     flash_attention,
+    flash_attention_with_rope,
 )
 from bpe_transformer_tpu.ops.rope import apply_rope, rope_tables
 
@@ -62,10 +63,17 @@ def main() -> int:
 
             return fn
 
+        cos_s, sin_s = cos[:seq], sin[:seq]
         t_xla = _bench(roped(lambda q, k, v: _xla_attention(q, k, v, True)), q, k, v)
         t_flash = _bench(
             roped(
                 lambda q, k, v: flash_attention(q, k, v, True, 512, 512, not on_tpu)
+            ),
+            q, k, v,
+        )
+        t_fused = _bench(
+            lambda q, k, v: flash_attention_with_rope(
+                q, k, v, cos_s, sin_s, True, 512, 512, not on_tpu
             ),
             q, k, v,
         )
@@ -75,7 +83,9 @@ def main() -> int:
                     "metric": f"rope+causal_attention seq={seq} (B=1,H=8,D=64,bf16)",
                     "xla_ms": round(t_xla * 1e3, 3),
                     "pallas_ms": round(t_flash * 1e3, 3),
+                    "pallas_fused_rope_ms": round(t_fused * 1e3, 3),
                     "speedup": round(t_xla / t_flash, 2),
+                    "speedup_fused": round(t_xla / t_fused, 2),
                     "device": str(jax.devices()[0]),
                 }
             )

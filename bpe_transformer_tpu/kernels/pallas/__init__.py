@@ -1,6 +1,14 @@
 """Pallas (Mosaic) TPU kernels for the hot ops."""
 
-from bpe_transformer_tpu.kernels.pallas.flash_attention import flash_attention
+from bpe_transformer_tpu.kernels.pallas.flash_attention import (
+    flash_attention,
+    flash_attention_with_rope,
+)
 from bpe_transformer_tpu.kernels.pallas.gelu import gelu, gelu_reference
 
-__all__ = ["flash_attention", "gelu", "gelu_reference"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_rope",
+    "gelu",
+    "gelu_reference",
+]

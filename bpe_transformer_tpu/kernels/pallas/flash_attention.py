@@ -1,8 +1,10 @@
-"""Flash attention: blockwise online-softmax attention as a Pallas kernel.
+"""Flash attention: blockwise online-softmax attention as a Pallas kernel,
+optionally with RoPE fused into the Q/K block loads.
 
 TPU-native replacement for materialized S^2 attention (the reference's spec
 M7, `/root/reference/tests/adapters.py:92-110`, materializes the full score
-matrix; BASELINE.json config 4 demands a fused kernel at seq 1k/4k/16k).
+matrix; BASELINE.json config 4 demands a fused RoPE+attention kernel at seq
+1k/4k/16k).
 
 Kernel structure (classic FlashAttention on the MXU):
 
@@ -16,6 +18,13 @@ Kernel structure (classic FlashAttention on the MXU):
 * sequence padding to the block size is sound under causal masking (padded
   keys sit above every valid query's diagonal) and padded query rows are
   sliced off on the way out.
+* RoPE fusion: Q and K are pre-permuted on the host side from the
+  interleaved pair convention ``(x0, x1, x2, x3, ...)`` to a half-split
+  layout ``(x0, x2, ... | x1, x3, ...)``.  Attention scores are invariant
+  under any fixed permutation of the head dim applied to both Q and K, so
+  in-kernel rotation becomes two dense multiply-adds against full-width
+  cos/sin tiles (``rot = x * C + swap(x) * S``) with no strided access —
+  the rotated Q/K never round-trip through HBM.
 
 The backward pass recomputes attention with plain XLA ops (memory-bound but
 correct); a Pallas backward kernel is the natural next optimization.
@@ -37,10 +46,30 @@ from bpe_transformer_tpu.ops.core import causal_mask, scaled_dot_product_attenti
 LANES = 128
 
 
+def _rotate_half_layout(x, c, s, half: int):
+    """RoPE rotation for inputs in the half-split feature layout.
+
+    ``c``/``s`` are full-width cos/sin tiles ``[cos|cos|0]`` / ``[sin|sin|0]``
+    so the rotation is ``x * c + swap(x) * s`` with ``swap = [-x2 | x1 | 0]``
+    — two dense FMAs, no strided lane access.
+    """
+    x1 = x[:, :half]
+    x2 = x[:, half : 2 * half]
+    tail = x[:, 2 * half :]
+    swapped = jnp.concatenate([-x2, x1, tail], axis=-1)
+    return x * c + swapped * s
+
+
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, scale: float, block_q: int, block_k: int, causal: bool, num_k_blocks: int,
+    *refs,
+    scale: float, block_q: int, block_k: int, causal: bool, num_k_blocks: int,
+    rope_half: int,
 ):
+    if rope_half:
+        q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref = refs[:7]
+        o_ref, acc_ref, m_ref, l_ref, qrot_ref = refs[7:]
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -49,14 +78,32 @@ def _flash_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
+        if rope_half:
+            # Rotate the query block once per (batch*head, q-block); it is
+            # reused across every key block from VMEM scratch.
+            qrot_ref[:] = _rotate_half_layout(
+                q_ref[0].astype(jnp.float32) * scale,
+                cq_ref[:].astype(jnp.float32),
+                sq_ref[:].astype(jnp.float32),
+                rope_half,
+            )
 
     # Key blocks entirely above the causal diagonal contribute nothing.
     compute = (block_k * ik) <= (block_q * iq + block_q - 1) if causal else True
 
     @pl.when(compute)
     def _block():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
+        if rope_half:
+            q = qrot_ref[:]
+            k = _rotate_half_layout(
+                k_ref[0].astype(jnp.float32),
+                ck_ref[:].astype(jnp.float32),
+                sk_ref[:].astype(jnp.float32),
+                rope_half,
+            )
+        else:
+            q = q_ref[0].astype(jnp.float32) * scale
+            k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -95,11 +142,18 @@ def _xla_attention(q, k, v, causal: bool):
     return out.astype(q.dtype)
 
 
-def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
+def _flash_impl(q, k, v, causal, block_q, block_k, interpret, cos=None, sin=None):
     *batch, s, d = q.shape
     bh = 1
     for dim in batch:
         bh *= dim
+    rope = cos is not None
+    if rope and (cos.shape != (s, d // 2) or sin.shape != (s, d // 2)):
+        raise ValueError(
+            f"cos/sin must be position-gathered to shape (seq, d//2) = "
+            f"{(s, d // 2)}, got {cos.shape} / {sin.shape}; select rows from "
+            "rope_tables(...) by token position before calling"
+        )
 
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -118,6 +172,23 @@ def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
         x = x.reshape(bh, s, d)
         return jnp.pad(x, ((0, 0), (0, s_pad - s), (0, d_pad - d)))
 
+    if rope:
+        half = d // 2
+        # Scores are invariant to a fixed feature permutation applied to both
+        # Q and K: move from the interleaved pair convention to a half-split
+        # layout so the in-kernel rotation needs no strided access.
+        to_half = lambda x: jnp.concatenate([x[..., 0::2], x[..., 1::2]], axis=-1)
+        q, k = to_half(q), to_half(k)
+        # Full-width tiles [cos|cos|0] / [sin|sin|0], padded to (s_pad, d_pad).
+        ctile = jnp.pad(
+            jnp.concatenate([cos, cos], axis=-1).astype(jnp.float32),
+            ((0, s_pad - s), (0, d_pad - d)),
+        )
+        stile = jnp.pad(
+            jnp.concatenate([sin, sin], axis=-1).astype(jnp.float32),
+            ((0, s_pad - s), (0, d_pad - d)),
+        )
+
     qp, kp, vp = prep(q), prep(k), prep(v)
     nq = s_pad // block_q
     nk = s_pad // block_k
@@ -129,26 +200,35 @@ def _flash_impl(q, k, v, causal, block_q, block_k, interpret):
         block_k=block_k,
         causal=causal,
         num_k_blocks=nk,
+        rope_half=(d // 2) if rope else 0,
     )
+    qspec = pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM)
+    in_specs = [qspec, kspec, kspec]
+    operands = [qp, kp, vp]
+    scratch = [
+        pltpu.VMEM((block_q, d_pad), jnp.float32),  # output accumulator
+        pltpu.VMEM((block_q, LANES), jnp.float32),  # running row max
+        pltpu.VMEM((block_q, LANES), jnp.float32),  # running denominator
+    ]
+    if rope:
+        tile_q = pl.BlockSpec((block_q, d_pad), lambda b, i, j: (i, 0), memory_space=pltpu.VMEM)
+        tile_k = pl.BlockSpec((block_k, d_pad), lambda b, i, j: (j, 0), memory_space=pltpu.VMEM)
+        in_specs += [tile_q, tile_q, tile_k, tile_k]
+        operands += [ctile, stile, ctile, stile]
+        scratch.append(pltpu.VMEM((block_q, d_pad), jnp.float32))  # rotated Q
+
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(qp.shape, qp.dtype),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, block_q, d_pad), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
         ),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d_pad), jnp.float32),  # output accumulator
-            pltpu.VMEM((block_q, LANES), jnp.float32),  # running row max
-            pltpu.VMEM((block_q, LANES), jnp.float32),  # running denominator
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(qp, kp, vp)
+    )(*operands)
 
     return out[:, :s, :d].reshape(*batch, s, d)
 
@@ -184,3 +264,58 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ------------------------------------------------- fused RoPE + attention
+
+
+def _xla_rope_attention(q, k, v, cos, sin, causal: bool):
+    """XLA oracle for the fused kernel: interleaved-pair RoPE on Q/K, then
+    materialized-scores attention (used for parity tests and the recompute
+    backward)."""
+    from bpe_transformer_tpu.ops.rope import apply_rope
+
+    positions = jnp.arange(q.shape[-2])
+    qr = apply_rope(q.astype(jnp.float32), positions, cos, sin)
+    kr = apply_rope(k.astype(jnp.float32), positions, cos, sin)
+    return _xla_attention(qr, kr, v.astype(jnp.float32), causal).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def flash_attention_with_rope(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention with RoPE applied to Q/K inside the kernel.
+
+    ``cos``/``sin`` are position-gathered tables of shape ``(seq, d//2)``
+    (interleaved-pair convention, `ops.rope.rope_tables` rows selected by
+    token position) — the rotated Q/K exist only in VMEM, saving one full
+    read+write of Q and K through HBM versus rope-then-attention
+    (BASELINE.json config 4: fused RoPE+attention at seq 1k/4k/16k).
+    """
+    return _flash_impl(q, k, v, causal, block_q, block_k, interpret, cos, sin)
+
+
+def _flash_rope_fwd(q, k, v, cos, sin, causal, block_q, block_k, interpret):
+    out = _flash_impl(q, k, v, causal, block_q, block_k, interpret, cos, sin)
+    return out, (q, k, v, cos, sin)
+
+
+def _flash_rope_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v, cos, sin = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_, c_, s_: _xla_rope_attention(q_, k_, v_, c_, s_, causal),
+        q, k, v, cos, sin,
+    )
+    return vjp(g)
+
+
+flash_attention_with_rope.defvjp(_flash_rope_fwd, _flash_rope_bwd)

@@ -31,7 +31,8 @@ class ModelConfig:
     # TPU execution knobs (not part of the reference schema).
     activation_dtype: str = "float32"  # "bfloat16" for the perf path
     remat: bool = False  # rematerialize each block on the backward pass
-    attention_impl: str = "xla"  # "xla" (materialized) | "flash" (Pallas)
+    # "xla" (materialized) | "flash" (Pallas) | "flash_fused" (RoPE in-kernel)
+    attention_impl: str = "xla"
     flash_block_size: int = 256  # q/k tile size for the flash kernel
 
     @property

@@ -8,7 +8,9 @@ import jax.numpy as jnp
 
 from bpe_transformer_tpu.kernels.pallas.flash_attention import (
     _xla_attention,
+    _xla_rope_attention,
     flash_attention,
+    flash_attention_with_rope,
 )
 from bpe_transformer_tpu.kernels.pallas.gelu import gelu, gelu_reference
 from bpe_transformer_tpu.parallel import make_mesh
@@ -94,6 +96,69 @@ def test_flash_attention_bf16():
         np.asarray(out, dtype=np.float32),
         np.asarray(expected, dtype=np.float32),
         atol=3e-2,
+    )
+
+
+# ------------------------------------------------ fused RoPE + attention
+
+
+@pytest.mark.parametrize(
+    "batch,heads,seq,d",
+    [
+        (2, 4, 48, 64),   # seq not divisible by block
+        (1, 2, 128, 32),
+        (1, 1, 200, 16),  # small head dim, ragged seq
+    ],
+)
+def test_fused_rope_flash_attention_matches_xla(batch, heads, seq, d):
+    from bpe_transformer_tpu.ops.rope import rope_tables
+
+    rng = np.random.default_rng(6)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((batch, heads, seq, d)).astype(np.float32)
+    )
+    q, k, v = mk(), mk(), mk()
+    cos, sin = rope_tables(d, seq)
+    out = flash_attention_with_rope(q, k, v, cos, sin, True, 32, 16, True)
+    expected = _xla_rope_attention(q, k, v, cos, sin, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+def test_fused_rope_flash_attention_gradients_match_xla():
+    from bpe_transformer_tpu.ops.rope import rope_tables
+
+    rng = np.random.default_rng(7)
+    shape = (1, 2, 96, 32)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal(shape).astype(np.float32)) for _ in range(3)
+    )
+    cos, sin = rope_tables(shape[-1], shape[-2])
+
+    def loss_fused(q, k, v):
+        return flash_attention_with_rope(q, k, v, cos, sin, True, 32, 32, True).sum()
+
+    def loss_xla(q, k, v):
+        return _xla_rope_attention(q, k, v, cos, sin, True).sum()
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fused, g_xla):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_model_fused_flash_attention_matches_xla_impl():
+    import dataclasses
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, forward, init_params
+
+    cfg = dataclasses.replace(TS_TEST_CONFIG, vocab_size=512)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids = jnp.asarray(np.random.default_rng(8).integers(0, 512, size=(2, 16)))
+    base = forward(params, ids, cfg)
+    fused_cfg = dataclasses.replace(cfg, attention_impl="flash_fused")
+    fused = forward(params, ids, fused_cfg)
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(fused), atol=2e-4, rtol=1e-3
     )
 
 
