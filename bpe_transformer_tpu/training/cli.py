@@ -105,6 +105,8 @@ def cmd_train(args) -> int:
         eval_every=args.eval_every,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
+        metrics_jsonl=args.metrics_jsonl,
+        wandb_project=args.wandb_project,
         seed=args.seed,
         parallel=args.parallel,
         mesh_axes=mesh_axes,
@@ -206,6 +208,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval-every", type=int, default=500)
     p.add_argument("--checkpoint-every", type=int, default=1000)
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="append step metrics as JSON lines to this file")
+    p.add_argument("--wandb-project", default=None,
+                   help="log metrics to this wandb project (requires wandb)")
     p.add_argument("--resume", default=None)
     p.add_argument(
         "--parallel",

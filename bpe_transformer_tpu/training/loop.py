@@ -12,7 +12,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import shutil
-import time
 from pathlib import Path
 
 import jax
@@ -28,6 +27,8 @@ from bpe_transformer_tpu.training.train_step import (
     make_eval_step,
     make_train_step,
 )
+from bpe_transformer_tpu.utils.metrics import MetricsLogger
+from bpe_transformer_tpu.utils.profiling import StepTimer
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +40,10 @@ class LoopConfig:
     eval_batches: int = 8
     checkpoint_every: int = 1000
     checkpoint_dir: str | None = None
+    #: Optional observability sinks (utils.metrics): JSONL file of step
+    #: records, and a wandb project (gated import — only used when set).
+    metrics_jsonl: str | None = None
+    wandb_project: str | None = None
     seed: int = 0
     #: None -> single device; "dp" -> shard_map psum; "sp" -> context
     #: parallelism (ring attention over a data x seq mesh);
@@ -145,61 +150,67 @@ def train(
         return float(np.mean(losses))
 
     history: list[dict] = []
-    window_start = time.perf_counter()
-    window_tokens = 0
+    timer = StepTimer(n_chips=n_chips)
+    sinks = MetricsLogger(
+        jsonl_path=loop.metrics_jsonl, wandb_project=loop.wandb_project
+    )
     last_loss = float("nan")
     val_loss = float("nan")
 
-    for iteration in range(start_iteration, loop.steps):
-        x, y = get_batch(
-            train_data, loop.batch_size, model_config.context_length, rng
-        )
-        x, y = place((jax.numpy.asarray(x), jax.numpy.asarray(y)))
-        params, opt_state, metrics = step_fn(params, opt_state, x, y)
-        window_tokens += tokens_per_step
-
-        is_last = iteration + 1 == loop.steps
-        if (iteration + 1) % loop.log_every == 0 or is_last:
-            last_loss = float(metrics["loss"])  # device sync point
-            elapsed = time.perf_counter() - window_start
-            tok_per_sec = window_tokens / max(elapsed, 1e-9)
-            record = {
-                "step": iteration + 1,
-                "loss": last_loss,
-                "lr": float(metrics["lr"]),
-                "grad_norm": float(metrics["grad_norm"]),
-                "tokens_per_sec": tok_per_sec,
-                "tokens_per_sec_per_chip": tok_per_sec / n_chips,
-            }
-            history.append(record)
-            log_fn(
-                f"step {record['step']:>6d}  loss {record['loss']:.4f}  "
-                f"lr {record['lr']:.2e}  gnorm {record['grad_norm']:.3f}  "
-                f"tok/s {record['tokens_per_sec']:,.0f}"
+    # finally-close so an interrupt/OOM mid-run still flushes the JSONL
+    # handle and finishes the wandb run.
+    try:
+        for iteration in range(start_iteration, loop.steps):
+            x, y = get_batch(
+                train_data, loop.batch_size, model_config.context_length, rng
             )
-            window_start = time.perf_counter()
-            window_tokens = 0
+            x, y = place((jax.numpy.asarray(x), jax.numpy.asarray(y)))
+            params, opt_state, metrics = step_fn(params, opt_state, x, y)
+            timer.update(tokens_per_step)
 
-        if val_data is not None and (
-            (iteration + 1) % loop.eval_every == 0 or is_last
-        ):
-            val_loss = run_eval()
-            log_fn(f"step {iteration + 1:>6d}  val_loss {val_loss:.4f}")
+            is_last = iteration + 1 == loop.steps
+            if (iteration + 1) % loop.log_every == 0 or is_last:
+                last_loss = float(metrics["loss"])  # device sync point
+                rates = timer.snapshot()
+                record = {
+                    "step": iteration + 1,
+                    "loss": last_loss,
+                    "lr": float(metrics["lr"]),
+                    "grad_norm": float(metrics["grad_norm"]),
+                    "tokens_per_sec": rates["tokens_per_sec"],
+                    "tokens_per_sec_per_chip": rates["tokens_per_sec_per_chip"],
+                }
+                history.append(record)
+                sinks.log(record)
+                log_fn(
+                    f"step {record['step']:>6d}  loss {record['loss']:.4f}  "
+                    f"lr {record['lr']:.2e}  gnorm {record['grad_norm']:.3f}  "
+                    f"tok/s {record['tokens_per_sec']:,.0f}"
+                )
 
-        if loop.checkpoint_dir is not None and (
-            (iteration + 1) % loop.checkpoint_every == 0 or is_last
-        ):
-            ckpt_path = Path(loop.checkpoint_dir) / f"step_{iteration + 1:08d}.ckpt"
-            save_checkpoint(
-                ckpt_path,
-                params=params,
-                opt_state=opt_state,
-                iteration=iteration + 1,
-                extra={"val_loss": val_loss, "train_loss": last_loss},
-            )
-            # latest.ckpt is a byte copy — don't pay device_get + pickle twice.
-            shutil.copyfile(ckpt_path, Path(loop.checkpoint_dir) / "latest.ckpt")
+            if val_data is not None and (
+                (iteration + 1) % loop.eval_every == 0 or is_last
+            ):
+                val_loss = run_eval()
+                sinks.log({"step": iteration + 1, "val_loss": val_loss})
+                log_fn(f"step {iteration + 1:>6d}  val_loss {val_loss:.4f}")
 
+            if loop.checkpoint_dir is not None and (
+                (iteration + 1) % loop.checkpoint_every == 0 or is_last
+            ):
+                ckpt_path = Path(loop.checkpoint_dir) / f"step_{iteration + 1:08d}.ckpt"
+                save_checkpoint(
+                    ckpt_path,
+                    params=params,
+                    opt_state=opt_state,
+                    iteration=iteration + 1,
+                    extra={"val_loss": val_loss, "train_loss": last_loss},
+                )
+                # latest.ckpt is a byte copy — don't pay device_get + pickle twice.
+                shutil.copyfile(ckpt_path, Path(loop.checkpoint_dir) / "latest.ckpt")
+
+    finally:
+        sinks.close()
     summary = {
         "steps": loop.steps,
         "final_train_loss": last_loss,

@@ -1,0 +1,12 @@
+from bpe_transformer_tpu.utils.debug import check_finite, nan_checks
+from bpe_transformer_tpu.utils.metrics import MetricsLogger
+from bpe_transformer_tpu.utils.profiling import StepTimer, profile_trace, time_fn
+
+__all__ = [
+    "MetricsLogger",
+    "StepTimer",
+    "check_finite",
+    "nan_checks",
+    "profile_trace",
+    "time_fn",
+]

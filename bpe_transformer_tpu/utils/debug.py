@@ -1,0 +1,52 @@
+"""Debug toggles: NaN checking and finite-ness assertions.
+
+The reference has no sanitizers and no races by construction (share-nothing
+multiprocessing, SURVEY §5). The JAX-native analogue of a sanitizer is
+``jax_debug_nans`` (recompiles jitted fns with NaN checks on every op) plus
+explicit finite checks at step boundaries; both live here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+
+@contextlib.contextmanager
+def nan_checks(enabled: bool = True):
+    """Enable ``jax_debug_nans`` within the block.
+
+    Under this flag XLA de-optimizes jitted functions so every primitive's
+    output is checked; a NaN raises ``FloatingPointError`` at the producing
+    op instead of surfacing steps later in the loss. Expensive — use for
+    debugging runs, not production training.
+    """
+    previous = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enabled)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", previous)
+
+
+def check_finite(tree, name: str = "tree") -> None:
+    """Host-side assertion that every leaf of a pytree is finite.
+
+    All per-leaf ``isfinite`` reductions are dispatched first and fetched
+    with a single ``jax.device_get``, so the host sync cost is one round
+    trip regardless of tree size; intended at checkpoint boundaries so a
+    corrupted state is never serialized.
+    """
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    finite = jax.device_get(
+        [jnp.all(jnp.isfinite(leaf)) for _, leaf in leaves]
+    )
+    bad = [
+        jax.tree_util.keystr(path)
+        for (path, _), ok in zip(leaves, finite)
+        if not bool(ok)
+    ]
+    if bad:
+        raise FloatingPointError(f"non-finite values in {name}: {bad}")

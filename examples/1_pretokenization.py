@@ -1,0 +1,56 @@
+"""Pre-tokenization demo: serial vs parallel throughput.
+
+Script equivalent of the reference's `notebooks/1_pretokenization.ipynb`
+(which timed serial vs multiprocessing pre-tokenization of TinyStories on a
+laptop — SURVEY §6). Runs both paths on a text file and reports tokens/sec.
+
+Usage:
+    python examples/1_pretokenization.py [--input PATH] [--workers N]
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+import argparse
+import time
+
+from bpe_transformer_tpu.tokenization.pretokenization import (
+    parallel_pretokenization,
+    serial_pretokenization,
+)
+
+DEFAULT_INPUT = Path("/root/reference/tests/fixtures/tinystories_sample.txt")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--input", type=Path, default=DEFAULT_INPUT)
+    parser.add_argument("--workers", type=int, default=None)
+    args = parser.parse_args()
+
+    special_tokens = ["<|endoftext|>"]
+
+    start = time.perf_counter()
+    serial_counts = serial_pretokenization(args.input, special_tokens=special_tokens)
+    serial_s = time.perf_counter() - start
+    n_tokens = sum(serial_counts.values())
+    print(f"serial:   {serial_s:6.2f}s  ({n_tokens / serial_s:,.0f} pretokens/s)")
+
+    start = time.perf_counter()
+    parallel_counts = parallel_pretokenization(
+        args.input, n_workers=args.workers, special_tokens=special_tokens
+    )
+    parallel_s = time.perf_counter() - start
+    print(f"parallel: {parallel_s:6.2f}s  ({n_tokens / parallel_s:,.0f} pretokens/s)")
+
+    assert parallel_counts == serial_counts, "parallel != serial pretokenization"
+    print(f"{len(serial_counts):,} distinct pretokens, {n_tokens:,} total — paths agree")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

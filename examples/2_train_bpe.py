@@ -1,0 +1,58 @@
+"""BPE training demo: learn a vocabulary and inspect it.
+
+Script equivalent of the reference's `notebooks/2_bpe_tokenization_training
+.ipynb` (BPE training with timing/memory measurement — SURVEY §6). Trains a
+BPE tokenizer on a text file, saves vocab/merges artifacts, and prints the
+longest learned token.
+
+Usage:
+    python examples/2_train_bpe.py [--input PATH] [--vocab-size N] [--out DIR]
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+import argparse
+import time
+import tracemalloc
+
+from bpe_transformer_tpu import BPETrainer
+
+DEFAULT_INPUT = Path("/root/reference/tests/fixtures/tinystories_sample.txt")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--input", type=Path, default=DEFAULT_INPUT)
+    parser.add_argument("--vocab-size", type=int, default=1000)
+    parser.add_argument("--out", type=Path, default=Path("bpe_artifacts"))
+    args = parser.parse_args()
+
+    tracemalloc.start()
+    start = time.perf_counter()
+    trainer = BPETrainer(
+        vocab_size=args.vocab_size, special_tokens=["<|endoftext|>"]
+    )
+    trainer.train(args.input)
+    elapsed = time.perf_counter() - start
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+
+    vocab, merges = trainer.vocab, trainer.merges
+    print(f"trained vocab {len(vocab):,} ({len(merges):,} merges) "
+          f"in {elapsed:.2f}s, peak traced memory {peak / 2**20:.1f} MB")
+
+    longest = max(vocab.values(), key=len)
+    print(f"longest learned token: {longest!r} ({len(longest)} bytes)")
+
+    trainer.save_trainer(args.out)
+    print(f"saved vocab.pkl / merges.pkl under {args.out}/")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,92 @@
+"""End-to-end LM pretraining demo: text -> BPE -> memmap -> TPU train -> sample.
+
+The reference has no training loop at all (SURVEY §3.5); this is the full
+pipeline its adapters imply, TPU-native: train a BPE tokenizer on the host,
+stream-encode the corpus to a uint16 memmap, run jitted training steps on
+whatever accelerator JAX finds, and sample text from the result.
+
+Usage:
+    python examples/4_train_lm.py [--input PATH] [--steps N] [--out DIR]
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+import argparse
+import dataclasses
+
+from bpe_transformer_tpu import BPETokenizer, BPETrainer
+from bpe_transformer_tpu.data.dataset import tokenize_to_memmap
+from bpe_transformer_tpu.models import TINYSTORIES_4L
+from bpe_transformer_tpu.training.loop import LoopConfig, train
+from bpe_transformer_tpu.training.sampling import generate_text
+from bpe_transformer_tpu.training.train_step import TrainHParams
+
+DEFAULT_INPUT = Path("/root/reference/tests/fixtures/tinystories_sample.txt")
+SPECIALS = ["<|endoftext|>"]
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--input", type=Path, default=DEFAULT_INPUT)
+    parser.add_argument("--steps", type=int, default=300)
+    parser.add_argument("--vocab-size", type=int, default=2000)
+    parser.add_argument("--out", type=Path, default=Path("lm_demo"))
+    args = parser.parse_args()
+    args.out.mkdir(parents=True, exist_ok=True)
+
+    print("1/4  training BPE tokenizer ...")
+    trainer = BPETrainer(vocab_size=args.vocab_size, special_tokens=SPECIALS)
+    trainer.train(args.input)
+    trainer.save_trainer(args.out / "tokenizer")
+    tokenizer = BPETokenizer(trainer.vocab, trainer.merges, SPECIALS)
+
+    print("2/4  encoding corpus to memmap ...")
+    tokens = tokenize_to_memmap(tokenizer, args.input, args.out / "tokens.bin")
+    print(f"     {tokens.shape[0]:,} tokens")
+
+    print("3/4  training LM ...")
+    config = dataclasses.replace(
+        TINYSTORIES_4L, vocab_size=args.vocab_size, context_length=128
+    )
+    n_val = max(tokens.shape[0] // 20, config.context_length + 1)
+    summary = train(
+        model_config=config,
+        hparams=TrainHParams(
+            max_learning_rate=3e-3,
+            warmup_iters=max(args.steps // 20, 1),
+            cosine_cycle_iters=args.steps,
+        ),
+        loop=LoopConfig(
+            steps=args.steps,
+            batch_size=32,
+            log_every=max(args.steps // 10, 1),
+            eval_every=args.steps,
+            checkpoint_every=args.steps,
+            checkpoint_dir=str(args.out / "checkpoints"),
+            metrics_jsonl=str(args.out / "metrics.jsonl"),
+        ),
+        train_data=tokens[:-n_val],
+        val_data=tokens[-n_val:],
+    )
+    print(f"     final train loss {summary['final_train_loss']:.3f}, "
+          f"val loss {summary['final_val_loss']:.3f}")
+
+    print("4/4  sampling ...")
+    from bpe_transformer_tpu.checkpointing import load_checkpoint
+
+    params = load_checkpoint(args.out / "checkpoints" / "latest.ckpt")["params"]
+    text = generate_text(
+        params, config, tokenizer,
+        prompt="Once upon a time", max_new_tokens=64, temperature=0.8, top_k=40,
+    )
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,6 +38,10 @@ def test_linear_adapter():
 
 
 def test_silu_softmax_adapters():
+    # Seeded: the softmax(x + 100) overflow check compares against
+    # softmax(x) at atol 1e-6, and f32 rounding of `x + 100` can exceed
+    # that for unlucky unseeded draws with |x| large.
+    torch.manual_seed(0)
     x = torch.randn(4, 7)
     np.testing.assert_allclose(
         run_silu(x).numpy(), F.silu(x).numpy(), atol=1e-6

@@ -1,0 +1,63 @@
+"""Smoke-run the examples/ scripts (the reference's notebook equivalents).
+
+Each runs as a subprocess on the small reference fixtures with CPU forced,
+asserting exit 0 and the expected closing output.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_example(tmp_path, sample: Path, script: str, *args: str) -> str:
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / script), "--input", str(sample), *args],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+        env=env,
+        timeout=600,
+    )
+    assert proc.returncode == 0, f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
+    return proc.stdout
+
+
+@pytest.fixture
+def sample(reference_fixtures) -> Path:
+    return reference_fixtures / "tinystories_sample.txt"
+
+
+def test_example_pretokenization(tmp_path, sample):
+    out = run_example(tmp_path, sample, "1_pretokenization.py", "--workers", "2")
+    assert "paths agree" in out
+
+
+def test_example_train_bpe(tmp_path, sample):
+    out = run_example(tmp_path, sample, "2_train_bpe.py", "--vocab-size", "400")
+    assert "longest learned token" in out
+    assert (tmp_path / "bpe_artifacts" / "vocab.pkl").exists()
+
+
+def test_example_encode_decode(tmp_path, sample):
+    out = run_example(tmp_path, sample, "3_encode_decode.py")
+    assert "roundtrip OK" in out
+
+
+@pytest.mark.slow
+def test_example_train_lm(tmp_path, sample):
+    out = run_example(
+        tmp_path, sample, "4_train_lm.py", "--steps", "4", "--vocab-size", "400"
+    )
+    assert "4/4  sampling" in out
+    assert (tmp_path / "lm_demo" / "checkpoints" / "latest.ckpt").exists()
+    assert (tmp_path / "lm_demo" / "metrics.jsonl").exists()

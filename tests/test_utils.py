@@ -1,0 +1,82 @@
+"""Auxiliary subsystems: profiling harness, debug toggles, metrics sinks.
+
+The reference has none of these as library code (SURVEY §5 — profiling in
+notebook cells, no logging calls, unused wandb dep); these tests pin the
+TPU-native versions.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bpe_transformer_tpu.utils import (
+    MetricsLogger,
+    StepTimer,
+    check_finite,
+    nan_checks,
+    profile_trace,
+    time_fn,
+)
+
+
+def test_time_fn_reports_timings():
+    fn = jax.jit(lambda x: x * 2.0)
+    x = jnp.ones((16, 16))
+    out = time_fn(fn, x, iters=3, warmup=1)
+    assert out["iters"] == 3
+    assert 0 < out["best_s"] <= out["mean_s"]
+
+
+def test_profile_trace_writes_artifacts(tmp_path):
+    logdir = tmp_path / "trace"
+    with profile_trace(str(logdir)):
+        jax.block_until_ready(jnp.dot(jnp.ones((32, 32)), jnp.ones((32, 32))))
+    # jax.profiler writes plugins/profile/<run>/ under the logdir.
+    assert any(logdir.rglob("*.xplane.pb")), "no xplane trace written"
+
+
+def test_step_timer_windows():
+    timer = StepTimer(n_chips=4)
+    timer.update(1000)
+    timer.update(1000)
+    snap = timer.snapshot()
+    assert snap["window_tokens"] == 2000
+    assert snap["tokens_per_sec"] == pytest.approx(
+        4 * snap["tokens_per_sec_per_chip"]
+    )
+    # Window resets.
+    assert timer.snapshot()["window_tokens"] == 0
+    assert timer.total_tokens == 2000
+
+
+def test_metrics_logger_jsonl_and_stdout(tmp_path):
+    path = tmp_path / "m.jsonl"
+    lines = []
+    with MetricsLogger(stdout=True, jsonl_path=path, log_fn=lines.append) as m:
+        m.log({"step": 1, "loss": 2.5})
+        m.log({"step": 2, "loss": 2.25})
+    records = [json.loads(line) for line in path.read_text().splitlines()]
+    assert records == [{"step": 1, "loss": 2.5}, {"step": 2, "loss": 2.25}]
+    assert lines and "loss 2.5" in lines[0]
+
+
+def test_metrics_logger_noop_without_sinks():
+    MetricsLogger().log({"step": 1})  # must not raise
+
+
+def test_nan_checks_catches_nan_at_the_producing_op():
+    with nan_checks():
+        with pytest.raises(FloatingPointError):
+            jax.block_until_ready(jnp.log(jnp.array(-1.0)) * 0.0)
+    # Restored afterwards: the same expression is fine outside the block.
+    jax.block_until_ready(jnp.log(jnp.array(-1.0)) * 0.0)
+
+
+def test_check_finite():
+    good = {"a": jnp.ones(3), "b": {"c": jnp.zeros(2)}}
+    check_finite(good)
+    bad = {"a": jnp.ones(3), "b": {"c": jnp.array([1.0, float("nan")])}}
+    with pytest.raises(FloatingPointError, match="b"):
+        check_finite(bad, name="params")
