@@ -12,6 +12,21 @@ Heavy JAX subpackages are imported lazily so tokenizer-only workflows never
 pay for (or require) an accelerator runtime.
 """
 
+import os as _os
+import sys as _sys
+
+if _os.environ.get("JAX_PLATFORMS") and "jax" in _sys.modules:
+    # Some containers register an accelerator PJRT plugin at interpreter
+    # boot (sitecustomize) and force-select it via jax.config, which tramples
+    # the JAX_PLATFORMS env var.  Re-assert the user's explicit platform
+    # choice before any backend initializes; no-op once backends are live.
+    try:
+        _sys.modules["jax"].config.update(
+            "jax_platforms", _os.environ["JAX_PLATFORMS"]
+        )
+    except Exception:
+        pass
+
 from bpe_transformer_tpu.tokenization import BPETokenizer, BPETrainer, Tokenizer, train_bpe
 
 __version__ = "0.1.0"

@@ -32,15 +32,34 @@ def tokenize_to_memmap(
     out_path = Path(out_path)
     out_path.parent.mkdir(parents=True, exist_ok=True)
     dt = np.dtype(dtype)
-    buffer: list[int] = []
+    encode_arrays = getattr(tokenizer, "encode_iterable_arrays", None)
     with open(text_path, encoding="utf-8") as src, open(out_path, "wb") as dst:
-        for token_id in tokenizer.encode_iterable(src):
-            buffer.append(token_id)
-            if len(buffer) >= 1 << 20:
+        if encode_arrays is not None:
+            # Array fast path: identical segmentation (and token stream) to
+            # encode_iterable, but ids stay in numpy arrays end to end; with
+            # the native engine this is the C++ hot loop.  Writes are
+            # buffered to ~1M tokens so per-line segments don't become
+            # per-line syscalls.
+            chunks: list[np.ndarray] = []
+            buffered = 0
+            for ids in encode_arrays(src):
+                chunks.append(ids.astype(dt, copy=False))
+                buffered += ids.size
+                if buffered >= 1 << 20:
+                    np.concatenate(chunks).tofile(dst)
+                    chunks.clear()
+                    buffered = 0
+            if chunks:
+                np.concatenate(chunks).tofile(dst)
+        else:
+            buffer: list[int] = []
+            for token_id in tokenizer.encode_iterable(src):
+                buffer.append(token_id)
+                if len(buffer) >= 1 << 20:
+                    np.asarray(buffer, dtype=dt).tofile(dst)
+                    buffer.clear()
+            if buffer:
                 np.asarray(buffer, dtype=dt).tofile(dst)
-                buffer.clear()
-        if buffer:
-            np.asarray(buffer, dtype=dt).tofile(dst)
     return load_token_file(out_path, dtype)
 
 

@@ -1,0 +1,21 @@
+"""Native (C++) host-side tokenization engine.
+
+The TPU design keeps text processing on the host CPU; this package provides
+the C++ hot loops (GPT-2 pre-tokenization scanner + BPE merge loop) behind a
+ctypes C ABI, with transparent fallback to the pure-Python path when no
+toolchain is available.
+"""
+
+from bpe_transformer_tpu.native.engine import (
+    NativeBPEEncoder,
+    is_available,
+    pretokenize_offsets,
+    unavailable_reason,
+)
+
+__all__ = [
+    "NativeBPEEncoder",
+    "is_available",
+    "pretokenize_offsets",
+    "unavailable_reason",
+]

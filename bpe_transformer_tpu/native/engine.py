@@ -1,0 +1,178 @@
+"""ctypes driver for the C++ tokenization engine.
+
+Builds ``src/bt_native.cpp`` into a shared library on first use (cached under
+``_build/`` keyed by a source hash, so each source change recompiles exactly
+once) and exposes :class:`NativeBPEEncoder`, the fused
+pretokenize-and-BPE-encode hot path used by
+:class:`~bpe_transformer_tpu.tokenization.BPETokenizer`.
+
+The native path is strictly an accelerator: construction falls back to the
+pure-Python encoder whenever a toolchain is unavailable (``is_available()``),
+and parity between both paths is pinned by ``tests/test_native.py``.
+
+Set ``BT_NATIVE=0`` to disable the native path globally.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_SRC_DIR = Path(__file__).parent / "src"
+_BUILD_DIR = Path(__file__).parent / "_build"
+_SOURCES = [_SRC_DIR / "bt_native.cpp", _SRC_DIR / "unicode_classes.inc"]
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed: str | None = None
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for src in _SOURCES:
+        h.update(src.read_bytes())
+    return h.hexdigest()[:16]
+
+
+def _compile() -> Path:
+    out = _BUILD_DIR / f"libbt_native-{_source_hash()}.so"
+    if out.exists():
+        return out
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(f".tmp{os.getpid()}.so")
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fvisibility=hidden",
+        str(_SOURCES[0]), "-o", str(tmp),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    os.replace(tmp, out)  # atomic under concurrent builders
+    return out
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed is not None:
+            return _lib
+        if os.environ.get("BT_NATIVE", "1") == "0":
+            _load_failed = "disabled via BT_NATIVE=0"
+            return None
+        try:
+            lib = ctypes.CDLL(str(_compile()))
+        except (OSError, subprocess.SubprocessError, FileNotFoundError) as exc:
+            _load_failed = f"native build unavailable: {exc!r}"
+            return None
+
+        lib.bt_engine_new.restype = ctypes.c_void_p
+        lib.bt_engine_new.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.bt_engine_free.restype = None
+        lib.bt_engine_free.argtypes = [ctypes.c_void_p]
+        lib.bt_encode.restype = ctypes.c_int64
+        lib.bt_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
+        lib.bt_pretokenize.restype = ctypes.c_int64
+        lib.bt_pretokenize.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def is_available() -> bool:
+    """True when the native engine compiled and loaded on this host."""
+    return _load() is not None
+
+
+def unavailable_reason() -> str | None:
+    _load()
+    return _load_failed
+
+
+def pretokenize_offsets(text: str) -> list[tuple[int, int]]:
+    """(start, end) byte offsets of GPT-2 pre-tokens (scanner parity hook)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(_load_failed or "native engine unavailable")
+    data = text.encode("utf-8")
+    cap = max(len(data), 1)
+    out = (ctypes.c_int64 * (2 * cap))()
+    n = lib.bt_pretokenize(data, len(data), out, cap)
+    if n < 0:  # cannot happen: a pre-token is at least one byte
+        raise RuntimeError("pretokenize capacity underflow")
+    return [(out[2 * i], out[2 * i + 1]) for i in range(n)]
+
+
+class NativeBPEEncoder:
+    """Fused pretokenize+encode over a compiled merge table.
+
+    Constructed from the same ``(byte_id, pair_rank)`` tables the Python
+    encoder compiles, so both paths share one source of truth for greedy
+    merge order.
+    """
+
+    def __init__(
+        self,
+        byte_id: list[int | None],
+        pair_rank: dict[tuple[int, int], tuple[int, int]],
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(_load_failed or "native engine unavailable")
+        self._lib = lib
+
+        byte_arr = (ctypes.c_int32 * 256)(
+            *[(-1 if i is None else i) for i in byte_id]
+        )
+        n = len(pair_rank)
+        lefts = (ctypes.c_int32 * n)()
+        rights = (ctypes.c_int32 * n)()
+        ranks = (ctypes.c_int32 * n)()
+        merged = (ctypes.c_int32 * n)()
+        for idx, ((left, right), (rank, merged_id)) in enumerate(pair_rank.items()):
+            lefts[idx] = left
+            rights[idx] = right
+            ranks[idx] = rank
+            merged[idx] = merged_id
+        self._handle = lib.bt_engine_new(byte_arr, n, lefts, rights, ranks, merged)
+        if not self._handle:
+            raise RuntimeError("bt_engine_new returned NULL")
+
+    def encode_part(self, part: str) -> list[int]:
+        """Token ids of a specials-free text part."""
+        return self.encode_part_array(part).tolist()
+
+    def encode_part_array(self, part: str) -> "np.ndarray":
+        """Token ids of a specials-free text part as an int32 array."""
+        import numpy as np
+
+        data = part.encode("utf-8")
+        if not data:
+            return np.empty(0, dtype=np.int32)
+        cap = len(data)
+        out = np.empty(cap, dtype=np.int32)
+        n = self._lib.bt_encode(
+            self._handle, data, len(data),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap,
+        )
+        if n < 0:  # cannot happen: ids never outnumber input bytes
+            raise RuntimeError("encode capacity underflow")
+        return out[:n]
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.bt_engine_free(handle)
+            self._handle = None

@@ -1,0 +1,328 @@
+// Native tokenization engine: GPT-2 pre-tokenization + BPE encode hot loops.
+//
+// TPU-native rebuild rationale: the reference's encode path
+// (`/root/reference/bpe_transformer/tokenization/bpe_tokenizer.py:139-290`)
+// is pure Python and is the throughput bottleneck of the host-side
+// tokenization stack (reference baseline: 108.69 s to stream-encode the
+// TinyStories validation split).  Tokenization stays on the host CPU in the
+// TPU design, so the hot loops live here, in C++, behind a C ABI driven from
+// Python via ctypes.
+//
+// The scanner is a hand-rolled implementation of the GPT-2 pre-tokenization
+// regex ('(?:[sdmt]|ll|ve|re)| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|
+// \s+(?!\S)|\s+) over UTF-8, with Unicode class membership taken from range
+// tables generated directly from the Python `regex` module
+// (gen_unicode_tables.py) so both paths classify codepoints identically.
+//
+// The BPE loop applies the lowest-rank adjacent merge (earliest position on
+// ties) per pre-token — the same greedy order as the Python path's compiled
+// rank table, which itself reproduces the reference's
+// lowest-merge-priority-first semantics.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct CpRange {
+  uint32_t lo;
+  uint32_t hi;
+};
+
+#include "unicode_classes.inc"
+
+inline bool in_ranges(uint32_t cp, const CpRange* ranges, int n) {
+  int lo = 0, hi = n - 1;
+  while (lo <= hi) {
+    int mid = (lo + hi) >> 1;
+    if (cp < ranges[mid].lo) {
+      hi = mid - 1;
+    } else if (cp > ranges[mid].hi) {
+      lo = mid + 1;
+    } else {
+      return true;
+    }
+  }
+  return false;
+}
+
+enum CharClass : uint8_t { CC_OTHER = 0, CC_LETTER = 1, CC_NUMBER = 2, CC_SPACE = 3 };
+
+// Direct-lookup table for the first 0x300 codepoints (covers ASCII +
+// Latin-1/Latin-Extended, i.e. nearly all real text); binary search beyond.
+struct AsciiTable {
+  uint8_t cls[0x300];
+  AsciiTable() {
+    for (uint32_t cp = 0; cp < 0x300; ++cp) {
+      if (in_ranges(cp, kSpaceRanges, kSpaceRanges_len)) {
+        cls[cp] = CC_SPACE;
+      } else if (in_ranges(cp, kLetterRanges, kLetterRanges_len)) {
+        cls[cp] = CC_LETTER;
+      } else if (in_ranges(cp, kNumberRanges, kNumberRanges_len)) {
+        cls[cp] = CC_NUMBER;
+      } else {
+        cls[cp] = CC_OTHER;
+      }
+    }
+  }
+};
+const AsciiTable kTable;
+
+inline CharClass classify(uint32_t cp) {
+  if (cp < 0x300) return static_cast<CharClass>(kTable.cls[cp]);
+  if (in_ranges(cp, kLetterRanges, kLetterRanges_len)) return CC_LETTER;
+  if (in_ranges(cp, kNumberRanges, kNumberRanges_len)) return CC_NUMBER;
+  if (in_ranges(cp, kSpaceRanges, kSpaceRanges_len)) return CC_SPACE;
+  return CC_OTHER;
+}
+
+// Decode one UTF-8 codepoint at p (p < end guaranteed).  Input comes from
+// Python str.encode("utf-8") and is always valid; malformed bytes are
+// defensively treated as single-byte CC_OTHER codepoints.
+inline uint32_t decode_utf8(const uint8_t* p, const uint8_t* end, int* len) {
+  uint8_t b0 = p[0];
+  if (b0 < 0x80) {
+    *len = 1;
+    return b0;
+  }
+  if ((b0 & 0xE0) == 0xC0 && p + 1 < end) {
+    *len = 2;
+    return ((b0 & 0x1Fu) << 6) | (p[1] & 0x3Fu);
+  }
+  if ((b0 & 0xF0) == 0xE0 && p + 2 < end) {
+    *len = 3;
+    return ((b0 & 0x0Fu) << 12) | ((p[1] & 0x3Fu) << 6) | (p[2] & 0x3Fu);
+  }
+  if ((b0 & 0xF8) == 0xF0 && p + 3 < end) {
+    *len = 4;
+    return ((b0 & 0x07u) << 18) | ((p[1] & 0x3Fu) << 12) | ((p[2] & 0x3Fu) << 6) |
+           (p[3] & 0x3Fu);
+  }
+  *len = 1;
+  return 0xFFFFFFFFu;  // classify() returns CC_OTHER
+}
+
+inline CharClass class_at(const uint8_t* p, const uint8_t* end, int* len) {
+  uint32_t cp = decode_utf8(p, end, len);
+  return cp == 0xFFFFFFFFu ? CC_OTHER : classify(cp);
+}
+
+// Consume a maximal run of codepoints of class `want` starting at p.
+inline const uint8_t* consume_class(const uint8_t* p, const uint8_t* end,
+                                    CharClass want) {
+  while (p < end) {
+    int len;
+    if (class_at(p, end, &len) != want) break;
+    p += len;
+  }
+  return p;
+}
+
+// One GPT-2 pre-token starting at byte offset `i`; returns its end offset.
+// Implements the regex alternation in order, with the alternatives' greedy /
+// backtracking semantics resolved statically (see scanner notes above).
+size_t next_pretoken_end(const uint8_t* s, size_t n, size_t i) {
+  const uint8_t* end = s + n;
+
+  // Alt 1: '(?:[sdmt]|ll|ve|re)  — lowercase ASCII only, class before pairs.
+  if (s[i] == '\'') {
+    if (i + 1 < n) {
+      uint8_t c = s[i + 1];
+      if (c == 's' || c == 'd' || c == 'm' || c == 't') return i + 2;
+      if (i + 2 < n) {
+        uint8_t c2 = s[i + 2];
+        if ((c == 'l' && c2 == 'l') || (c == 'v' && c2 == 'e') ||
+            (c == 'r' && c2 == 'e'))
+          return i + 3;
+      }
+    }
+  }
+
+  // Alts 2-4: " ?" + a maximal run of letters / numbers / other.  The
+  // optional-space branch only survives regex backtracking when a run of the
+  // right class actually follows the space.
+  size_t j = i;
+  if (s[i] == ' ') j = i + 1;
+  if (j < n) {
+    int len;
+    CharClass cc = class_at(s + j, end, &len);
+    if (cc != CC_SPACE) {
+      const uint8_t* run_end = consume_class(s + j + len, end, cc);
+      return static_cast<size_t>(run_end - s);
+    }
+  }
+
+  // Alts 5-6: whitespace.  \s+(?!\S) keeps the full run at end-of-input,
+  // otherwise leaves the final whitespace codepoint for the next token; a
+  // single whitespace codepoint followed by non-space falls through to \s+.
+  size_t k = i;
+  size_t last_ws_start = i;
+  int n_ws = 0;
+  while (k < n) {
+    int len;
+    if (class_at(s + k, end, &len) != CC_SPACE) break;
+    last_ws_start = k;
+    k += len;
+    ++n_ws;
+  }
+  if (n_ws == 0) {
+    // Defensive: cannot happen (every class falls in an alternative above).
+    return i + 1;
+  }
+  if (k == n) return k;          // \s+(?!\S): run extends to end of input
+  if (n_ws >= 2) return last_ws_start;  // leave last ws codepoint
+  return k;                       // \s+ on a single whitespace codepoint
+}
+
+// ------------------------------------------------------------------ BPE
+
+// Open-addressing hash map: (left_id, right_id) -> (rank, merged_id).
+struct PairMap {
+  std::vector<uint64_t> keys;
+  std::vector<uint64_t> vals;  // rank << 32 | merged_id
+  uint64_t mask = 0;
+
+  static constexpr uint64_t kEmpty = ~0ull;
+
+  void build(int64_t n, const int32_t* lefts, const int32_t* rights,
+             const int32_t* ranks, const int32_t* merged) {
+    size_t cap = 16;
+    while (cap < static_cast<size_t>(n) * 2) cap <<= 1;
+    keys.assign(cap, kEmpty);
+    vals.assign(cap, 0);
+    mask = cap - 1;
+    for (int64_t idx = 0; idx < n; ++idx) {
+      uint64_t key = (static_cast<uint64_t>(static_cast<uint32_t>(lefts[idx])) << 32) |
+                     static_cast<uint32_t>(rights[idx]);
+      uint64_t slot = hash(key) & mask;
+      while (keys[slot] != kEmpty) {
+        if (keys[slot] == key) goto next;  // first (lowest-rank) entry wins
+        slot = (slot + 1) & mask;
+      }
+      keys[slot] = key;
+      vals[slot] = (static_cast<uint64_t>(static_cast<uint32_t>(ranks[idx])) << 32) |
+                   static_cast<uint32_t>(merged[idx]);
+    next:;
+    }
+  }
+
+  static inline uint64_t hash(uint64_t x) {
+    x ^= x >> 33;
+    x *= 0xff51afd7ed558ccdull;
+    x ^= x >> 33;
+    return x;
+  }
+
+  // Returns rank<<32|merged, or kEmpty when absent.
+  inline uint64_t find(int32_t l, int32_t r) const {
+    uint64_t key = (static_cast<uint64_t>(static_cast<uint32_t>(l)) << 32) |
+                   static_cast<uint32_t>(r);
+    uint64_t slot = hash(key) & mask;
+    while (true) {
+      uint64_t k = keys[slot];
+      if (k == key) return vals[slot];
+      if (k == kEmpty) return kEmpty;
+      slot = (slot + 1) & mask;
+    }
+  }
+};
+
+struct Engine {
+  int32_t byte_ids[256];
+  PairMap pairs;
+};
+
+// Merge `len` ids in place; returns the merged length.  Applies the
+// lowest-rank adjacent pair first, earliest position breaking ties —
+// identical greedy order to BPETokenizer._encode_pretoken.
+inline int merge_ids(const Engine* e, int32_t* ids, int len) {
+  while (len > 1) {
+    uint64_t best = PairMap::kEmpty;
+    int best_pos = -1;
+    for (int i = 0; i < len - 1; ++i) {
+      uint64_t hit = e->pairs.find(ids[i], ids[i + 1]);
+      if (hit < best) {
+        best = hit;
+        best_pos = i;
+      }
+    }
+    if (best_pos < 0) break;
+    ids[best_pos] = static_cast<int32_t>(best & 0xFFFFFFFFu);
+    std::memmove(ids + best_pos + 1, ids + best_pos + 2,
+                 static_cast<size_t>(len - best_pos - 2) * sizeof(int32_t));
+    --len;
+  }
+  return len;
+}
+
+}  // namespace
+
+extern "C" {
+
+#define BT_EXPORT __attribute__((visibility("default")))
+
+BT_EXPORT Engine* bt_engine_new(const int32_t* byte_ids, int64_t n_merges,
+                      const int32_t* lefts, const int32_t* rights,
+                      const int32_t* ranks, const int32_t* merged) {
+  Engine* e = new Engine();
+  std::memcpy(e->byte_ids, byte_ids, 256 * sizeof(int32_t));
+  e->pairs.build(n_merges, lefts, rights, ranks, merged);
+  return e;
+}
+
+BT_EXPORT void bt_engine_free(Engine* e) { delete e; }
+
+// Pre-tokenize only: writes (start, end) byte-offset pairs.  Returns the
+// number of pre-tokens, or -(required_pairs) when out_cap is too small.
+BT_EXPORT int64_t bt_pretokenize(const uint8_t* text, int64_t n, int64_t* out_offsets,
+                       int64_t out_cap) {
+  int64_t count = 0;
+  size_t i = 0;
+  size_t len = static_cast<size_t>(n);
+  while (i < len) {
+    size_t end = next_pretoken_end(text, len, i);
+    if (count < out_cap) {
+      out_offsets[2 * count] = static_cast<int64_t>(i);
+      out_offsets[2 * count + 1] = static_cast<int64_t>(end);
+    }
+    ++count;
+    i = end;
+  }
+  return count <= out_cap ? count : -count;
+}
+
+// Fused pre-tokenize + BPE encode of a specials-free UTF-8 part.  Writes
+// token ids to `out` (capacity `out_cap`; n input bytes always suffice).
+// Returns the number of ids, or -(required) when out_cap is too small.
+BT_EXPORT int64_t bt_encode(const Engine* e, const uint8_t* text, int64_t n, int32_t* out,
+                  int64_t out_cap) {
+  int64_t n_out = 0;
+  size_t i = 0;
+  size_t len = static_cast<size_t>(n);
+  std::vector<int32_t> big;  // spill for pathological pre-tokens
+  int32_t buf[256];
+  while (i < len) {
+    size_t end = next_pretoken_end(text, len, i);
+    size_t n_bytes = end - i;
+    int32_t* ids = buf;
+    if (n_bytes > 256) {
+      big.resize(n_bytes);
+      ids = big.data();
+    }
+    int m = 0;
+    for (size_t b = i; b < end; ++b) {
+      int32_t id = e->byte_ids[text[b]];
+      if (id >= 0) ids[m++] = id;  // bytes absent from the vocab are skipped
+    }
+    m = merge_ids(e, ids, m);
+    if (n_out + m <= out_cap) {
+      std::memcpy(out + n_out, ids, static_cast<size_t>(m) * sizeof(int32_t));
+    }
+    n_out += m;
+    i = end;
+  }
+  return n_out <= out_cap ? n_out : -n_out;
+}
+
+}  // extern "C"

@@ -35,6 +35,20 @@ from bpe_transformer_tpu.tokenization.pretokenization import (
 
 _REPLACEMENT = "�".encode(ENCODING)
 
+# Per-process tokenizer for Pool workers: the parent pickles the tokenizer
+# ONCE per worker (initializer) instead of once per task, so each worker
+# compiles its merge tables / native engine a single time and reuses them.
+_WORKER_TOKENIZER: "BPETokenizer | None" = None
+
+
+def _stream_worker_init(tokenizer: "BPETokenizer") -> None:
+    global _WORKER_TOKENIZER
+    _WORKER_TOKENIZER = tokenizer
+
+
+def _stream_worker_encode(segment: str) -> list[int]:
+    return _WORKER_TOKENIZER.encode(segment)
+
 
 class Tokenizer(ABC):
     """Minimal tokenizer interface (mirrors the reference ABC,
@@ -102,6 +116,34 @@ class BPETokenizer(Tokenizer):
         self._byte_id = [self._id_of.get(bytes([b])) for b in range(256)]
         self._cache: dict[bytes, tuple[int, ...]] = {}
 
+        # Native (C++) fused pretokenize+encode hot path; falls back to the
+        # Python encoder when no toolchain is available.  Built lazily so
+        # pickling to Pool workers stays cheap (see __getstate__).
+        self._native = None
+        self._native_tried = False
+
+    # ------------------------------------------------------------- native
+
+    def _native_encoder(self):
+        """The C++ engine for this vocab/merge table, or None."""
+        if not self._native_tried:
+            self._native_tried = True
+            try:
+                from bpe_transformer_tpu.native import NativeBPEEncoder, is_available
+
+                if is_available():
+                    self._native = NativeBPEEncoder(self._byte_id, self._pair_rank)
+            except Exception:
+                self._native = None
+        return self._native
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_native"] = None
+        state["_native_tried"] = False
+        state["_cache"] = {}
+        return state
+
     # ---------------------------------------------------------------- props
 
     @property
@@ -161,6 +203,7 @@ class BPETokenizer(Tokenizer):
     def encode(self, text: str) -> list[int]:
         """Encode ``text`` into token ids (specials map directly)."""
         out: list[int] = []
+        native = self._native_encoder()
         parts = split_on_special_tokens(text, self._special_tokens, training=False)
         for part in parts:
             if not part:
@@ -168,6 +211,9 @@ class BPETokenizer(Tokenizer):
             special_id = self._special_ids.get(part)
             if special_id is not None:
                 out.append(special_id)
+                continue
+            if native is not None:
+                out.extend(native.encode_part(part))
                 continue
             for pretoken in iter_pretoken_strings(part):
                 out.extend(self._encode_pretoken(pretoken.encode(ENCODING)))
@@ -179,7 +225,9 @@ class BPETokenizer(Tokenizer):
             return cached
 
         byte_id = self._byte_id
-        ids = [byte_id[b] for b in pretoken]
+        # Bytes absent from the vocab are skipped (same policy as the native
+        # engine, so both paths emit identical streams on any vocab).
+        ids = [i for b in pretoken if (i := byte_id[b]) is not None]
         rank_of = self._pair_rank
         while len(ids) > 1:
             # Lowest-rank adjacent pair wins; earliest position breaks ties.
@@ -200,6 +248,30 @@ class BPETokenizer(Tokenizer):
             self._cache.clear()
         self._cache[pretoken] = result
         return result
+
+    def encode_array(self, text: str):
+        """Encode ``text`` to an int32 numpy array.
+
+        Bulk-pipeline fast path (corpus -> memmap tokenization): with the
+        native engine the ids never materialize as Python objects.
+        """
+        import numpy as np
+
+        native = self._native_encoder()
+        if native is None:
+            return np.asarray(self.encode(text), dtype=np.int32)
+        chunks = []
+        for part in split_on_special_tokens(text, self._special_tokens, training=False):
+            if not part:
+                continue
+            special_id = self._special_ids.get(part)
+            if special_id is not None:
+                chunks.append(np.asarray([special_id], dtype=np.int32))
+            else:
+                chunks.append(native.encode_part_array(part))
+        if not chunks:
+            return np.empty(0, dtype=np.int32)
+        return np.concatenate(chunks)
 
     # ------------------------------------------------------------- decode
 
@@ -225,36 +297,58 @@ class BPETokenizer(Tokenizer):
         else:
             yield from self._encode_stream_parallel(iterable, n_workers)
 
-    def _encode_stream_serial(self, iterable: Iterable[str]) -> Iterator[int]:
+    @staticmethod
+    def _iter_segments(iterable: Iterable[str]) -> Iterator[str]:
+        """Newline-bounded segments of a string stream.
+
+        The single segmentation policy shared by every streaming encode path
+        (serial, parallel, array) so they all emit identical token streams:
+        buffer each incoming chunk and flush up to the last newline.
+        """
         pending = ""
         for chunk in iterable:
             pending += chunk
             cut = pending.rfind("\n")
             if cut != -1:
-                yield from self.encode(pending[: cut + 1])
+                yield pending[: cut + 1]
                 pending = pending[cut + 1 :]
         if pending:
-            yield from self.encode(pending)
+            yield pending
+
+    def _encode_stream_serial(self, iterable: Iterable[str]) -> Iterator[int]:
+        for segment in self._iter_segments(iterable):
+            yield from self.encode(segment)
+
+    def encode_iterable_arrays(self, iterable: Iterable[str]) -> Iterator["object"]:
+        """Lazily encode a string stream, yielding one int32 array per
+        newline-bounded segment.
+
+        Same segmentation (and therefore the same token stream) as
+        :meth:`encode_iterable`; with the native engine the ids never
+        materialize as Python objects.  Bulk-pipeline fast path.
+        """
+        for segment in self._iter_segments(iterable):
+            yield self.encode_array(segment)
 
     def _encode_stream_parallel(
         self, iterable: Iterable[str], n_workers: int
     ) -> Iterator[int]:
         batch: list[str] = []
         batch_size = n_workers * 10
-        pending = ""
-        with Pool(processes=n_workers) as pool:
-            for chunk in iterable:
-                pending += chunk
-                cut = pending.rfind("\n")
-                if cut != -1:
-                    batch.append(pending[: cut + 1])
-                    pending = pending[cut + 1 :]
-                    if len(batch) >= batch_size:
-                        for encoded in pool.map(self.encode, batch, chunksize=5):
-                            yield from encoded
-                        batch = []
+        # Build (and disk-cache) the native engine once before forking so
+        # workers load the cached .so instead of racing N concurrent builds.
+        self._native_encoder()
+        with Pool(
+            processes=n_workers,
+            initializer=_stream_worker_init,
+            initargs=(self,),
+        ) as pool:
+            for segment in self._iter_segments(iterable):
+                batch.append(segment)
+                if len(batch) >= batch_size:
+                    for encoded in pool.map(_stream_worker_encode, batch, chunksize=5):
+                        yield from encoded
+                    batch = []
             if batch:
-                for encoded in pool.map(self.encode, batch, chunksize=5):
+                for encoded in pool.map(_stream_worker_encode, batch, chunksize=5):
                     yield from encoded
-        if pending:
-            yield from self.encode(pending)

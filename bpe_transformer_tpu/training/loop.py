@@ -99,6 +99,9 @@ def train(
 
     start_iteration = 0
     if resume_from is not None:
+        resume_from = Path(resume_from)
+        if resume_from.is_dir():  # checkpoint dir -> most recent snapshot
+            resume_from = resume_from / "latest.ckpt"
         payload = load_checkpoint(resume_from)
         params = payload["params"]
         opt_state = (

@@ -34,11 +34,8 @@ class MetricsLogger:
         log_fn=print,
     ):
         self._log_fn = log_fn if stdout else None
-        self._jsonl: IO[str] | None = None
-        if jsonl_path is not None:
-            path = Path(jsonl_path)
-            path.parent.mkdir(parents=True, exist_ok=True)
-            self._jsonl = open(path, "a")
+        # Validate / init the wandb sink before opening the JSONL file so a
+        # missing wandb package doesn't leak an open handle or stray file.
         self._wandb = None
         if wandb_project is not None:
             try:
@@ -49,6 +46,11 @@ class MetricsLogger:
                     "installed; install it or drop the flag"
                 ) from e
             self._wandb = wandb.init(project=wandb_project, config=wandb_config)
+        self._jsonl: IO[str] | None = None
+        if jsonl_path is not None:
+            path = Path(jsonl_path)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            self._jsonl = open(path, "a")
 
     def log(self, record: dict) -> None:
         if self._log_fn is not None:

@@ -1,0 +1,176 @@
+"""Native (C++) tokenization engine: parity with the pure-Python path.
+
+The native engine re-implements the GPT-2 pre-tokenization regex as a
+hand-rolled UTF-8 scanner and the BPE greedy merge loop in C++
+(`bpe_transformer_tpu/native/src/bt_native.cpp`).  Both must be
+behaviorally identical to the Python implementations, which are themselves
+pinned against tiktoken and the reference
+(`/root/reference/tests/test_tokenizer.py:88-413`).
+"""
+
+from __future__ import annotations
+
+import pickle
+import random
+
+import pytest
+import regex
+
+from bpe_transformer_tpu.native import engine as native_engine
+from bpe_transformer_tpu.settings import GPT2_SPLIT_PATTERN
+from bpe_transformer_tpu.tokenization import BPETokenizer
+
+pytestmark = pytest.mark.skipif(
+    not native_engine.is_available(),
+    reason=f"native engine unavailable: {native_engine.unavailable_reason()}",
+)
+
+_GPT2_RE = regex.compile(GPT2_SPLIT_PATTERN)
+
+SCANNER_CASES = [
+    "Hello world!  This is a test.\n",
+    "don't stop 'll 've 're 'sx 'S 'D",
+    "  multiple   spaces\t\ttabs\n\nnewlines  ",
+    "numbers 123 mixed1a2b ¡unicode! café über 東京タワー ١٢٣",
+    "trailing spaces   ",
+    " ",
+    "",
+    "a",
+    "'",
+    "'' ''",
+    "\n",
+    "\n\na",
+    " \n a",
+    "🙂 emoji🙂🙂 test",
+    " nbsp emsp　ideographic",
+]
+
+
+def _scan_native(text: str) -> list[str]:
+    data = text.encode("utf-8")
+    return [
+        data[s:e].decode("utf-8")
+        for s, e in native_engine.pretokenize_offsets(text)
+    ]
+
+
+@pytest.mark.parametrize("text", SCANNER_CASES)
+def test_scanner_matches_regex(text):
+    assert _scan_native(text) == [m.group() for m in _GPT2_RE.finditer(text)]
+
+
+def test_scanner_fuzz_matches_regex():
+    rng = random.Random(0)
+    pool = "abc ABZ 0159 ,.!?'\"\t\n  é東🙂́א\r\x1c  "
+    for _ in range(500):
+        text = "".join(rng.choice(pool) for _ in range(rng.randint(0, 80)))
+        assert _scan_native(text) == [m.group() for m in _GPT2_RE.finditer(text)]
+
+
+@pytest.fixture(scope="module")
+def toy_pair():
+    """(native-enabled, python-forced) tokenizers over a small trained vocab."""
+    from bpe_transformer_tpu.tokenization import BPETrainer
+    import tempfile, os
+
+    corpus = (
+        "the quick brown fox jumps over the lazy dog. "
+        "don't stop believing 123 числа café\n"
+    ) * 50 + "<|endoftext|>\n"
+    with tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False) as f:
+        f.write(corpus)
+        path = f.name
+    try:
+        trainer = BPETrainer(vocab_size=400, special_tokens=["<|endoftext|>"])
+        trainer.train(path, n_workers=1)
+        vocab, merges = trainer.vocab, trainer.merges
+    finally:
+        os.unlink(path)
+
+    tok_native = BPETokenizer(dict(vocab), list(merges), ["<|endoftext|>"])
+    tok_python = BPETokenizer(dict(vocab), list(merges), ["<|endoftext|>"])
+    tok_python._native_tried = True  # force the pure-Python path
+    assert tok_native._native_encoder() is not None
+    return tok_native, tok_python
+
+
+ENCODE_CASES = [
+    "the quick brown fox",
+    "don't stop",
+    "hello<|endoftext|>world",
+    "<|endoftext|><|endoftext|>",
+    "unseen bytes: ß∂ƒ 東京 🙂",
+    "  spaces   and\t\ttabs\n\n",
+    "",
+]
+
+
+@pytest.mark.parametrize("text", ENCODE_CASES)
+def test_encode_parity(toy_pair, text):
+    tok_native, tok_python = toy_pair
+    assert tok_native.encode(text) == tok_python.encode(text)
+
+
+def test_encode_fuzz_parity(toy_pair):
+    tok_native, tok_python = toy_pair
+    rng = random.Random(1)
+    pool = "the quick brown fox don't 0123 .,!? \n\t é東🙂 <|endoftext|>"
+    for _ in range(200):
+        text = "".join(rng.choice(pool) for _ in range(rng.randint(0, 120)))
+        assert tok_native.encode(text) == tok_python.encode(text)
+
+
+def test_encode_roundtrip(toy_pair):
+    tok_native, _ = toy_pair
+    text = "the lazy dog don't care about 123 café <|endoftext|> tail"
+    assert tok_native.decode(tok_native.encode(text)) == text
+
+
+def test_encode_array_matches_encode(toy_pair):
+    tok_native, _ = toy_pair
+    text = "the quick brown fox <|endoftext|> don't stop 123\n"
+    assert tok_native.encode_array(text).tolist() == tok_native.encode(text)
+
+
+def test_gpt2_fixture_parity(reference_fixtures):
+    """Native path reproduces GPT-2 ids on the reference sample corpus."""
+    from bpe_transformer_tpu.tokenization.gpt2 import (
+        load_gpt2_merges,
+        load_gpt2_vocab,
+    )
+
+    vocab = load_gpt2_vocab(reference_fixtures / "gpt2_vocab.json")
+    merges = load_gpt2_merges(reference_fixtures / "gpt2_merges.txt")
+    tok_native = BPETokenizer(dict(vocab), list(merges), ["<|endoftext|>"])
+    tok_python = BPETokenizer(dict(vocab), list(merges), ["<|endoftext|>"])
+    tok_python._native_tried = True
+    sample = reference_fixtures / "tinystories_sample.txt"
+    text = sample.read_text(encoding="utf-8")
+    assert tok_native._native_encoder() is not None
+    assert tok_native.encode(text) == tok_python.encode(text)
+
+
+def test_memmap_fast_path_matches_stream_on_indented_text(toy_pair, tmp_path):
+    """The array fast path must emit the same token stream as
+    encode_iterable even when whitespace runs span newlines (indented
+    lines), i.e. hosts with and without a C++ toolchain produce identical
+    .bin files."""
+    from bpe_transformer_tpu.data import tokenize_to_memmap
+
+    tok_native, tok_python = toy_pair
+    src = tmp_path / "corpus.txt"
+    src.write_text("foo\n  bar\n\tbaz  \n   \n the quick qux" * 40)
+    mm = tokenize_to_memmap(tok_native, src, tmp_path / "tokens.bin", dtype="uint32")
+    with open(src, encoding="utf-8") as f:
+        stream = list(tok_python.encode_iterable(f))
+    assert mm.tolist() == stream
+
+
+def test_pickled_tokenizer_rebuilds_native(toy_pair):
+    """Pool workers receive a pickled tokenizer; the native handle must not
+    travel through pickle but must rebuild lazily on the other side."""
+    tok_native, _ = toy_pair
+    clone = pickle.loads(pickle.dumps(tok_native))
+    assert clone._native is None and clone._native_tried is False
+    text = "the quick brown fox don't"
+    assert clone.encode(text) == tok_native.encode(text)
