@@ -160,7 +160,38 @@ def bench_torch_cpu() -> float:
     return TORCH_MEASURE_STEPS * BATCH * s / elapsed
 
 
+def _ensure_jax_backend(probe_timeout_s: int = 300) -> None:
+    """Fail over to the CPU backend when the accelerator is unreachable.
+
+    The accelerator plugin registered at interpreter boot can fail to
+    initialize (relay/tunnel outages) — sometimes by hanging rather than
+    raising — and a benchmark that crashes or stalls reports nothing.  Probe
+    backend init in a SUBPROCESS with a timeout; on failure, force the CPU
+    platform in this process before any backend initializes here.  The
+    JSON's device field records what actually ran.
+    """
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True,
+            timeout=probe_timeout_s,
+        )
+        ok = probe.returncode == 0
+        reason = (probe.stderr or b"").decode(errors="replace")[-300:]
+    except subprocess.TimeoutExpired:
+        ok = False
+        reason = f"backend init exceeded {probe_timeout_s}s"
+    if not ok:
+        print(f"accelerator backend unavailable ({reason}); CPU fallback", file=sys.stderr)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> int:
+    _ensure_jax_backend()
     tokens_per_sec, info = bench_jax()
     try:
         baseline = bench_torch_cpu()

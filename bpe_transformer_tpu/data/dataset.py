@@ -32,6 +32,12 @@ def tokenize_to_memmap(
     out_path = Path(out_path)
     out_path.parent.mkdir(parents=True, exist_ok=True)
     dt = np.dtype(dtype)
+    vocab = getattr(tokenizer, "vocab", None)
+    if vocab and max(vocab) > np.iinfo(dt).max:
+        raise ValueError(
+            f"vocab ids up to {max(vocab)} do not fit dtype {dt.name} "
+            f"(max {np.iinfo(dt).max}); pass dtype='uint32'"
+        )
     encode_arrays = getattr(tokenizer, "encode_iterable_arrays", None)
     with open(text_path, encoding="utf-8") as src, open(out_path, "wb") as dst:
         if encode_arrays is not None:

@@ -27,7 +27,12 @@ class ModelConfig:
     remove_rmsnorm: bool = False
     use_post_norm: bool = False
     remove_rope: bool = False
-    ffn_type: str | None = None  # None -> SwiGLU; "silu"/"gelu" -> 2-matrix FFN
+    # None -> SwiGLU; "silu"/"gelu" -> 2-matrix FFN; "moe" -> routed experts
+    ffn_type: str | None = None
+    # MoE knobs (used when ffn_type == "moe").
+    n_experts: int = 0
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
     # TPU execution knobs (not part of the reference schema).
     activation_dtype: str = "float32"  # "bfloat16" for the perf path
     remat: bool = False  # rematerialize each block on the backward pass
@@ -43,6 +48,11 @@ class ModelConfig:
         if self.d_model % self.num_heads:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by num_heads={self.num_heads}"
+            )
+        if self.ffn_type == "moe" and self.n_experts < 1:
+            raise ValueError(
+                'ffn_type="moe" requires n_experts >= 1 (got '
+                f"{self.n_experts}); set n_experts in the model config"
             )
 
     @classmethod

@@ -1,0 +1,101 @@
+"""Mixture-of-experts FFN (Switch-style top-1 routing) with expert parallelism.
+
+No reference precedent (SURVEY §2.4 lists EP as absent); built TPU-first in
+the GSPMD dense-dispatch formulation: expert weights are stacked on a leading
+``(n_experts, ...)`` dim, routing builds one-hot dispatch/combine tensors,
+and expert compute is a single batched einsum over all experts.  Sharding the
+expert dim over an ``expert`` mesh axis turns the dispatch einsums into
+all-to-alls over ICI — no per-expert Python loops, fully static shapes.
+
+Semantics (Switch Transformer, Fedus et al. 2021 — public):
+
+* each token routes to its argmax expert with gate = softmax prob;
+* per-expert capacity ``ceil(capacity_factor * tokens / n_experts)``;
+  overflow tokens are dropped (their FFN output is zero, the residual
+  connection carries them through);
+* load-balance auxiliary loss ``n_experts * sum_e f_e * P_e`` (f = fraction
+  of tokens dispatched to e, P = mean router probability of e) encourages
+  uniform routing; added to the training loss with ``router_aux_weight``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from bpe_transformer_tpu.models.config import ModelConfig
+from bpe_transformer_tpu.ops.core import silu
+
+
+def init_moe_params(rng: jax.Array, config: ModelConfig, dtype=jnp.float32) -> dict:
+    """Stacked expert weights + router for one MoE FFN layer."""
+    e, d, ff = config.n_experts, config.d_model, config.d_ff
+
+    def dense(key, shape, std=0.02):
+        return (
+            jax.random.truncated_normal(key, -3.0, 3.0, shape, jnp.float32) * std
+        ).astype(dtype)
+
+    k = jax.random.split(rng, 4)
+    return {
+        "router": dense(k[0], (e, d)),
+        "w1": dense(k[1], (e, ff, d)),
+        "w2": dense(k[2], (e, d, ff)),
+        "w3": dense(k[3], (e, ff, d)),
+    }
+
+
+def expert_capacity(n_tokens: int, n_experts: int, capacity_factor: float) -> int:
+    return max(1, math.ceil(capacity_factor * n_tokens / n_experts))
+
+
+def switch_ffn(
+    x: Array, moe_params: dict, config: ModelConfig
+) -> tuple[Array, Array]:
+    """Top-1 routed SwiGLU experts.  Returns ``(output, aux_loss)``.
+
+    ``x``: (..., d_model); routing flattens all leading dims into one token
+    axis (static shape under jit).
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    n = math.prod(orig_shape[:-1])
+    tokens = x.reshape(n, d)
+    e = config.n_experts
+    cap = expert_capacity(n, e, config.capacity_factor)
+
+    # Router in float32 for stable softmax/argmax.
+    logits = jnp.einsum(
+        "nd,ed->ne", tokens.astype(jnp.float32), moe_params["router"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # (n, e)
+    expert_idx = jnp.argmax(probs, axis=-1)  # (n,)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]  # (n,)
+
+    assign = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (n, e)
+    # Position of each token within its expert's queue (order = token order).
+    pos = jnp.cumsum(assign, axis=0) * assign - assign  # (n, e): 0-based, 0 elsewhere
+    keep = assign * (pos < cap)  # drop overflow tokens
+    dispatch = keep[:, :, None] * jax.nn.one_hot(
+        pos.astype(jnp.int32), cap, dtype=jnp.float32
+    )  # (n, e, cap)
+    combine = gate[:, None, None] * dispatch  # (n, e, cap)
+
+    # Dispatch -> expert SwiGLU -> combine, all batched over the expert dim.
+    compute_dtype = tokens.dtype
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(compute_dtype), tokens)
+    up = jnp.einsum("ecd,efd->ecf", expert_in, moe_params["w1"])
+    lin = jnp.einsum("ecd,efd->ecf", expert_in, moe_params["w3"])
+    h = silu(up) * lin
+    expert_out = jnp.einsum("ecf,edf->ecd", h, moe_params["w2"])
+    out = jnp.einsum("nec,ecd->nd", combine.astype(compute_dtype), expert_out)
+
+    # Load-balance loss over the *pre-capacity* assignments.
+    frac_tokens = jnp.mean(assign, axis=0)  # (e,)
+    frac_probs = jnp.mean(probs, axis=0)  # (e,)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+
+    return out.reshape(orig_shape), aux

@@ -53,6 +53,16 @@ def init_params(
     layers = []
     for i in range(config.num_layers):
         k = jax.random.split(keys[2 + i], 7)
+        if config.ffn_type == "moe":
+            from bpe_transformer_tpu.models.moe import init_moe_params
+
+            ffn_params = init_moe_params(k[4], config, dtype)
+        else:
+            ffn_params = {
+                "w1": dense(k[4], ff, d),
+                "w2": dense(k[5], d, ff),
+                "w3": dense(k[6], ff, d),
+            }
         layers.append(
             {
                 "attn": {
@@ -63,11 +73,7 @@ def init_params(
                 },
                 "ln1": jnp.ones((d,), dtype),
                 "ln2": jnp.ones((d,), dtype),
-                "ffn": {
-                    "w1": dense(k[4], ff, d),
-                    "w2": dense(k[5], d, ff),
-                    "w3": dense(k[6], ff, d),
-                },
+                "ffn": ffn_params,
             }
         )
     return {
@@ -81,15 +87,21 @@ def init_params(
 # ------------------------------------------------------------------ forward
 
 
-def _ffn(x: Array, ffn_params: dict, config: ModelConfig) -> Array:
+def _ffn(x: Array, ffn_params: dict, config: ModelConfig) -> tuple[Array, Array]:
+    """FFN dispatch; returns ``(output, aux_loss)`` (aux is 0 except MoE)."""
+    zero = jnp.zeros((), jnp.float32)
     if config.ffn_type in (None, "swiglu"):
-        return swiglu(x, ffn_params["w1"], ffn_params["w2"], ffn_params["w3"])
+        return swiglu(x, ffn_params["w1"], ffn_params["w2"], ffn_params["w3"]), zero
     if config.ffn_type == "silu":
-        return linear(silu(linear(x, ffn_params["w1"])), ffn_params["w2"])
+        return linear(silu(linear(x, ffn_params["w1"])), ffn_params["w2"]), zero
     if config.ffn_type == "gelu":
         from bpe_transformer_tpu.kernels.pallas.gelu import gelu
 
-        return linear(gelu(linear(x, ffn_params["w1"])), ffn_params["w2"])
+        return linear(gelu(linear(x, ffn_params["w1"])), ffn_params["w2"]), zero
+    if config.ffn_type == "moe":
+        from bpe_transformer_tpu.models.moe import switch_ffn
+
+        return switch_ffn(x, ffn_params, config)
     raise ValueError(f"unknown ffn_type: {config.ffn_type!r}")
 
 
@@ -156,16 +168,17 @@ def _attention(
     )
 
 
-def transformer_block(
+def transformer_block_aux(
     x: Array,
     block_params: dict,
     config: ModelConfig,
     rope_cos_sin: tuple[Array, Array] | None,
     positions: Array,
     attention_fn=None,
-) -> Array:
-    """One block; pre-norm by default, post-norm under the ablation flag.
+) -> tuple[Array, Array]:
+    """One block; returns ``(x, aux_loss)`` (aux nonzero only for MoE FFNs).
 
+    Pre-norm by default, post-norm under the ablation flag.
     ``attention_fn(q, k, v)`` overrides the config-selected attention (used
     by the sequence-parallel path to substitute ring attention).
     """
@@ -178,15 +191,29 @@ def transformer_block(
             block_params["ln1"],
             config,
         )
-        return _maybe_norm(
-            x + _ffn(x, block_params["ffn"], config), block_params["ln2"], config
-        )
+        f, aux = _ffn(x, block_params["ffn"], config)
+        return _maybe_norm(x + f, block_params["ln2"], config), aux
     h = _maybe_norm(x, block_params["ln1"], config)
     x = x + _attention(
         h, block_params["attn"], config, rope_cos_sin, positions, attention_fn
     )
     h = _maybe_norm(x, block_params["ln2"], config)
-    return x + _ffn(h, block_params["ffn"], config)
+    f, aux = _ffn(h, block_params["ffn"], config)
+    return x + f, aux
+
+
+def transformer_block(
+    x: Array,
+    block_params: dict,
+    config: ModelConfig,
+    rope_cos_sin: tuple[Array, Array] | None,
+    positions: Array,
+    attention_fn=None,
+) -> Array:
+    """One block (aux-loss-free view of :func:`transformer_block_aux`)."""
+    return transformer_block_aux(
+        x, block_params, config, rope_cos_sin, positions, attention_fn
+    )[0]
 
 
 def forward(
@@ -195,11 +222,15 @@ def forward(
     config: ModelConfig,
     positions: Array | None = None,
     attention_fn=None,
+    return_aux: bool = False,
 ) -> Array:
     """Logits ``(batch, seq, vocab)`` for ``token_ids (batch, seq)``.
 
     ``seq`` may be anything up to ``config.context_length`` (truncated-input
     behavior pinned by `test_transformer_lm_truncated_input`).
+
+    ``return_aux=True`` additionally returns the summed auxiliary
+    (load-balance) loss of MoE layers: ``(logits, aux)``.
     """
     seq_len = token_ids.shape[-1]
     if seq_len > config.context_length:
@@ -230,18 +261,23 @@ def forward(
         )
         rope_cos_sin = (cos.astype(act_dtype), sin.astype(act_dtype))
 
-    block = transformer_block
+    block = transformer_block_aux
     if config.remat:
         # config and attention_fn are non-array (static) arguments.
         block = jax.checkpoint(
-            transformer_block, static_argnums=(2, 5), policy=None
+            transformer_block_aux, static_argnums=(2, 5), policy=None
         )
+    aux_total = jnp.zeros((), jnp.float32)
     for block_params in compute_params["layers"]:
-        x = block(x, block_params, config, rope_cos_sin, positions, attention_fn)
+        x, aux = block(x, block_params, config, rope_cos_sin, positions, attention_fn)
+        aux_total = aux_total + aux
 
     x = _maybe_norm(x, compute_params["ln_final"], config)
     # LM head always runs in float32 for stable logits/loss.
-    return linear(x.astype(jnp.float32), params["lm_head"].astype(jnp.float32))
+    logits = linear(x.astype(jnp.float32), params["lm_head"].astype(jnp.float32))
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 # ------------------------------------------------- torch state-dict interop

@@ -11,6 +11,13 @@ from bpe_transformer_tpu.parallel.sharding import (
     param_specs,
     shard_params,
 )
+from bpe_transformer_tpu.parallel.pp import (
+    init_pp_opt_state,
+    make_pp_train_step,
+    shard_pp_params,
+    stack_pipeline_params,
+    unstack_pipeline_params,
+)
 from bpe_transformer_tpu.parallel.ring_attention import (
     make_ring_attention,
     ring_self_attention,
@@ -28,6 +35,11 @@ from bpe_transformer_tpu.parallel.train_step import (
 
 __all__ = [
     "batch_sharding",
+    "init_pp_opt_state",
+    "make_pp_train_step",
+    "shard_pp_params",
+    "stack_pipeline_params",
+    "unstack_pipeline_params",
     "make_ring_attention",
     "make_sp_train_step",
     "ring_self_attention",

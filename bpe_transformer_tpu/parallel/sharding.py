@@ -77,22 +77,33 @@ def param_specs(
     *,
     fsdp_axis: str = "data",
     tp_axis: str = "model",
+    ep_axis: str = "expert",
 ):
     """Pytree of ``PartitionSpec`` for ``params`` under a strategy.
 
-    Strategies: ``dp`` | ``fsdp`` | ``tp`` | ``fsdp_tp``.
+    ``strategy`` is an underscore-joined set of tokens from
+    ``{dp, fsdp, tp, ep}`` (e.g. ``"fsdp_tp"``, ``"dp_ep"``).  ``ep`` shards
+    the leading expert dim of MoE leaves (router + stacked expert weights)
+    along ``ep_axis``; the dispatch einsums then lower to all-to-alls.
     """
-    if strategy not in ("dp", "fsdp", "tp", "fsdp_tp"):
+    tokens = set(strategy.split("_")) if strategy else set()
+    unknown = tokens - {"dp", "fsdp", "tp", "ep"}
+    if not tokens or unknown:
         raise ValueError(f"unknown parallel strategy: {strategy!r}")
-    use_tp = "tp" in strategy and tp_axis in mesh.shape
-    use_fsdp = "fsdp" in strategy and fsdp_axis in mesh.shape
+    use_tp = "tp" in tokens and tp_axis in mesh.shape
+    use_fsdp = "fsdp" in tokens and fsdp_axis in mesh.shape
+    use_ep = "ep" in tokens and ep_axis in mesh.shape
     fsdp_size = mesh.shape.get(fsdp_axis, 1)
     tp_size = mesh.shape.get(tp_axis, 1)
+    ep_size = mesh.shape.get(ep_axis, 1)
 
     def rule(path, leaf):
         name = _path_str(path)
         spec: list = [None] * leaf.ndim
-        if use_tp:
+        is_moe = "ffn" in name and ("router" in name or leaf.ndim == 3)
+        if use_ep and is_moe and leaf.shape[0] % ep_size == 0:
+            spec[0] = ep_axis
+        if use_tp and not is_moe:
             spec = _tp_spec(name, leaf.ndim)
             # Drop TP assignments that don't divide evenly.
             spec = [

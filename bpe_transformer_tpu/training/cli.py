@@ -110,6 +110,7 @@ def cmd_train(args) -> int:
         seed=args.seed,
         parallel=args.parallel,
         mesh_axes=mesh_axes,
+        pp_microbatches=args.pp_microbatches,
     )
     train_data = load_token_file(args.data, args.dtype)
     val_data = load_token_file(args.val_data, args.dtype) if args.val_data else None
@@ -216,13 +217,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--parallel",
         default=None,
-        choices=["dp", "sp", "fsdp", "tp", "fsdp_tp"],
+        choices=["dp", "sp", "pp", "fsdp", "tp", "fsdp_tp", "ep", "dp_ep", "fsdp_ep"],
         help="multi-chip strategy (default: single device)",
+    )
+    p.add_argument(
+        "--pp-microbatches",
+        type=int,
+        default=4,
+        help="pipeline microbatches per step (with --parallel pp)",
     )
     p.add_argument(
         "--mesh",
         default=None,
-        help='mesh axes, e.g. "data=8" or "data=4,model=2"',
+        help='mesh axes, e.g. "data=8", "data=4,model=2", "data=2,pp=4"',
     )
     p.add_argument("--seed", type=int, default=0)
     p.set_defaults(fn=cmd_train)

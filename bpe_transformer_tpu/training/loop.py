@@ -46,10 +46,12 @@ class LoopConfig:
     wandb_project: str | None = None
     seed: int = 0
     #: None -> single device; "dp" -> shard_map psum; "sp" -> context
-    #: parallelism (ring attention over a data x seq mesh);
-    #: "fsdp"/"tp"/"fsdp_tp" -> GSPMD with those shardings.
+    #: parallelism (ring attention over a data x seq mesh); "pp" -> GPipe
+    #: pipeline stages over a pp axis; "fsdp"/"tp"/"ep" combinations
+    #: (e.g. "fsdp_tp", "dp_ep") -> GSPMD with those shardings.
     parallel: str | None = None
     mesh_axes: dict | None = None  # e.g. {"data": 8} or {"data": 4, "model": 2}
+    pp_microbatches: int = 4  # pipeline microbatches (parallel="pp")
 
 
 def train(
@@ -83,7 +85,25 @@ def train(
         if mesh_axes is None and loop.parallel == "sp":
             # sp needs a seq axis; default to pure context parallelism.
             mesh_axes = {"data": 1, "seq": len(jax.devices())}
+        if mesh_axes is None and loop.parallel == "pp":
+            mesh_axes = {"pp": len(jax.devices())}
         mesh = make_mesh(mesh_axes)
+        # A strategy whose axis is absent from the mesh would silently
+        # degrade to replication — fail loudly instead.
+        required_axes = {
+            "dp": "data",
+            "tp": "model",
+            "ep": "expert",
+            "fsdp": "data",
+            "pp": "pp",
+        }
+        for token in loop.parallel.split("_"):
+            needed = required_axes.get(token)
+            if needed is not None and needed not in mesh.shape:
+                raise ValueError(
+                    f'parallel="{loop.parallel}" requires a mesh with a '
+                    f'"{needed}" axis, e.g. --mesh data=2,{needed}=4'
+                )
         if loop.parallel == "sp":
             seq_size = mesh.shape.get("seq")
             if seq_size is None:
@@ -115,8 +135,34 @@ def train(
         params = init_params(jax.random.PRNGKey(loop.seed), model_config)
         opt_state = None  # built after placement
 
-    if mesh is not None and loop.parallel not in ("dp", "sp"):
+    if mesh is not None and loop.parallel not in ("dp", "sp", "pp"):
         params = shard_params(params, mesh, loop.parallel)
+    if loop.parallel == "pp":
+        from bpe_transformer_tpu.parallel.pp import (
+            init_pp_opt_state,
+            shard_pp_params,
+            stack_pipeline_params,
+        )
+
+        pp_size = mesh.shape["pp"]
+        if model_config.ffn_type == "moe":
+            raise NotImplementedError(
+                'parallel="pp" does not yet thread the MoE router aux loss '
+                "through the pipeline schedule; use an ep strategy instead"
+            )
+        # A resumed checkpoint may already carry the stacked pipeline layout;
+        # a dense checkpoint (params AND optimizer moments) is re-stacked.
+        if "stages" not in params:
+            params = stack_pipeline_params(params, pp_size)
+            if opt_state is not None:
+                opt_state = AdamWState(
+                    step=opt_state.step,
+                    m=stack_pipeline_params(opt_state.m, pp_size),
+                    v=stack_pipeline_params(opt_state.v, pp_size),
+                )
+        params = shard_pp_params(params, mesh)
+        if opt_state is None:
+            opt_state = init_pp_opt_state(params, mesh)
     if opt_state is None:
         opt_state = adamw_init(params)
 
@@ -129,6 +175,13 @@ def train(
     elif loop.parallel == "sp":
         step_fn = make_sp_train_step(model_config, hparams, mesh)
         place = lambda b: shard_sp_batch(b, mesh)
+    elif loop.parallel == "pp":
+        from bpe_transformer_tpu.parallel.pp import make_pp_train_step
+
+        step_fn = make_pp_train_step(
+            model_config, hparams, mesh, num_microbatches=loop.pp_microbatches
+        )
+        place = lambda b: shard_batch(b, mesh)
     else:
         step_fn = make_gspmd_train_step(
             model_config, hparams, mesh, loop.parallel, example_params=params
@@ -142,14 +195,23 @@ def train(
     def run_eval() -> float:
         if val_data is None:
             return float("nan")
+        eval_params = params
+        if loop.parallel == "pp":
+            # Eval reuses the dense single-program forward; pull the stacked
+            # stages back to host and restore the layer-list layout.
+            from bpe_transformer_tpu.parallel.pp import unstack_pipeline_params
+
+            eval_params = unstack_pipeline_params(jax.device_get(params))
         eval_rng = np.random.default_rng(loop.seed + 1)
         losses = []
         for _ in range(loop.eval_batches):
             ex, ey = get_batch(
                 val_data, loop.batch_size, model_config.context_length, eval_rng
             )
-            ex, ey = place((jax.numpy.asarray(ex), jax.numpy.asarray(ey)))
-            losses.append(float(eval_step(params, ex, ey)))
+            ex, ey = (jax.numpy.asarray(ex), jax.numpy.asarray(ey))
+            if loop.parallel != "pp":
+                ex, ey = place((ex, ey))
+            losses.append(float(eval_step(eval_params, ex, ey)))
         return float(np.mean(losses))
 
     history: list[dict] = []

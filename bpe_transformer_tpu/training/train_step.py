@@ -37,9 +37,17 @@ class TrainHParams:
 
 
 def make_loss_fn(config: ModelConfig) -> Callable:
-    def loss_fn(params, x, y):
-        logits = forward(params, x, config)
-        return cross_entropy(logits, y)
+    if config.ffn_type == "moe":
+
+        def loss_fn(params, x, y):
+            logits, aux = forward(params, x, config, return_aux=True)
+            return cross_entropy(logits, y) + config.router_aux_weight * aux
+
+    else:
+
+        def loss_fn(params, x, y):
+            logits = forward(params, x, config)
+            return cross_entropy(logits, y)
 
     return loss_fn
 
@@ -95,5 +103,11 @@ def make_train_step(config: ModelConfig, hparams: TrainHParams) -> Callable:
 
 
 def make_eval_step(config: ModelConfig) -> Callable:
-    loss_fn = make_loss_fn(config)
-    return jax.jit(loss_fn)
+    """Pure cross-entropy eval (no MoE router aux — that's a training
+    regularizer; val_loss stays a log-perplexity comparable across configs)."""
+
+    def eval_loss(params, x, y):
+        logits = forward(params, x, config)
+        return cross_entropy(logits, y)
+
+    return jax.jit(eval_loss)

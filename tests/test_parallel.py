@@ -183,3 +183,64 @@ def test_sp_forward_matches_full_forward():
     np.testing.assert_allclose(
         np.asarray(full), np.asarray(sharded), atol=2e-4, rtol=1e-3
     )
+
+
+# ------------------------------------------------------------ pipeline (pp)
+
+
+def test_pp_step_matches_single_device():
+    """GPipe pipeline (4 stages) + dp must reproduce the single-device update."""
+    from bpe_transformer_tpu.parallel.pp import (
+        init_pp_opt_state,
+        make_pp_train_step,
+        shard_pp_params,
+        stack_pipeline_params,
+        unstack_pipeline_params,
+    )
+
+    cfg = dataclasses.replace(CFG, num_layers=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    opt_state = adamw_init(params)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(16, cfg.context_length)))
+    y = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(16, cfg.context_length)))
+
+    single = make_train_step(cfg, HP)
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 2, "pp": 4})
+    params2 = init_params(jax.random.PRNGKey(0), cfg)
+    pp_params = shard_pp_params(stack_pipeline_params(params2, 4), mesh)
+    pp_opt = init_pp_opt_state(pp_params, mesh)
+    step = make_pp_train_step(cfg, HP, mesh, num_microbatches=4)
+    x2, y2 = shard_batch((x, y), mesh)
+    p2, s2, m2 = step(pp_params, pp_opt, x2, y2)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(m1["grad_norm"]), float(m2["grad_norm"]), rtol=1e-4
+    )
+    restored = unstack_pipeline_params(jax.device_get(p2))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        ),
+        p1,
+        restored,
+    )
+
+
+def test_pp_stack_unstack_roundtrip():
+    from bpe_transformer_tpu.parallel.pp import (
+        stack_pipeline_params,
+        unstack_pipeline_params,
+    )
+
+    cfg = dataclasses.replace(CFG, num_layers=4)
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    restored = unstack_pipeline_params(stack_pipeline_params(params, 2))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params,
+        restored,
+    )

@@ -176,3 +176,36 @@ def test_generate_greedy_and_topk(byte_data):
     sampled = generate_ids(params, TINY, [1, 2, 3], 5, temperature=1.0, top_k=5, seed=1)
     assert len(sampled) == 5
     assert all(0 <= t < TINY.vocab_size for t in sampled)
+
+
+def test_pp_training_runs(byte_data, tmp_path):
+    """GPipe pipeline loop: 2 stages x 4-way data parallel, with eval +
+    checkpoint in the stacked-stage layout."""
+    loop = LoopConfig(
+        steps=8,
+        batch_size=16,
+        log_every=4,
+        eval_every=8,
+        checkpoint_every=8,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        parallel="pp",
+        mesh_axes={"data": 4, "pp": 2},
+        pp_microbatches=2,
+    )
+    summary = train(TINY, HP, loop, byte_data, val_data=byte_data, log_fn=lambda *_: None)
+    assert np.isfinite(summary["final_train_loss"])
+    assert np.isfinite(summary["final_val_loss"])
+
+
+def test_moe_training_runs(byte_data):
+    """MoE LM through the loop with expert parallelism."""
+    cfg = dataclasses.replace(TINY, ffn_type="moe", n_experts=4)
+    loop = LoopConfig(
+        steps=6,
+        batch_size=16,
+        log_every=3,
+        parallel="dp_ep",
+        mesh_axes={"data": 2, "expert": 4},
+    )
+    summary = train(cfg, HP, loop, byte_data, log_fn=lambda *_: None)
+    assert np.isfinite(summary["final_train_loss"])
