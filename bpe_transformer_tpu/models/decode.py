@@ -1,0 +1,209 @@
+"""KV-cached autoregressive decoding.
+
+A capability the reference never implements (its contract stops at training
+logits, `/root/reference/tests/adapters.py:282-361`); built TPU-first so
+sampling is O(1) per token instead of re-running the full forward:
+
+* the cache is a static-shape pytree — per layer ``(batch, heads,
+  context_length, d_head)`` K and V buffers — so prefill + every decode step
+  compile once (``lax.dynamic_update_slice`` writes, no shape growth);
+* prefill runs the blocks over the whole prompt at once (MXU-friendly) while
+  recording K/V; each decode step projects exactly one token and attends
+  against the cache under a position mask;
+* the token loop is a ``lax.scan`` inside ONE jit, so generation launches a
+  single XLA program regardless of ``max_new_tokens``.
+
+Weights use the same param pytree as training — no export/conversion step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+from bpe_transformer_tpu.models.config import ModelConfig
+from bpe_transformer_tpu.models.transformer import Params
+from bpe_transformer_tpu.ops.core import (
+    embedding,
+    linear,
+    merge_heads,
+    rmsnorm,
+    silu,
+    split_heads,
+    swiglu,
+)
+from bpe_transformer_tpu.ops.rope import apply_rope, rope_tables
+
+KVCache = list  # [{"k": (B, H, ctx, dh), "v": (B, H, ctx, dh)} per layer]
+
+
+def init_kv_cache(config: ModelConfig, batch: int, dtype=jnp.float32) -> KVCache:
+    shape = (batch, config.num_heads, config.context_length, config.d_head)
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(config.num_layers)
+    ]
+
+
+def _rope_qk(q, k, positions, config):
+    if config.remove_rope:
+        return q, k
+    cos, sin = rope_tables(config.d_head, config.context_length, config.rope_theta)
+    pos = jnp.expand_dims(positions, axis=-2)  # broadcast over heads
+    return apply_rope(q, pos, cos, sin), apply_rope(k, pos, cos, sin)
+
+
+def _ffn_dense(x, ffn, config):
+    if config.ffn_type in (None, "swiglu"):
+        return swiglu(x, ffn["w1"], ffn["w2"], ffn["w3"])
+    if config.ffn_type == "silu":
+        return linear(silu(linear(x, ffn["w1"])), ffn["w2"])
+    raise NotImplementedError(
+        f"cached decoding supports swiglu/silu FFNs, got {config.ffn_type!r}"
+    )
+
+
+def _norm(x, w, config):
+    return x if config.remove_rmsnorm else rmsnorm(x, w)
+
+
+def _project_qkv(h, attn, num_heads):
+    q = split_heads(linear(h, attn["q_proj"]), num_heads)
+    k = split_heads(linear(h, attn["k_proj"]), num_heads)
+    v = split_heads(linear(h, attn["v_proj"]), num_heads)
+    return q, k, v
+
+
+def prefill(
+    params: Params, token_ids: Array, config: ModelConfig, cache: KVCache
+) -> tuple[Array, KVCache]:
+    """Run the prompt through the model, filling the cache.
+
+    ``token_ids``: (batch, prompt_len).  Returns logits of the LAST prompt
+    position ``(batch, vocab)`` and the filled cache.
+    """
+    batch, plen = token_ids.shape
+    positions = jnp.arange(plen)
+    x = embedding(params["token_embeddings"], token_ids)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(config.d_head, jnp.float32))
+    mask = jnp.tril(jnp.ones((plen, plen), bool))
+
+    new_cache = []
+    for block_params, layer_cache in zip(params["layers"], cache):
+        h = _norm(x, block_params["ln1"], config)
+        q, k, v = _project_qkv(h, block_params["attn"], config.num_heads)
+        q, k = _rope_qk(q, k, positions, config)
+        layer_cache = {
+            "k": lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, 0, 0)),
+        }
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        scores = jnp.where(mask, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+        att = merge_heads(jnp.einsum("bhqk,bhkd->bhqd", probs, v))
+        x = x + linear(att, block_params["attn"]["output_proj"])
+        h = _norm(x, block_params["ln2"], config)
+        x = x + _ffn_dense(h, block_params["ffn"], config)
+        new_cache.append(layer_cache)
+
+    x = _norm(x, params["ln_final"], config)
+    logits = linear(x[:, -1].astype(jnp.float32), params["lm_head"].astype(jnp.float32))
+    return logits, new_cache
+
+
+def decode_step(
+    params: Params,
+    token: Array,
+    pos: Array,
+    cache: KVCache,
+    config: ModelConfig,
+) -> tuple[Array, KVCache]:
+    """One cached decode step.
+
+    ``token``: (batch,) ids of the token AT position ``pos`` (scalar);
+    returns logits ``(batch, vocab)`` for position ``pos`` and the updated
+    cache.
+    """
+    x = embedding(params["token_embeddings"], token[:, None])  # (B, 1, d)
+    positions = pos[None]  # (1,)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(config.d_head, jnp.float32))
+    # Attend only to filled positions <= pos.
+    visible = jnp.arange(config.context_length) <= pos  # (ctx,)
+
+    new_cache = []
+    for block_params, layer_cache in zip(params["layers"], cache):
+        h = _norm(x, block_params["ln1"], config)
+        q, k, v = _project_qkv(h, block_params["attn"], config.num_heads)
+        q, k = _rope_qk(q, k, positions, config)
+        k_cache = lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, pos, 0))
+        v_cache = lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, pos, 0))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale  # (B,H,1,ctx)
+        scores = jnp.where(visible[None, None, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+        att = merge_heads(jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache))
+        x = x + linear(att, block_params["attn"]["output_proj"])
+        h = _norm(x, block_params["ln2"], config)
+        x = x + _ffn_dense(h, block_params["ffn"], config)
+        new_cache.append({"k": k_cache, "v": v_cache})
+
+    x = _norm(x, params["ln_final"], config)
+    logits = linear(x[:, 0].astype(jnp.float32), params["lm_head"].astype(jnp.float32))
+    return logits, new_cache
+
+
+def _sample_from_logits(logits, key, temperature: float, top_k: int | None):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("config", "max_new_tokens", "temperature", "top_k"),
+)
+def generate_cached(
+    params: Params,
+    prompt_ids: Array,
+    key: Array,
+    *,
+    config: ModelConfig,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+) -> Array:
+    """Sample ``(batch, max_new_tokens)`` continuations in one XLA program.
+
+    ``prompt_ids``: (batch, prompt_len) with ``prompt_len + max_new_tokens
+    <= context_length`` (the cache is sized to the context window).
+    """
+    batch, plen = prompt_ids.shape
+    if plen + max_new_tokens > config.context_length:
+        raise ValueError(
+            f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"context_length ({config.context_length})"
+        )
+    cache = init_kv_cache(config, batch)
+    logits, cache = prefill(params, prompt_ids, config, cache)
+    key, sub = jax.random.split(key)
+    first = _sample_from_logits(logits, sub, temperature, top_k)
+
+    def step(carry, _):
+        token, pos, cache, key = carry
+        logits, cache = decode_step(params, token, pos, cache, config)
+        key, sub = jax.random.split(key)
+        nxt = _sample_from_logits(logits, sub, temperature, top_k)
+        return (nxt, pos + 1, cache, key), nxt
+
+    if max_new_tokens == 1:
+        return first[:, None]
+    (_, _, _, _), rest = lax.scan(
+        step, (first, jnp.asarray(plen), cache, key), None, length=max_new_tokens - 1
+    )
+    return jnp.concatenate([first[:, None], rest.T], axis=1)

@@ -163,20 +163,32 @@ def _pp_loss_fn(
 
         def tick(carry, t):
             recv, loss_sum = carry
+            # Only rank 0 pays for the embedding lookup; other ranks take the
+            # ppermute'd activation (lax.cond executes a single branch).
             enter = jnp.clip(t, 0, num_micro - 1)
-            x_enter = embedding(
-                embed_w, lax.dynamic_index_in_dim(x_mb, enter, 0, keepdims=False)
-            ).astype(act_dtype)
-            act_in = jnp.where(rank == 0, x_enter, recv)
+            act_in = lax.cond(
+                rank == 0,
+                lambda: embedding(
+                    embed_w,
+                    lax.dynamic_index_in_dim(x_mb, enter, 0, keepdims=False),
+                ).astype(act_dtype),
+                lambda: recv,
+            )
             act_out = apply_stage(act_in)
 
+            # Only the last rank pays for the full-vocab head matmul + CE.
             done = t - (pp_size - 1)
             done_idx = jnp.clip(done, 0, num_micro - 1)
-            mb_loss = head_loss(
-                act_out, lax.dynamic_index_in_dim(y_mb, done_idx, 0, keepdims=False)
-            )
             take = (rank == pp_size - 1) & (done >= 0)
-            loss_sum = loss_sum + jnp.where(take, mb_loss, 0.0)
+            mb_loss = lax.cond(
+                take,
+                lambda: head_loss(
+                    act_out,
+                    lax.dynamic_index_in_dim(y_mb, done_idx, 0, keepdims=False),
+                ),
+                lambda: jnp.zeros((), jnp.float32),
+            )
+            loss_sum = loss_sum + mb_loss
 
             recv_next = lax.ppermute(act_out, pp_axis, fwd_perm)
             return (recv_next, loss_sum), None

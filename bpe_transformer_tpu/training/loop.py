@@ -105,6 +105,12 @@ def train(
                     f'"{needed}" axis, e.g. --mesh data=2,{needed}=4'
                 )
         if loop.parallel == "sp":
+            if model_config.ffn_type == "moe":
+                raise NotImplementedError(
+                    'parallel="sp" builds its loss from the ring-attention '
+                    "forward and does not yet add the MoE router aux loss; "
+                    "use an ep strategy instead"
+                )
             seq_size = mesh.shape.get("seq")
             if seq_size is None:
                 raise ValueError(
@@ -152,6 +158,13 @@ def train(
             )
         # A resumed checkpoint may already carry the stacked pipeline layout;
         # a dense checkpoint (params AND optimizer moments) is re-stacked.
+        if "stages" in params:
+            n_stages = jax.tree_util.tree_leaves(params["stages"])[0].shape[0]
+            if n_stages != pp_size:
+                raise ValueError(
+                    f"checkpoint has {n_stages} pipeline stages but the mesh "
+                    f"pp axis is {pp_size}; resume with --mesh ...,pp={n_stages}"
+                )
         if "stages" not in params:
             params = stack_pipeline_params(params, pp_size)
             if opt_state is not None:
@@ -198,10 +211,13 @@ def train(
         eval_params = params
         if loop.parallel == "pp":
             # Eval reuses the dense single-program forward; pull the stacked
-            # stages back to host and restore the layer-list layout.
+            # stages back to host, restore the layer-list layout, and upload
+            # ONCE so the batch loop below doesn't re-transfer per batch.
             from bpe_transformer_tpu.parallel.pp import unstack_pipeline_params
 
-            eval_params = unstack_pipeline_params(jax.device_get(params))
+            eval_params = jax.device_put(
+                unstack_pipeline_params(jax.device_get(params))
+            )
         eval_rng = np.random.default_rng(loop.seed + 1)
         losses = []
         for _ in range(loop.eval_batches):

@@ -50,6 +50,32 @@ def generate_ids(
     prompt = list(prompt_ids)[-ctx:]
     if not prompt:
         raise ValueError("prompt must contain at least one token")
+
+    if (
+        len(prompt) + max_new_tokens <= ctx
+        and config.ffn_type in (None, "swiglu", "silu")
+        and not config.use_post_norm  # decode.py hardcodes pre-norm blocks
+    ):
+        # KV-cached fast path: O(1) work per token, one XLA program for the
+        # whole generation (models/decode.py).
+        from bpe_transformer_tpu.models.decode import generate_cached
+
+        ids = generate_cached(
+            params,
+            jnp.asarray([prompt], dtype=jnp.int32),
+            jax.random.PRNGKey(seed),
+            config=config,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_k=top_k,
+        )
+        out = [int(t) for t in np.asarray(ids[0])]
+        if stop_id is not None and stop_id in out:
+            out = out[: out.index(stop_id) + 1]
+        return out
+
+    # Sliding-window fallback (prompt + continuation exceed the context, or
+    # FFN variants the cached path doesn't cover): full forward per token.
     buf = np.zeros(ctx, dtype=np.int32)
     buf[: len(prompt)] = prompt
     length = len(prompt)
