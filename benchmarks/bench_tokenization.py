@@ -1,0 +1,122 @@
+"""Host tokenization benchmarks, mirroring the reference's published numbers.
+
+The reference's only published performance artifacts are notebook timings on
+an M3 Pro laptop (SURVEY §6 / BASELINE.md): pre-tokenization throughput, BPE
+training time, and streaming-encode time on TinyStories.  This script
+measures the same three stages here — Python path vs the native C++ engine —
+on a corpus assembled from the reference's fixture sample.
+
+Usage:
+    python benchmarks/bench_tokenization.py [--mb 20] [--vocab 10000]
+
+Prints one JSON line per stage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+SAMPLE = Path("/root/reference/tests/fixtures/tinystories_sample.txt")
+
+
+def build_corpus(mb: float, out: Path) -> Path:
+    base = SAMPLE.read_text(encoding="utf-8")
+    reps = max(1, int(mb * 1e6 / len(base.encode())))
+    with open(out, "w", encoding="utf-8") as f:
+        for _ in range(reps):
+            f.write(base)
+    return out
+
+
+def timed(fn):
+    t0 = time.perf_counter()
+    result = fn()
+    return time.perf_counter() - t0, result
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mb", type=float, default=20.0)
+    parser.add_argument("--vocab", type=int, default=10_000)
+    args = parser.parse_args()
+
+    from bpe_transformer_tpu.native import is_available
+    from bpe_transformer_tpu.tokenization import BPETokenizer, BPETrainer
+    from bpe_transformer_tpu.tokenization.pretokenization import count_pretokens
+
+    tmp = Path(tempfile.mkdtemp(prefix="bench_tok_"))
+    corpus = build_corpus(args.mb, tmp / "corpus.txt")
+    size_mb = corpus.stat().st_size / 1e6
+    specials = ["<|endoftext|>"]
+    results = []
+
+    def report(stage: str, seconds: float, python_seconds: float | None = None):
+        rec = {
+            "stage": stage,
+            "seconds": round(seconds, 3),
+            "mb_per_s": round(size_mb / seconds, 2),
+            "native": is_available(),
+        }
+        if python_seconds is not None:
+            rec["python_seconds"] = round(python_seconds, 3)
+            rec["speedup"] = round(python_seconds / seconds, 2)
+        results.append(rec)
+        print(json.dumps(rec))
+
+    # 1. Pre-tokenization counting (python multiprocessing path — the
+    #    reference's parallel_pretokenization equivalent).
+    t_count, _ = timed(lambda: count_pretokens(corpus, specials, training=True))
+    report("pretokenize_count_python", t_count)
+
+    # 2. BPE training, full pipeline (native streams + C++ merge loop).
+    trainer = BPETrainer(vocab_size=args.vocab, special_tokens=specials)
+    t_native, _ = timed(lambda: trainer.train(corpus))
+    os.environ["BT_NATIVE"] = "0"
+    try:
+        t_py, _ = timed(
+            lambda: BPETrainer(
+                vocab_size=args.vocab, special_tokens=specials
+            ).train(corpus)
+        )
+    finally:
+        os.environ.pop("BT_NATIVE", None)
+    report("bpe_train_full", t_native, python_seconds=t_py)
+
+    # 3. Streaming encode of the corpus with the trained tokenizer.
+    tok = BPETokenizer(trainer.vocab, trainer.merges, specials)
+    tok_py = BPETokenizer(dict(trainer.vocab), list(trainer.merges), specials)
+    tok_py._native_tried = True
+
+    def encode_stream(t):
+        with open(corpus, encoding="utf-8") as f:
+            n = 0
+            for _ in t.encode_iterable(f):
+                n += 1
+        return n
+
+    t_enc, n_tokens = timed(lambda: encode_stream(tok))
+    t_enc_py, _ = timed(lambda: encode_stream(tok_py))
+    report("encode_stream", t_enc, python_seconds=t_enc_py)
+    print(
+        json.dumps(
+            {
+                "corpus_mb": round(size_mb, 1),
+                "tokens": n_tokens,
+                "encode_tokens_per_s": round(n_tokens / t_enc),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

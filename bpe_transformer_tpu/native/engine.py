@@ -87,6 +87,42 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_char_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
         ]
+        lib.bt_train_bpe.restype = ctypes.c_int64
+        lib.bt_train_bpe.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
+        lib.bt_counter_new.restype = ctypes.c_void_p
+        lib.bt_counter_new.argtypes = []
+        lib.bt_counter_free.restype = None
+        lib.bt_counter_free.argtypes = [ctypes.c_void_p]
+        lib.bt_counter_add.restype = None
+        lib.bt_counter_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.bt_counter_add_prefix.restype = ctypes.c_int64
+        lib.bt_counter_add_prefix.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.bt_counter_stats.restype = None
+        lib.bt_counter_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.bt_counter_items.restype = ctypes.c_int64
+        lib.bt_counter_items.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.bt_train_bpe_from_counter.restype = ctypes.c_int64
+        lib.bt_train_bpe_from_counter.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ]
         _lib = lib
         return _lib
 
@@ -113,6 +149,141 @@ def pretokenize_offsets(text: str) -> list[tuple[int, int]]:
     if n < 0:  # cannot happen: a pre-token is at least one byte
         raise RuntimeError("pretokenize capacity underflow")
     return [(out[2 * i], out[2 * i + 1]) for i in range(n)]
+
+
+def train_bpe_merges(
+    words: list[tuple[int, ...]],
+    counts: list[int],
+    vocab_bytes: list[bytes],
+    target_vocab: int,
+) -> list[tuple[int, int]]:
+    """Run the C++ greedy BPE merge loop.
+
+    ``words``: distinct pre-tokens as id tuples (len >= 2) with parallel
+    ``counts`` multiplicities; ``vocab_bytes[id]`` are the initial vocab
+    entries (the tie-break compares these byte strings).  Returns the ordered
+    merge list as ``(left_id, right_id)`` pairs; merge ``i`` creates id
+    ``len(vocab_bytes) + i``.
+    """
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(_load_failed or "native engine unavailable")
+
+    word_data = np.fromiter(
+        (t for w in words for t in w), dtype=np.int32
+    )
+    word_offsets = np.zeros(len(words) + 1, dtype=np.int64)
+    np.cumsum([len(w) for w in words], out=word_offsets[1:])
+    counts_arr = np.asarray(counts, dtype=np.int64)
+
+    vocab_data = np.frombuffer(b"".join(vocab_bytes), dtype=np.uint8)
+    vocab_offsets = np.zeros(len(vocab_bytes) + 1, dtype=np.int64)
+    np.cumsum([len(v) for v in vocab_bytes], out=vocab_offsets[1:])
+
+    out_cap = max(target_vocab - len(vocab_bytes), 0)
+    out = np.empty(2 * max(out_cap, 1), dtype=np.int32)
+
+    as_ptr = lambda arr, ct: arr.ctypes.data_as(ctypes.POINTER(ct))
+    n = lib.bt_train_bpe(
+        as_ptr(word_data, ctypes.c_int32),
+        as_ptr(word_offsets, ctypes.c_int64),
+        len(words),
+        as_ptr(counts_arr, ctypes.c_int64),
+        as_ptr(vocab_data, ctypes.c_uint8),
+        as_ptr(vocab_offsets, ctypes.c_int64),
+        len(vocab_bytes),
+        target_vocab,
+        as_ptr(out, ctypes.c_int32),
+        out_cap,
+    )
+    if n < 0:  # cannot happen: the loop stops at target_vocab
+        raise RuntimeError("train_bpe capacity underflow")
+    return [(int(out[2 * i]), int(out[2 * i + 1])) for i in range(n)]
+
+
+class NativePretokenCounter:
+    """Streaming GPT-2 pre-token counter over the C++ scanner.
+
+    Feed specials-free text parts with :meth:`add`; read the accumulated
+    counts with :meth:`items`, or hand the whole counter to
+    :meth:`train_bpe` without ever materializing it in Python.
+    """
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(_load_failed or "native engine unavailable")
+        self._lib = lib
+        self._handle = lib.bt_counter_new()
+        if not self._handle:
+            raise RuntimeError("bt_counter_new returned NULL")
+
+    def add(self, part: "str | bytes") -> None:
+        data = part.encode("utf-8") if isinstance(part, str) else part
+        if data:
+            self._lib.bt_counter_add(self._handle, data, len(data))
+
+    def add_prefix(self, data: bytes) -> int:
+        """Count all pre-tokens ending strictly before the end of ``data``;
+        returns bytes consumed (the tail must be re-fed with the next chunk)."""
+        if not data:
+            return 0
+        return self._lib.bt_counter_add_prefix(self._handle, data, len(data))
+
+    def items(self) -> list[tuple[bytes, int]]:
+        import numpy as np
+
+        n_items = ctypes.c_int64()
+        total_bytes = ctypes.c_int64()
+        self._lib.bt_counter_stats(
+            self._handle, ctypes.byref(n_items), ctypes.byref(total_bytes)
+        )
+        n = n_items.value
+        str_data = np.empty(max(total_bytes.value, 1), dtype=np.uint8)
+        offsets = np.empty(n + 1, dtype=np.int64)
+        counts = np.empty(max(n, 1), dtype=np.int64)
+        got = self._lib.bt_counter_items(
+            self._handle,
+            str_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        raw = str_data.tobytes()
+        return [
+            (raw[offsets[i] : offsets[i + 1]], int(counts[i])) for i in range(got)
+        ]
+
+    def train_bpe(
+        self, vocab_bytes: list[bytes], target_vocab: int
+    ) -> list[tuple[int, int]]:
+        """Fused count->train: run the C++ merge loop on this counter."""
+        import numpy as np
+
+        vocab_data = np.frombuffer(b"".join(vocab_bytes), dtype=np.uint8)
+        vocab_offsets = np.zeros(len(vocab_bytes) + 1, dtype=np.int64)
+        np.cumsum([len(v) for v in vocab_bytes], out=vocab_offsets[1:])
+        out_cap = max(target_vocab - len(vocab_bytes), 0)
+        out = np.empty(2 * max(out_cap, 1), dtype=np.int32)
+        n = self._lib.bt_train_bpe_from_counter(
+            self._handle,
+            vocab_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            vocab_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(vocab_bytes),
+            target_vocab,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_cap,
+        )
+        if n < 0:  # cannot happen: the loop stops at target_vocab
+            raise RuntimeError("train_bpe capacity underflow")
+        return [(int(out[2 * i]), int(out[2 * i + 1])) for i in range(n)]
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.bt_counter_free(handle)
+            self._handle = None
 
 
 class NativeBPEEncoder:

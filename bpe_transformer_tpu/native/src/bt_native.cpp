@@ -21,6 +21,9 @@
 
 #include <cstdint>
 #include <cstring>
+#include <queue>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -256,6 +259,162 @@ inline int merge_ids(const Engine* e, int32_t* ids, int len) {
   return len;
 }
 
+// ------------------------------------------------------------- BPE trainer
+
+// Greedy BPE merge loop with the reference's exact selection semantics
+// (mirrors tokenization/trainer.py): highest total pair count wins, ties
+// broken toward the lexicographically GREATER (bytes, bytes) pair; within a
+// word, occurrences merge leftmost-first without overlap; a merge is only
+// recorded if it applied somewhere; heap entries are lazily invalidated by a
+// count check at pop time.  Vocab entries are immutable once assigned, so
+// comparing via the current vocab table equals capture-at-push semantics.
+
+struct TrainerHeapEntry {
+  int64_t count;
+  int32_t a, b;
+};
+
+inline uint64_t pair_key(int32_t a, int32_t b) {
+  return (static_cast<uint64_t>(static_cast<uint32_t>(a)) << 32) |
+         static_cast<uint32_t>(b);
+}
+
+struct TrainerHeapCompare {
+  const std::vector<std::string>* vocab;
+  // priority_queue pops the LARGEST element; "larger" = higher count, then
+  // lexicographically greater (bytes_a, bytes_b).
+  bool operator()(const TrainerHeapEntry& x, const TrainerHeapEntry& y) const {
+    if (x.count != y.count) return x.count < y.count;
+    const std::string& xa = (*vocab)[static_cast<size_t>(x.a)];
+    const std::string& ya = (*vocab)[static_cast<size_t>(y.a)];
+    if (xa != ya) return xa < ya;
+    return (*vocab)[static_cast<size_t>(x.b)] < (*vocab)[static_cast<size_t>(y.b)];
+  }
+};
+
+// Core merge loop shared by bt_train_bpe and the fused counter->train entry.
+int64_t train_bpe_impl(std::vector<std::vector<int32_t>>& words,
+                       const std::vector<int64_t>& word_counts,
+                       std::vector<std::string>& vocab, int64_t target_vocab,
+                       int32_t* out_pairs, int64_t out_cap) {
+  int64_t n_words = static_cast<int64_t>(words.size());
+  std::unordered_map<uint64_t, int64_t> pair_counts;
+  // Pair -> word indices that may contain it.  Entries can go stale (the
+  // word was rewritten); they are filtered by the rewrite scan, and count
+  // bookkeeping stays exact because counts update only on actual rewrites.
+  std::unordered_map<uint64_t, std::vector<int32_t>> pair_words;
+  pair_counts.reserve(static_cast<size_t>(n_words) * 2);
+  pair_words.reserve(static_cast<size_t>(n_words) * 2);
+
+  for (int64_t w = 0; w < n_words; ++w) {
+    const auto& word = words[static_cast<size_t>(w)];
+    int64_t c = word_counts[w];
+    for (size_t i = 0; i + 1 < word.size(); ++i) {
+      uint64_t key = pair_key(word[i], word[i + 1]);
+      auto [it, inserted] = pair_counts.try_emplace(key, 0);
+      it->second += c;
+      auto& vec = pair_words[key];
+      if (vec.empty() || vec.back() != static_cast<int32_t>(w)) {
+        vec.push_back(static_cast<int32_t>(w));
+      }
+    }
+  }
+
+  TrainerHeapCompare cmp{&vocab};
+  std::priority_queue<TrainerHeapEntry, std::vector<TrainerHeapEntry>,
+                      TrainerHeapCompare>
+      heap(cmp);
+  for (const auto& [key, count] : pair_counts) {
+    heap.push({count, static_cast<int32_t>(key >> 32),
+               static_cast<int32_t>(key & 0xFFFFFFFFu)});
+  }
+
+  int64_t n_merges = 0;
+  std::vector<int32_t> rewritten;
+  std::vector<uint64_t> touched;
+  while (static_cast<int64_t>(vocab.size()) < target_vocab && !heap.empty()) {
+    TrainerHeapEntry top = heap.top();
+    heap.pop();
+    uint64_t key = pair_key(top.a, top.b);
+    auto cit = pair_counts.find(key);
+    int64_t current = (cit == pair_counts.end()) ? 0 : cit->second;
+    if (current != top.count || current <= 0) continue;  // stale entry
+
+    auto mit = pair_words.find(key);
+    if (mit == pair_words.end() || mit->second.empty()) continue;
+    // The member list is consumed: rewritten words no longer contain the
+    // pair (new adjacencies always involve the fresh id, so a merged pair
+    // of old ids can never re-form), and stale indices are filtered below.
+    std::vector<int32_t> members;
+    members.swap(mit->second);
+
+    int32_t z = static_cast<int32_t>(vocab.size());
+    bool merged_any = false;
+    touched.clear();
+
+    for (int32_t idx : members) {
+      auto& word = words[static_cast<size_t>(idx)];
+      size_t n = word.size();
+      // Leftmost non-overlapping scan; skip words without the pair.
+      rewritten.clear();
+      bool hit = false;
+      size_t i = 0;
+      while (i + 1 < n) {
+        if (word[i] == top.a && word[i + 1] == top.b) {
+          rewritten.push_back(z);
+          i += 2;
+          hit = true;
+        } else {
+          rewritten.push_back(word[i]);
+          ++i;
+        }
+      }
+      if (!hit) continue;
+      if (i == n - 1) rewritten.push_back(word[n - 1]);
+      merged_any = true;
+      int64_t c = word_counts[idx];
+      for (size_t j = 0; j + 1 < n; ++j) {
+        uint64_t p = pair_key(word[j], word[j + 1]);
+        pair_counts[p] -= c;
+        touched.push_back(p);
+      }
+      for (size_t j = 0; j + 1 < rewritten.size(); ++j) {
+        uint64_t p = pair_key(rewritten[j], rewritten[j + 1]);
+        pair_counts[p] += c;
+        auto& vec = pair_words[p];
+        if (vec.empty() || vec.back() != idx) vec.push_back(idx);
+        touched.push_back(p);
+      }
+      word.assign(rewritten.begin(), rewritten.end());
+    }
+
+    if (!merged_any) continue;
+
+    if (n_merges < out_cap) {
+      out_pairs[2 * n_merges] = top.a;
+      out_pairs[2 * n_merges + 1] = top.b;
+    }
+    ++n_merges;
+    vocab.push_back(vocab[static_cast<size_t>(top.a)] +
+                    vocab[static_cast<size_t>(top.b)]);
+
+    for (uint64_t p : touched) {
+      auto it = pair_counts.find(p);
+      if (it != pair_counts.end() && it->second > 0) {
+        heap.push({it->second, static_cast<int32_t>(p >> 32),
+                   static_cast<int32_t>(p & 0xFFFFFFFFu)});
+      }
+    }
+  }
+
+  return n_merges <= out_cap ? n_merges : -n_merges;
+}
+
+// Streaming pre-token counter (training mode: caller strips specials).
+struct PretokenCounter {
+  std::unordered_map<std::string, int64_t> counts;
+};
+
 }  // namespace
 
 extern "C" {
@@ -323,6 +482,149 @@ BT_EXPORT int64_t bt_encode(const Engine* e, const uint8_t* text, int64_t n, int
     i = end;
   }
   return n_out <= out_cap ? n_out : -n_out;
+}
+
+// Learn BPE merges.  Inputs: the distinct-word table (flattened ids +
+// offsets + multiplicities) and the initial vocab byte strings (flattened +
+// offsets; ids 0..n_vocab-1).  Writes (a, b) id pairs of the ordered merge
+// list into out_pairs (2 int32 per merge).  Returns the number of merges,
+// or -(required) if out_cap (in pairs) is too small.
+BT_EXPORT int64_t bt_train_bpe(
+    const int32_t* word_data, const int64_t* word_offsets, int64_t n_words,
+    const int64_t* word_counts, const uint8_t* vocab_data,
+    const int64_t* vocab_offsets, int64_t n_vocab, int64_t target_vocab,
+    int32_t* out_pairs, int64_t out_cap) {
+  std::vector<std::string> vocab;
+  vocab.reserve(static_cast<size_t>(target_vocab));
+  for (int64_t i = 0; i < n_vocab; ++i) {
+    vocab.emplace_back(
+        reinterpret_cast<const char*>(vocab_data + vocab_offsets[i]),
+        static_cast<size_t>(vocab_offsets[i + 1] - vocab_offsets[i]));
+  }
+  std::vector<std::vector<int32_t>> words(static_cast<size_t>(n_words));
+  for (int64_t w = 0; w < n_words; ++w) {
+    words[static_cast<size_t>(w)].assign(word_data + word_offsets[w],
+                                         word_data + word_offsets[w + 1]);
+  }
+  std::vector<int64_t> counts(word_counts, word_counts + n_words);
+  return train_bpe_impl(words, counts, vocab, target_vocab, out_pairs, out_cap);
+}
+
+// ---------------------------------------------- streaming pre-token counter
+
+BT_EXPORT PretokenCounter* bt_counter_new() { return new PretokenCounter(); }
+
+BT_EXPORT void bt_counter_free(PretokenCounter* c) { delete c; }
+
+// Pre-tokenize a specials-free UTF-8 part and accumulate counts.
+BT_EXPORT void bt_counter_add(PretokenCounter* c, const uint8_t* text,
+                              int64_t n) {
+  size_t i = 0;
+  size_t len = static_cast<size_t>(n);
+  auto& counts = c->counts;
+  while (i < len) {
+    size_t end = next_pretoken_end(text, len, i);
+    counts[std::string(reinterpret_cast<const char*>(text + i), end - i)] += 1;
+    i = end;
+  }
+}
+
+// Streaming variant: count every pre-token that ends strictly BEFORE the end
+// of the buffer (the final token may extend — or have its whitespace
+// lookahead change — once more input arrives).  Returns bytes consumed; the
+// caller re-feeds the unconsumed tail prepended to the next chunk.
+BT_EXPORT int64_t bt_counter_add_prefix(PretokenCounter* c, const uint8_t* text,
+                                        int64_t n) {
+  size_t len = static_cast<size_t>(n);
+  // A trailing incomplete UTF-8 sequence (chunk cut mid-codepoint) must stay
+  // in the tail, or the truncated lead byte would misclassify as CC_OTHER
+  // and falsely terminate the preceding run.
+  for (size_t back = 1; back <= 3 && back <= len; ++back) {
+    uint8_t b = text[len - back];
+    if (b < 0x80) break;              // ASCII: sequence complete
+    if ((b & 0xC0) == 0xC0) {         // lead byte of a multi-byte sequence
+      size_t need = (b & 0xE0) == 0xC0   ? 2
+                    : (b & 0xF0) == 0xE0 ? 3
+                    : (b & 0xF8) == 0xF0 ? 4
+                                         : 1;
+      if (back < need) len -= back;   // incomplete: exclude from this pass
+      break;
+    }
+    // else: continuation byte, keep scanning backwards for the lead
+  }
+  size_t i = 0;
+  auto& counts = c->counts;
+  while (i < len) {
+    size_t end = next_pretoken_end(text, len, i);
+    // A token is only final when its full lookahead context is present:
+    // runs/whitespace need the next codepoint (<= 4 bytes) and the
+    // contraction alternative peeks 2 chars past the apostrophe — e.g.
+    // "we'l|l go" cut after the first 'l' would otherwise emit "'" + "ll"
+    // instead of "'ll".  Hold back anything ending within 4 bytes of the
+    // buffer end.
+    if (end + 4 > len) break;
+    counts[std::string(reinterpret_cast<const char*>(text + i), end - i)] += 1;
+    i = end;
+  }
+  return static_cast<int64_t>(i);
+}
+
+BT_EXPORT void bt_counter_stats(const PretokenCounter* c, int64_t* n_items,
+                                int64_t* total_bytes) {
+  *n_items = static_cast<int64_t>(c->counts.size());
+  int64_t bytes = 0;
+  for (const auto& [word, count] : c->counts) {
+    bytes += static_cast<int64_t>(word.size());
+  }
+  *total_bytes = bytes;
+}
+
+// Export (string, count) items; buffers must be sized per bt_counter_stats
+// (offsets has n_items + 1 slots).  Returns the number of items.
+BT_EXPORT int64_t bt_counter_items(const PretokenCounter* c, uint8_t* str_data,
+                                   int64_t* offsets, int64_t* counts) {
+  int64_t idx = 0;
+  int64_t pos = 0;
+  for (const auto& [word, count] : c->counts) {
+    offsets[idx] = pos;
+    std::memcpy(str_data + pos, word.data(), word.size());
+    pos += static_cast<int64_t>(word.size());
+    counts[idx] = count;
+    ++idx;
+  }
+  offsets[idx] = pos;
+  return idx;
+}
+
+// Fused path: learn merges straight from an accumulated counter, never
+// materializing the word table on the Python side.  Words with < 2 bytes
+// cannot merge and are skipped; initial word ids are the raw byte values
+// (base vocab ids 0..255 are always the single bytes).
+BT_EXPORT int64_t bt_train_bpe_from_counter(
+    PretokenCounter* c, const uint8_t* vocab_data, const int64_t* vocab_offsets,
+    int64_t n_vocab, int64_t target_vocab, int32_t* out_pairs,
+    int64_t out_cap) {
+  std::vector<std::string> vocab;
+  vocab.reserve(static_cast<size_t>(target_vocab));
+  for (int64_t i = 0; i < n_vocab; ++i) {
+    vocab.emplace_back(
+        reinterpret_cast<const char*>(vocab_data + vocab_offsets[i]),
+        static_cast<size_t>(vocab_offsets[i + 1] - vocab_offsets[i]));
+  }
+  std::vector<std::vector<int32_t>> words;
+  std::vector<int64_t> counts;
+  words.reserve(c->counts.size());
+  counts.reserve(c->counts.size());
+  for (const auto& [word, count] : c->counts) {
+    if (word.size() < 2) continue;
+    std::vector<int32_t> ids(word.size());
+    for (size_t i = 0; i < word.size(); ++i) {
+      ids[i] = static_cast<uint8_t>(word[i]);
+    }
+    words.push_back(std::move(ids));
+    counts.push_back(count);
+  }
+  return train_bpe_impl(words, counts, vocab, target_vocab, out_pairs, out_cap);
 }
 
 }  // extern "C"

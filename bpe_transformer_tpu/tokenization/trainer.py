@@ -114,7 +114,14 @@ class BPETrainer:
         return self._target_vocab_size
 
     def train(self, input_path: str | Path, n_workers: int | None = None) -> None:
-        """Pre-tokenize ``input_path`` and learn merges to the target size."""
+        """Pre-tokenize ``input_path`` and learn merges to the target size.
+
+        With a C++ toolchain the whole pipeline (scan, count, merge loop)
+        runs natively, streaming the file in bounded-memory chunks; the
+        Python counting + merge path is the fallback.
+        """
+        if self._train_native_file(input_path):
+            return
         pretoken_counts = count_pretokens(
             input_path,
             self._special_tokens,
@@ -123,8 +130,99 @@ class BPETrainer:
         )
         self.train_from_pretokens(pretoken_counts)
 
+    def _train_native_file(self, input_path: str | Path) -> bool:
+        """Stream-count + train via the C++ engine; False when unavailable."""
+        import os
+
+        if os.environ.get("BT_NATIVE", "1") == "0":
+            return False
+        try:
+            from bpe_transformer_tpu.native import engine as native_engine
+
+            if not native_engine.is_available():
+                return False
+            counter = native_engine.NativePretokenCounter()
+        except Exception:
+            return False
+
+        from bpe_transformer_tpu.tokenization.pretokenization import (
+            split_on_special_tokens,
+        )
+
+        specials = self._special_tokens
+
+        def feed(text: str) -> None:
+            for part in split_on_special_tokens(text, specials, training=True):
+                if part:
+                    counter.add(part)
+
+        # newline="" disables universal-newline translation so CRLF corpora
+        # count identically to the binary-read Python path.
+        with open(input_path, encoding=ENCODING, errors="ignore", newline="") as f:
+            if specials:
+                # Cut the stream only at complete special-token occurrences:
+                # pre-tokens never span a special, so these cuts are exactly
+                # lossless (mirrors find_chunk_boundaries' invariant).
+                max_keep = max(len(s) for s in specials) - 1
+                pending = ""
+                while True:
+                    chunk = f.read(1 << 22)
+                    if not chunk:
+                        break
+                    pending += chunk
+                    cut = max(pending.rfind(s) for s in specials)
+                    if cut > 0:
+                        feed(pending[:cut])
+                        pending = pending[cut:]
+                    elif len(pending) > (1 << 26):
+                        # No special in sight: keep memory bounded by exact
+                        # token streaming, retaining enough characters to
+                        # cover a special straddling the boundary.
+                        head = pending[: len(pending) - max_keep]
+                        data = head.encode(ENCODING)
+                        consumed = counter.add_prefix(data)
+                        pending = (
+                            data[consumed:].decode(ENCODING)
+                            + pending[len(pending) - max_keep :]
+                        )
+                if pending:
+                    feed(pending)
+            else:
+                # No specials: exact incremental scan — the C++ side counts
+                # every pre-token that provably cannot change with more
+                # input, and returns the undecided tail to carry over.
+                tail = b""
+                while True:
+                    chunk = f.read(1 << 22)
+                    if not chunk:
+                        break
+                    data = tail + chunk.encode(ENCODING)
+                    consumed = counter.add_prefix(data)
+                    tail = data[consumed:]
+                if tail:
+                    counter.add(tail)
+
+        vocab = self._vocab
+        base = len(vocab)
+        pairs = counter.train_bpe(
+            [vocab[i] for i in range(base)], self._target_vocab_size
+        )
+        next_id = base
+        for a, b in pairs:
+            self._merges.append((vocab[a], vocab[b]))
+            vocab[next_id] = vocab[a] + vocab[b]
+            next_id += 1
+        return True
+
     def train_from_pretokens(self, pretoken_counts: Counter[Pretoken]) -> None:
-        """Learn merges from pre-token multiplicities (already counted)."""
+        """Learn merges from pre-token multiplicities (already counted).
+
+        Uses the C++ merge loop (`native/src/bt_native.cpp:bt_train_bpe`,
+        same selection semantics) when a toolchain is available; the Python
+        loop below is the reference implementation and fallback.
+        """
+        if self._train_native(pretoken_counts):
+            return
         words: list[list[int]] = []
         counts: list[int] = []
         for pretoken, count in pretoken_counts.items():
@@ -190,6 +288,41 @@ class BPETrainer:
                 c = pair_counts.get(p, 0)
                 if c > 0:
                     heapq.heappush(heap, _HeapEntry(c, p, (vocab[p[0]], vocab[p[1]])))
+
+    def _train_native(self, pretoken_counts: Counter[Pretoken]) -> bool:
+        """Learn merges via the C++ loop; False when unavailable."""
+        import os
+
+        if os.environ.get("BT_NATIVE", "1") == "0":
+            return False
+        try:
+            from bpe_transformer_tpu.native import engine as native_engine
+
+            if not native_engine.is_available():
+                return False
+            vocab = self._vocab
+            base = len(vocab)
+            words: list[Pretoken] = []
+            counts: list[int] = []
+            for pretoken, count in pretoken_counts.items():
+                if len(pretoken) < 2:
+                    continue
+                words.append(pretoken)
+                counts.append(count)
+            pairs = native_engine.train_bpe_merges(
+                words,
+                counts,
+                [vocab[i] for i in range(base)],
+                self._target_vocab_size,
+            )
+        except Exception:
+            return False
+        next_id = base
+        for a, b in pairs:
+            self._merges.append((vocab[a], vocab[b]))
+            vocab[next_id] = vocab[a] + vocab[b]
+            next_id += 1
+        return True
 
     def save_trainer(self, output_dir: Path | None = None) -> None:
         """Pickle ``vocab.pkl`` and ``merges.pkl`` under ``output_dir``.

@@ -55,6 +55,7 @@ def generate_ids(
         len(prompt) + max_new_tokens <= ctx
         and config.ffn_type in (None, "swiglu", "silu")
         and not config.use_post_norm  # decode.py hardcodes pre-norm blocks
+        and config.activation_dtype == "float32"  # decode.py runs in f32
     ):
         # KV-cached fast path: O(1) work per token, one XLA program for the
         # whole generation (models/decode.py).

@@ -174,3 +174,119 @@ def test_pickled_tokenizer_rebuilds_native(toy_pair):
     assert clone._native is None and clone._native_tried is False
     text = "the quick brown fox don't"
     assert clone.encode(text) == tok_native.encode(text)
+
+
+# ----------------------------------------------------------- native trainer
+
+
+def _python_trainer(vocab_size, specials, path):
+    import os
+
+    from bpe_transformer_tpu.tokenization import BPETrainer
+
+    os.environ["BT_NATIVE"] = "0"
+    try:
+        t = BPETrainer(vocab_size=vocab_size, special_tokens=specials)
+        t.train(path)
+    finally:
+        os.environ.pop("BT_NATIVE", None)
+    return t
+
+
+def _native_trainer(vocab_size, specials, path):
+    from bpe_transformer_tpu.tokenization import BPETrainer
+
+    t = BPETrainer(vocab_size=vocab_size, special_tokens=specials)
+    # Call the native path directly and require that it actually ran — a
+    # silent fallback would compare Python against Python.
+    assert t._train_native_file(path) is True
+    return t
+
+
+@pytest.mark.parametrize("specials", [["<|endoftext|>"], []])
+def test_trainer_native_matches_python(tmp_path, specials):
+    corpus = tmp_path / "c.txt"
+    corpus.write_text(
+        (
+            "the quick brown fox jumps over the lazy dog. don't stop! "
+            "числа 123 café\n<|endoftext|>\nsecond doc  with   spaces\n"
+        )
+        * 120,
+        encoding="utf-8",
+    )
+    tn = _native_trainer(420, specials, corpus)
+    tp = _python_trainer(420, specials, corpus)
+    assert tn.merges == tp.merges
+    assert tn.vocab == tp.vocab
+
+
+def test_trainer_native_matches_python_multichunk(tmp_path):
+    """Corpus larger than one 4 MB read chunk: stream cuts must be lossless."""
+    corpus = tmp_path / "big.txt"
+    line = "a story about the fox.  it  has   whitespace runs \n"
+    with open(corpus, "w", encoding="utf-8") as f:
+        for i in range(90_000):
+            f.write(line)
+            if i % 97 == 0:
+                f.write("<|endoftext|>")
+    assert corpus.stat().st_size > (1 << 22)
+    tn = _native_trainer(300, ["<|endoftext|>"], corpus)
+    tp = _python_trainer(300, ["<|endoftext|>"], corpus)
+    assert tn.merges == tp.merges
+
+
+def test_counter_add_prefix_streaming_matches_single_shot():
+    from bpe_transformer_tpu.native.engine import NativePretokenCounter
+
+    text = ("word  runs \n\n tabs\tand don't 123 café " * 50).encode("utf-8")
+    one = NativePretokenCounter()
+    one.add(text)
+    streamed = NativePretokenCounter()
+    tail = b""
+    for i in range(0, len(text), 97):  # awkward chunk size on purpose
+        data = tail + text[i : i + 97]
+        consumed = streamed.add_prefix(data)
+        tail = data[consumed:]
+    if tail:
+        streamed.add(tail)
+    assert sorted(one.items()) == sorted(streamed.items())
+
+
+def test_reference_merge_snapshot_parity_native(reference_fixtures):
+    """The native trainer reproduces the reference's pinned merge list."""
+    ref_merges = reference_fixtures / "train-bpe-reference-merges.txt"
+    if not ref_merges.exists():
+        pytest.skip("reference merge fixture absent")
+    from tests.test_train_bpe import _load_reference_merges  # reuse parser
+
+    expected = _load_reference_merges(ref_merges)
+    tn = _native_trainer(500, ["<|endoftext|>"], reference_fixtures / "corpus.en")
+    assert tn.merges == expected
+
+
+def test_counter_add_prefix_contraction_straddles_boundary():
+    """A 3-char contraction split as b"we'l" | b"l go" must still count 'll."""
+    from bpe_transformer_tpu.native.engine import NativePretokenCounter
+
+    text = b"we'll go we'll go we'll go"
+    one = NativePretokenCounter()
+    one.add(text)
+    for cut in range(1, len(text)):
+        streamed = NativePretokenCounter()
+        tail = b""
+        for piece in (text[:cut], text[cut:]):
+            data = tail + piece
+            consumed = streamed.add_prefix(data)
+            tail = data[consumed:]
+        if tail:
+            streamed.add(tail)
+        assert sorted(one.items()) == sorted(streamed.items()), f"cut={cut}"
+
+
+def test_trainer_native_matches_python_crlf(tmp_path):
+    """CRLF corpora must not be newline-translated on the native path."""
+    corpus = tmp_path / "crlf.txt"
+    corpus.write_bytes(b"the cat\r\nsat on the mat\r\n" * 80)
+    tn = _native_trainer(300, ["<|endoftext|>"], corpus)
+    tp = _python_trainer(300, ["<|endoftext|>"], corpus)
+    assert tn.merges == tp.merges
