@@ -38,7 +38,13 @@ class ModelConfig:
     remat: bool = False  # rematerialize each block on the backward pass
     # "xla" (materialized) | "flash" (Pallas) | "flash_fused" (RoPE in-kernel)
     attention_impl: str = "xla"
+    # "xla" | "pallas" (fused SwiGLU kernel; swiglu FFNs only)
+    ffn_impl: str = "xla"
     flash_block_size: int = 256  # q/k tile size for the flash kernel
+    # Sequence-chunked LM loss: cap peak logits memory at
+    # O(batch * chunk * vocab) instead of O(batch * seq * vocab).
+    # None -> materialize full logits.  Must divide context_length.
+    loss_chunk_size: int | None = None
 
     @property
     def d_head(self) -> int:
@@ -111,6 +117,7 @@ GPT2_SMALL_32K = ModelConfig(
     d_ff=2048,
     rope_theta=10000.0,
     activation_dtype="bfloat16",
+    loss_chunk_size=256,
 )
 
 #: BASELINE.json config 5: GPT-2-medium-class model (FSDP target).
@@ -124,4 +131,5 @@ GPT2_MEDIUM = ModelConfig(
     rope_theta=10000.0,
     activation_dtype="bfloat16",
     remat=True,
+    loss_chunk_size=256,
 )

@@ -91,6 +91,15 @@ def _ffn(x: Array, ffn_params: dict, config: ModelConfig) -> tuple[Array, Array]
     """FFN dispatch; returns ``(output, aux_loss)`` (aux is 0 except MoE)."""
     zero = jnp.zeros((), jnp.float32)
     if config.ffn_type in (None, "swiglu"):
+        if config.ffn_impl == "pallas":
+            from bpe_transformer_tpu.kernels.pallas.swiglu import swiglu_fused
+
+            return (
+                swiglu_fused(
+                    x, ffn_params["w1"], ffn_params["w2"], ffn_params["w3"]
+                ),
+                zero,
+            )
         return swiglu(x, ffn_params["w1"], ffn_params["w2"], ffn_params["w3"]), zero
     if config.ffn_type == "silu":
         return linear(silu(linear(x, ffn_params["w1"])), ffn_params["w2"]), zero
@@ -216,21 +225,18 @@ def transformer_block(
     )[0]
 
 
-def forward(
+def forward_hidden(
     params: Params,
     token_ids: Array,
     config: ModelConfig,
     positions: Array | None = None,
     attention_fn=None,
-    return_aux: bool = False,
-) -> Array:
-    """Logits ``(batch, seq, vocab)`` for ``token_ids (batch, seq)``.
+) -> tuple[Array, Array]:
+    """Final-norm hidden states ``(batch, seq, d_model)`` + summed MoE aux.
 
-    ``seq`` may be anything up to ``config.context_length`` (truncated-input
-    behavior pinned by `test_transformer_lm_truncated_input`).
-
-    ``return_aux=True`` additionally returns the summed auxiliary
-    (load-balance) loss of MoE layers: ``(logits, aux)``.
+    Everything in :func:`forward` except the LM head — the seam for
+    memory-lean losses that stream the vocab projection in chunks instead of
+    materializing ``(batch, seq, vocab)`` logits.
     """
     seq_len = token_ids.shape[-1]
     if seq_len > config.context_length:
@@ -273,6 +279,26 @@ def forward(
         aux_total = aux_total + aux
 
     x = _maybe_norm(x, compute_params["ln_final"], config)
+    return x, aux_total
+
+
+def forward(
+    params: Params,
+    token_ids: Array,
+    config: ModelConfig,
+    positions: Array | None = None,
+    attention_fn=None,
+    return_aux: bool = False,
+) -> Array:
+    """Logits ``(batch, seq, vocab)`` for ``token_ids (batch, seq)``.
+
+    ``seq`` may be anything up to ``config.context_length`` (truncated-input
+    behavior pinned by `test_transformer_lm_truncated_input`).
+
+    ``return_aux=True`` additionally returns the summed auxiliary
+    (load-balance) loss of MoE layers: ``(logits, aux)``.
+    """
+    x, aux_total = forward_hidden(params, token_ids, config, positions, attention_fn)
     # LM head always runs in float32 for stable logits/loss.
     logits = linear(x.astype(jnp.float32), params["lm_head"].astype(jnp.float32))
     if return_aux:

@@ -3,6 +3,7 @@
 from bpe_transformer_tpu.parallel.mesh import (
     batch_sharding,
     initialize_distributed,
+    make_hybrid_mesh,
     make_mesh,
     replicated,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "initialize_distributed",
     "make_dp_train_step",
     "make_gspmd_train_step",
+    "make_hybrid_mesh",
     "make_mesh",
     "param_shardings",
     "param_specs",

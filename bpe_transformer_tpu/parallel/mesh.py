@@ -50,6 +50,50 @@ def make_mesh(
     return Mesh(device_array, names)
 
 
+def make_hybrid_mesh(
+    ici_axes: dict[str, int],
+    dcn_axes: dict[str, int] | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Multi-slice mesh: ``ici_axes`` partition within a slice (fast ICI),
+    ``dcn_axes`` replicate that layout across slices (slower DCN links).
+
+    Each axis's total size is ``ici * dcn``; axes absent from ``dcn_axes``
+    span a single slice.  Convention: put data parallelism (gradient
+    all-reduce, the most DCN-tolerant collective) on the DCN axis and keep
+    model/tensor/expert/sequence axes inside a slice.
+
+    Example — 2 slices of a v4-16 with FSDP inside each slice::
+
+        mesh = make_hybrid_mesh({"data": 8, "model": 2}, {"data": 2})
+        # mesh.shape == {"data": 16, "model": 2}
+    """
+    if devices is None:
+        devices = jax.devices()
+    dcn_axes = dcn_axes or {}
+    unknown = set(dcn_axes) - set(ici_axes)
+    if unknown:
+        raise ValueError(
+            f"dcn axes {sorted(unknown)} not present in ici_axes "
+            f"{sorted(ici_axes)}"
+        )
+    names = tuple(ici_axes)
+    ici_shape = tuple(ici_axes.values())
+    dcn_shape = tuple(dcn_axes.get(name, 1) for name in names)
+    total = int(np.prod(ici_shape)) * int(np.prod(dcn_shape))
+    if total != len(devices):
+        raise ValueError(
+            f"hybrid mesh ici={dict(ici_axes)} x dcn={dict(dcn_axes)} needs "
+            f"{total} devices, have {len(devices)}"
+        )
+    if all(size == 1 for size in dcn_shape):
+        return make_mesh(ici_axes, devices=devices)
+    device_array = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=devices
+    )
+    return Mesh(device_array, names)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

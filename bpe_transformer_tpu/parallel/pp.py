@@ -153,6 +153,13 @@ def _pp_loss_fn(
         def head_loss(act, targets):
             if not config.remove_rmsnorm:
                 act = rmsnorm(act, shared["ln_final"].astype(act_dtype))
+            chunk = config.loss_chunk_size
+            if chunk and act.shape[-2] % min(chunk, act.shape[-2]) == 0:
+                from bpe_transformer_tpu.ops.losses import chunked_lm_cross_entropy
+
+                return chunked_lm_cross_entropy(
+                    act, shared["lm_head"], targets, min(chunk, act.shape[-2])
+                )
             logits = linear(
                 act.astype(jnp.float32), shared["lm_head"].astype(jnp.float32)
             )

@@ -71,6 +71,25 @@ def make_sp_train_step(
 
     def local_step(params, opt_state: AdamWState, x, y):
         def loss_fn(p):
+            # Memory-lean loss: honor loss_chunk_size on the LOCAL sequence
+            # shard when it divides evenly (the shard is already seq/N long).
+            chunk = config.loss_chunk_size
+            s_local = x.shape[-1]
+            if chunk and s_local % min(chunk, s_local) == 0:
+                from bpe_transformer_tpu.models.transformer import forward_hidden
+                from bpe_transformer_tpu.ops.losses import chunked_lm_cross_entropy
+
+                offset = jax.lax.axis_index(seq_axis) * s_local
+                positions = offset + jnp.arange(s_local)
+                attention_fn = partial(
+                    ring_self_attention, axis_name=seq_axis, causal=True
+                )
+                hidden, _ = forward_hidden(
+                    p, x, config, positions=positions, attention_fn=attention_fn
+                )
+                return chunked_lm_cross_entropy(
+                    hidden, p["lm_head"], y, min(chunk, s_local)
+                )
             logits = sp_forward(p, x, config, seq_axis)
             return cross_entropy(logits, y)
 

@@ -37,7 +37,22 @@ class TrainHParams:
 
 
 def make_loss_fn(config: ModelConfig) -> Callable:
-    if config.ffn_type == "moe":
+    is_moe = config.ffn_type == "moe"
+
+    if config.loss_chunk_size:
+        from bpe_transformer_tpu.models.transformer import forward_hidden
+        from bpe_transformer_tpu.ops.losses import chunked_lm_cross_entropy
+
+        def loss_fn(params, x, y):
+            hidden, aux = forward_hidden(params, x, config)
+            loss = chunked_lm_cross_entropy(
+                hidden, params["lm_head"], y, config.loss_chunk_size
+            )
+            if is_moe:
+                loss = loss + config.router_aux_weight * aux
+            return loss
+
+    elif is_moe:
 
         def loss_fn(params, x, y):
             logits, aux = forward(params, x, config, return_aux=True)
@@ -104,10 +119,25 @@ def make_train_step(config: ModelConfig, hparams: TrainHParams) -> Callable:
 
 def make_eval_step(config: ModelConfig) -> Callable:
     """Pure cross-entropy eval (no MoE router aux — that's a training
-    regularizer; val_loss stays a log-perplexity comparable across configs)."""
+    regularizer; val_loss stays a log-perplexity comparable across configs).
 
-    def eval_loss(params, x, y):
-        logits = forward(params, x, config)
-        return cross_entropy(logits, y)
+    Honors ``loss_chunk_size`` so eval fits in the same memory envelope as
+    the train step."""
+
+    if config.loss_chunk_size:
+        from bpe_transformer_tpu.models.transformer import forward_hidden
+        from bpe_transformer_tpu.ops.losses import chunked_lm_cross_entropy
+
+        def eval_loss(params, x, y):
+            hidden, _ = forward_hidden(params, x, config)
+            return chunked_lm_cross_entropy(
+                hidden, params["lm_head"], y, config.loss_chunk_size
+            )
+
+    else:
+
+        def eval_loss(params, x, y):
+            logits = forward(params, x, config)
+            return cross_entropy(logits, y)
 
     return jax.jit(eval_loss)

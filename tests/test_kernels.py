@@ -250,3 +250,58 @@ def test_flash_attention_asymmetric_blocks():
     expected = _xla_attention(q, k, v, True)
     assert np.isfinite(np.asarray(out)).all()
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
+
+
+# --------------------------------------------------------------- fused swiglu
+
+
+def test_fused_swiglu_matches_xla():
+    from bpe_transformer_tpu.kernels.pallas.swiglu import swiglu_fused
+    from bpe_transformer_tpu.ops.core import swiglu
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 24, 64)).astype(np.float32))
+    w1 = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32) * 0.05)
+    w2 = jnp.asarray(rng.normal(size=(64, 128)).astype(np.float32) * 0.05)
+    w3 = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32) * 0.05)
+
+    got = swiglu_fused(x, w1, w2, w3, 16, 32, True)
+    want = swiglu(x, w1, w2, w3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_fused_swiglu_gradients_match_xla():
+    import jax
+
+    from bpe_transformer_tpu.kernels.pallas.swiglu import swiglu_fused
+    from bpe_transformer_tpu.ops.core import swiglu
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 32)).astype(np.float32))
+    w1 = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32) * 0.05)
+    w2 = jnp.asarray(rng.normal(size=(32, 64)).astype(np.float32) * 0.05)
+    w3 = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32) * 0.05)
+
+    loss_fused = lambda *a: swiglu_fused(*a, 8, 16, True).sum()
+    loss_xla = lambda *a: swiglu(*a).sum()
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, w1, w2, w3)
+    g_xla = jax.grad(loss_xla, argnums=(0, 1, 2, 3))(x, w1, w2, w3)
+    for a, b in zip(g_fused, g_xla):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_model_fused_swiglu_matches_xla_impl():
+    import dataclasses
+
+    import jax
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, forward, init_params
+
+    cfg_xla = dataclasses.replace(TS_TEST_CONFIG, vocab_size=256)
+    cfg_pallas = dataclasses.replace(cfg_xla, ffn_impl="pallas")
+    params = init_params(jax.random.PRNGKey(0), cfg_xla)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 256, size=(2, cfg_xla.context_length)))
+    a = forward(params, ids, cfg_xla)
+    b = forward(params, ids, cfg_pallas)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)

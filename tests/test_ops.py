@@ -220,3 +220,30 @@ def test_sdpa_fully_masked_rows_are_finite():
         jnp.asarray(_t2n(q)), jnp.asarray(_t2n(k)), jnp.asarray(_t2n(v)), mask
     )
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_chunked_lm_cross_entropy_matches_full():
+    """Chunked loss == full-logits loss, in value AND gradients."""
+    import jax
+
+    from bpe_transformer_tpu.ops.losses import chunked_lm_cross_entropy, cross_entropy
+
+    rng = np.random.default_rng(0)
+    b, s, d, v = 2, 16, 8, 50
+    hidden = jnp.asarray(rng.normal(size=(b, s, d)).astype(np.float32))
+    head = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
+    targets = jnp.asarray(rng.integers(0, v, size=(b, s)))
+
+    full = lambda h, w: cross_entropy(h @ w.T, targets)
+    chunked = lambda h, w: chunked_lm_cross_entropy(h, w, targets, chunk_size=4)
+
+    np.testing.assert_allclose(
+        float(chunked(hidden, head)), float(full(hidden, head)), rtol=1e-6
+    )
+    g_full = jax.grad(full, argnums=(0, 1))(hidden, head)
+    g_chunk = jax.grad(chunked, argnums=(0, 1))(hidden, head)
+    for a, c in zip(g_full, g_chunk):
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a), atol=1e-5)
+
+    with pytest.raises(ValueError, match="divisible"):
+        chunked_lm_cross_entropy(hidden, head, targets, chunk_size=5)

@@ -244,3 +244,16 @@ def test_pp_stack_unstack_roundtrip():
         params,
         restored,
     )
+
+
+def test_hybrid_mesh_degenerate_and_validation():
+    from bpe_transformer_tpu.parallel import make_hybrid_mesh
+
+    # dcn all-1 degenerates to a plain ICI mesh.
+    mesh = make_hybrid_mesh({"data": 4, "model": 2})
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+
+    with pytest.raises(ValueError, match="not present"):
+        make_hybrid_mesh({"data": 8}, {"model": 2})
+    with pytest.raises(ValueError, match="needs"):
+        make_hybrid_mesh({"data": 8}, {"data": 2})

@@ -209,3 +209,34 @@ def test_moe_training_runs(byte_data):
     )
     summary = train(cfg, HP, loop, byte_data, log_fn=lambda *_: None)
     assert np.isfinite(summary["final_train_loss"])
+
+
+def test_chunked_loss_step_matches_full(byte_data):
+    """A train step with loss_chunk_size set matches the full-logits step."""
+    import jax
+
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.training.train_step import make_train_step
+
+    cfg_full = TINY
+    cfg_chunk = dataclasses.replace(TINY, loss_chunk_size=8)
+    params = init_params(jax.random.PRNGKey(0), cfg_full)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, cfg_full.vocab_size, size=(8, cfg_full.context_length))
+    y = np.roll(x, -1, axis=1)
+
+    p1, s1, m1 = make_train_step(cfg_full, HP)(
+        params, adamw_init(params), x, y
+    )
+    p2, s2, m2 = make_train_step(cfg_chunk, HP)(
+        init_params(jax.random.PRNGKey(0), cfg_chunk), None or adamw_init(params), x, y
+    )
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        p1,
+        p2,
+    )
