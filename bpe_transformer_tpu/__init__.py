@@ -18,8 +18,11 @@ import sys as _sys
 if _os.environ.get("JAX_PLATFORMS") and "jax" in _sys.modules:
     # Some containers register an accelerator PJRT plugin at interpreter
     # boot (sitecustomize) and force-select it via jax.config, which tramples
-    # the JAX_PLATFORMS env var.  Re-assert the user's explicit platform
-    # choice before any backend initializes; no-op once backends are live.
+    # the JAX_PLATFORMS env var.  Re-assert the env var's platform choice
+    # before any backend initializes; no-op once backends are live.  Code
+    # that overrides the platform programmatically (e.g. bench.py's CPU
+    # fallback) must set the env var alongside jax.config so this re-assert
+    # agrees with it.
     try:
         _sys.modules["jax"].config.update(
             "jax_platforms", _os.environ["JAX_PLATFORMS"]

@@ -26,8 +26,15 @@ Kernel structure (classic FlashAttention on the MXU):
   cos/sin tiles (``rot = x * C + swap(x) * S``) with no strided access —
   the rotated Q/K never round-trip through HBM.
 
-The backward pass recomputes attention with plain XLA ops (memory-bound but
-correct); a Pallas backward kernel is the natural next optimization.
+The backward pass is the standard FlashAttention-2 split: the forward
+additionally emits the per-row logsumexp; the backward recomputes score
+tiles in VMEM (never materializing S^2) in two kernels — dK/dV with the
+query axis innermost (accumulators live in VMEM scratch per key block) and
+dQ with the key axis innermost.  ``delta = rowsum(dO * O)`` is one cheap
+elementwise XLA pass.  For the RoPE-fused variant the backward applies the
+(orthogonal) rotation to Q/K outside the kernel — elementwise, O(S*d) — and
+un-rotates dQ/dK with the transposed rotation, so the O(S^2) part still
+never touches HBM.
 """
 
 from __future__ import annotations
@@ -63,13 +70,19 @@ def _rotate_half_layout(x, c, s, half: int):
 def _flash_kernel(
     *refs,
     scale: float, block_q: int, block_k: int, causal: bool, num_k_blocks: int,
-    rope_half: int,
+    rope_half: int, with_lse: bool,
 ):
+    refs = list(refs)
     if rope_half:
         q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref = refs[:7]
-        o_ref, acc_ref, m_ref, l_ref, qrot_ref = refs[7:]
+        del refs[:7]
     else:
-        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        q_ref, k_ref, v_ref = refs[:3]
+        del refs[:3]
+    o_ref = refs.pop(0)
+    lse_ref = refs.pop(0) if with_lse else None
+    acc_ref, m_ref, l_ref = refs[:3]
+    qrot_ref = refs[3] if rope_half else None
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -130,6 +143,12 @@ def _flash_kernel(
     def _finalize():
         denom = jnp.maximum(l_ref[:, 0:1], 1e-30)  # fully-masked rows -> 0
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        if with_lse:
+            # Per-row logsumexp for the FA-2 backward.  Under the causal
+            # mask every row sees at least its diagonal, so l > 0 and the
+            # value is finite (padded rows included).
+            lse = m_ref[:, 0:1] + jnp.log(denom)
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _xla_attention(q, k, v, causal: bool):
@@ -142,7 +161,10 @@ def _xla_attention(q, k, v, causal: bool):
     return out.astype(q.dtype)
 
 
-def _flash_impl(q, k, v, causal, block_q, block_k, interpret, cos=None, sin=None):
+def _flash_impl(
+    q, k, v, causal, block_q, block_k, interpret, cos=None, sin=None,
+    return_lse=False,
+):
     *batch, s, d = q.shape
     bh = 1
     for dim in batch:
@@ -201,6 +223,7 @@ def _flash_impl(q, k, v, causal, block_q, block_k, interpret, cos=None, sin=None
         causal=causal,
         num_k_blocks=nk,
         rope_half=(d // 2) if rope else 0,
+        with_lse=return_lse,
     )
     qspec = pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM)
@@ -218,19 +241,221 @@ def _flash_impl(q, k, v, causal, block_q, block_k, interpret, cos=None, sin=None
         operands += [ctile, stile, ctile, stile]
         scratch.append(pltpu.VMEM((block_q, d_pad), jnp.float32))  # rotated Q
 
+    out_shape = jax.ShapeDtypeStruct(qp.shape, qp.dtype)
+    out_spec = pl.BlockSpec(
+        (1, block_q, d_pad), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
+    )
+    if return_lse:
+        # lse is written lane-broadcast (LANES copies per row) so both the
+        # forward store and the backward loads stay plain (8,128)-tiled
+        # VMEM traffic — same layout trick as the m/l scratch above.
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((bh, s_pad, LANES), jnp.float32),
+        )
+        out_spec = (
+            out_spec,
+            pl.BlockSpec(
+                (1, block_q, LANES), lambda b, i, j: (b, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        )
+
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(qp.shape, qp.dtype),
+        out_shape=out_shape,
         grid=(bh, nq, nk),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (1, block_q, d_pad), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
-        ),
+        out_specs=out_spec,
         scratch_shapes=scratch,
         interpret=interpret,
     )(*operands)
 
+    if return_lse:
+        out, lse = out
+        return out[:, :s, :d].reshape(*batch, s, d), lse[:, :, 0]
     return out[:, :s, :d].reshape(*batch, s, d)
+
+
+# ------------------------------------------------- FlashAttention-2 backward
+
+
+def _bwd_score_block(q_ref, k_ref, lse_ref, scale, block_q, block_k, causal, i, j):
+    """Recompute one (block_q, block_k) probability tile from VMEM refs."""
+    qs = q_ref[0].astype(jnp.float32) * scale
+    kb = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        qs, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_k
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    # exp(NEG_INF - lse) underflows to exactly 0, so masked entries drop out.
+    p = jnp.exp(s - lse_ref[0][:, 0:1])
+    return qs, p
+
+
+def _flash_bwd_dkdv_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc,
+    *, scale, block_q, block_k, causal, num_q_blocks,
+):
+    """Grid (batch*heads, S/block_k, S/block_q): the query axis iterates
+    fastest; dK/dV accumulate in VMEM scratch per key block."""
+    j = pl.program_id(1)  # key block
+    i = pl.program_id(2)  # query block (innermost)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    compute = (block_k * j) <= (block_q * i + block_q - 1) if causal else True
+
+    @pl.when(compute)
+    def _block():
+        qs, p = _bwd_score_block(
+            q_ref, k_ref, lse_ref, scale, block_q, block_k, causal, i, j
+        )
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        # dV += P^T dO ; dS = P * (dO V^T - delta) ; dK += dS^T (Q * scale)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0][:, 0:1])
+        dk_acc[:] += jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+    dq_ref, dq_acc,
+    *, scale, block_q, block_k, causal, num_k_blocks,
+):
+    """Grid (batch*heads, S/block_q, S/block_k): the key axis iterates
+    fastest; dQ accumulates in VMEM scratch per query block."""
+    i = pl.program_id(1)  # query block
+    j = pl.program_id(2)  # key block (innermost)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    compute = (block_k * j) <= (block_q * i + block_q - 1) if causal else True
+
+    @pl.when(compute)
+    def _block():
+        _, p = _bwd_score_block(
+            q_ref, k_ref, lse_ref, scale, block_q, block_k, causal, i, j
+        )
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_ref[0][:, 0:1])
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        # S = (Q * scale) K^T, so dQ picks up the remaining scale factor.
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+    """Blockwise dQ/dK/dV: two pallas_calls, no S^2 materialization.
+
+    ``lse`` is the forward's per-row logsumexp, shape ``(batch*heads,
+    s_pad)`` in the padded sequence length.
+    """
+    *batch, s, d = q.shape
+    bh = 1
+    for dim in batch:
+        bh *= dim
+
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    block = math.lcm(block_q, block_k)
+    s_pad = pl.cdiv(s, block) * block
+    d_pad = pl.cdiv(d, LANES) * LANES
+    nq = s_pad // block_q
+    nk = s_pad // block_k
+    scale = 1.0 / (d**0.5)
+
+    def prep(x):
+        x = x.reshape(bh, s, d)
+        return jnp.pad(x, ((0, 0), (0, s_pad - s), (0, d_pad - d)))
+
+    qp, kp, vp, dop, outp = prep(q), prep(k), prep(v), prep(g), prep(out)
+    # delta = rowsum(dO * O): one elementwise pass, O(S*d).  Padded rows have
+    # dO = 0, so their delta is 0 and their dS vanishes.
+    delta = jnp.sum(dop.astype(jnp.float32) * outp.astype(jnp.float32), axis=-1)
+    # Lane-broadcast the row statistics (see the forward's lse store).
+    lane = lambda x: jnp.broadcast_to(x[:, :, None], (bh, s_pad, LANES))
+    lse_b, delta_b = lane(lse), lane(delta)
+
+    qspec = lambda im: pl.BlockSpec((1, block_q, d_pad), im, memory_space=pltpu.VMEM)
+    kspec = lambda im: pl.BlockSpec((1, block_k, d_pad), im, memory_space=pltpu.VMEM)
+    rowspec = lambda im: pl.BlockSpec((1, block_q, LANES), im, memory_space=pltpu.VMEM)
+
+    # dK/dV: grid (bh, nk, nq), query axis innermost.
+    by_q = lambda b, j, i: (b, i, 0)
+    by_k = lambda b, j, i: (b, j, 0)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkdv_kernel,
+            scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+            num_q_blocks=nq,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct(kp.shape, k.dtype),
+            jax.ShapeDtypeStruct(vp.shape, v.dtype),
+        ),
+        grid=(bh, nk, nq),
+        in_specs=[qspec(by_q), qspec(by_q), rowspec(by_q), rowspec(by_q),
+                  kspec(by_k), kspec(by_k)],
+        out_specs=(kspec(by_k), kspec(by_k)),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, dop, lse_b, delta_b, kp, vp)
+
+    # dQ: grid (bh, nq, nk), key axis innermost.
+    by_q2 = lambda b, i, j: (b, i, 0)
+    by_k2 = lambda b, i, j: (b, j, 0)
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            scale=scale, block_q=block_q, block_k=block_k, causal=causal,
+            num_k_blocks=nk,
+        ),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        grid=(bh, nq, nk),
+        in_specs=[qspec(by_q2), qspec(by_q2), rowspec(by_q2), rowspec(by_q2),
+                  kspec(by_k2), kspec(by_k2)],
+        out_specs=qspec(by_q2),
+        scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(qp, dop, lse_b, delta_b, kp, vp)
+
+    unpad = lambda x: x[:, :s, :d].reshape(*batch, s, d)
+    return unpad(dq), unpad(dk), unpad(dv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -253,14 +478,17 @@ def flash_attention(
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_impl(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_impl(
+        q, k, v, causal, block_q, block_k, interpret, return_lse=True
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(q_, k_, v_, causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_bwd_impl(
+        q, k, v, out, lse, g, causal, block_q, block_k, interpret
+    )
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -305,17 +533,46 @@ def flash_attention_with_rope(
 
 
 def _flash_rope_fwd(q, k, v, cos, sin, causal, block_q, block_k, interpret):
-    out = _flash_impl(q, k, v, causal, block_q, block_k, interpret, cos, sin)
-    return out, (q, k, v, cos, sin)
+    out, lse = _flash_impl(
+        q, k, v, causal, block_q, block_k, interpret, cos, sin, return_lse=True
+    )
+    return out, (q, k, v, cos, sin, out, lse)
 
 
 def _flash_rope_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v, cos, sin = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_, c_, s_: _xla_rope_attention(q_, k_, v_, c_, s_, causal),
-        q, k, v, cos, sin,
+    """FA-2 backward through the rotation: RoPE is orthogonal per (position,
+    pair), so rotate Q/K forward (elementwise, O(S*d)), run the blockwise
+    backward on the rotated values — scores and lse are invariant to the
+    layout permutation the forward kernel uses — then apply the transposed
+    rotation (angle negated) to dQ/dK.  cos/sin grads are computed exactly
+    from the elementwise rotation (they are non-trainable tables in the
+    model, but the vjp stays honest)."""
+    from bpe_transformer_tpu.ops.rope import apply_rope
+
+    q, k, v, cos, sin, out, lse = residuals
+    positions = jnp.arange(q.shape[-2])
+    f32 = jnp.float32
+    qr = apply_rope(q.astype(f32), positions, cos, sin).astype(q.dtype)
+    kr = apply_rope(k.astype(f32), positions, cos, sin).astype(k.dtype)
+    dqr, dkr, dv = _flash_bwd_impl(
+        qr, kr, v, out, lse, g, causal, block_q, block_k, interpret
     )
-    return vjp(g)
+    dq = apply_rope(dqr.astype(f32), positions, cos, -sin).astype(q.dtype)
+    dk = apply_rope(dkr.astype(f32), positions, cos, -sin).astype(k.dtype)
+
+    def table_grads(x, dxr):
+        # x_rot_even = x_e*c - x_o*s ; x_rot_odd = x_e*s + x_o*c  (per pair)
+        x, dxr = x.astype(f32), dxr.astype(f32)
+        xe, xo = x[..., 0::2], x[..., 1::2]
+        ge, go = dxr[..., 0::2], dxr[..., 1::2]
+        bdims = tuple(range(x.ndim - 2))
+        dcos = jnp.sum(ge * xe + go * xo, axis=bdims)
+        dsin = jnp.sum(go * xe - ge * xo, axis=bdims)
+        return dcos, dsin
+
+    dcq, dsq = table_grads(q, dqr)
+    dck, dsk = table_grads(k, dkr)
+    return dq, dk, dv, (dcq + dck).astype(cos.dtype), (dsq + dsk).astype(sin.dtype)
 
 
 flash_attention_with_rope.defvjp(_flash_rope_fwd, _flash_rope_bwd)

@@ -66,3 +66,24 @@ def chunked_lm_cross_entropy(
 
     total = lax.map(chunk_nll, (h, t)).sum()
     return total / (batch * seq)
+
+
+def lm_loss(
+    hidden: Array,
+    lm_head_w: Array,
+    targets: Array,
+    chunk_size: int | None,
+) -> Array:
+    """LM cross-entropy from final hidden states, chunking when possible.
+
+    The one shared guard for every loss path (single-device train/eval,
+    pipeline head loss, sequence-parallel shards): clamp ``chunk_size`` to
+    the actual sequence — callers may evaluate truncated inputs — and fall
+    back to full logits when the chunk doesn't divide it.
+    """
+    seq = hidden.shape[-2]
+    chunk = min(chunk_size, seq) if chunk_size else None
+    if chunk and seq % chunk == 0:
+        return chunked_lm_cross_entropy(hidden, lm_head_w, targets, chunk)
+    logits = hidden.astype(jnp.float32) @ lm_head_w.astype(jnp.float32).T
+    return cross_entropy(logits, targets)

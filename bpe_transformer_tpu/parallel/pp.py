@@ -31,8 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from bpe_transformer_tpu.models.config import ModelConfig
 from bpe_transformer_tpu.models.transformer import Params, transformer_block
-from bpe_transformer_tpu.ops.core import embedding, linear, rmsnorm
-from bpe_transformer_tpu.ops.losses import cross_entropy
+from bpe_transformer_tpu.ops.core import embedding, rmsnorm
 from bpe_transformer_tpu.ops.rope import rope_tables
 from bpe_transformer_tpu.optim.adamw import AdamWState, adamw_init, adamw_update
 from bpe_transformer_tpu.optim.schedule import cosine_schedule_jax
@@ -153,17 +152,9 @@ def _pp_loss_fn(
         def head_loss(act, targets):
             if not config.remove_rmsnorm:
                 act = rmsnorm(act, shared["ln_final"].astype(act_dtype))
-            chunk = config.loss_chunk_size
-            if chunk and act.shape[-2] % min(chunk, act.shape[-2]) == 0:
-                from bpe_transformer_tpu.ops.losses import chunked_lm_cross_entropy
+            from bpe_transformer_tpu.ops.losses import lm_loss
 
-                return chunked_lm_cross_entropy(
-                    act, shared["lm_head"], targets, min(chunk, act.shape[-2])
-                )
-            logits = linear(
-                act.astype(jnp.float32), shared["lm_head"].astype(jnp.float32)
-            )
-            return cross_entropy(logits, targets)
+            return lm_loss(act, shared["lm_head"], targets, config.loss_chunk_size)
 
         fwd_perm = [(i, (i + 1) % pp_size) for i in range(pp_size)]
         ticks = num_micro + pp_size - 1
@@ -237,6 +228,15 @@ def make_pp_train_step(
     :func:`jax.eval_shape`-compatible :func:`~bpe_transformer_tpu.optim.
     adamw.adamw_init` over it.
     """
+    if config.ffn_type == "moe":
+        # The pipeline stage applies the aux-free transformer_block: running
+        # a MoE config here would silently drop the router load-balance loss
+        # and let routing collapse unregularized.  Fail as loudly as the
+        # training loop does.
+        raise NotImplementedError(
+            "pipeline parallelism does not yet thread the MoE router aux "
+            'loss; use strategy "dp_ep" for ffn_type="moe"'
+        )
     if pp_axis not in mesh.shape:
         raise ValueError(f"mesh {dict(mesh.shape)} lacks axis {pp_axis!r}")
     pp_size = mesh.shape[pp_axis]

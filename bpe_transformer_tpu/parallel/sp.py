@@ -26,7 +26,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from bpe_transformer_tpu.models.config import ModelConfig
 from bpe_transformer_tpu.models.transformer import forward
 from bpe_transformer_tpu.ops.grad import clip_by_global_norm
-from bpe_transformer_tpu.ops.losses import cross_entropy
 from bpe_transformer_tpu.optim.adamw import AdamWState, adamw_update
 from bpe_transformer_tpu.optim.schedule import cosine_schedule_jax
 from bpe_transformer_tpu.parallel.ring_attention import ring_self_attention
@@ -71,27 +70,21 @@ def make_sp_train_step(
 
     def local_step(params, opt_state: AdamWState, x, y):
         def loss_fn(p):
-            # Memory-lean loss: honor loss_chunk_size on the LOCAL sequence
-            # shard when it divides evenly (the shard is already seq/N long).
-            chunk = config.loss_chunk_size
-            s_local = x.shape[-1]
-            if chunk and s_local % min(chunk, s_local) == 0:
-                from bpe_transformer_tpu.models.transformer import forward_hidden
-                from bpe_transformer_tpu.ops.losses import chunked_lm_cross_entropy
+            # Memory-lean loss on the LOCAL sequence shard (already seq/N
+            # long); lm_loss applies the shared clamp/divisibility guard.
+            from bpe_transformer_tpu.models.transformer import forward_hidden
+            from bpe_transformer_tpu.ops.losses import lm_loss
 
-                offset = jax.lax.axis_index(seq_axis) * s_local
-                positions = offset + jnp.arange(s_local)
-                attention_fn = partial(
-                    ring_self_attention, axis_name=seq_axis, causal=True
-                )
-                hidden, _ = forward_hidden(
-                    p, x, config, positions=positions, attention_fn=attention_fn
-                )
-                return chunked_lm_cross_entropy(
-                    hidden, p["lm_head"], y, min(chunk, s_local)
-                )
-            logits = sp_forward(p, x, config, seq_axis)
-            return cross_entropy(logits, y)
+            s_local = x.shape[-1]
+            offset = jax.lax.axis_index(seq_axis) * s_local
+            positions = offset + jnp.arange(s_local)
+            attention_fn = partial(
+                ring_self_attention, axis_name=seq_axis, causal=True
+            )
+            hidden, _ = forward_hidden(
+                p, x, config, positions=positions, attention_fn=attention_fn
+            )
+            return lm_loss(hidden, p["lm_head"], y, config.loss_chunk_size)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         # Equal-size shards: the global mean is the mean of shard means.

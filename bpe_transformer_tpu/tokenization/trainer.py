@@ -28,6 +28,11 @@ from pathlib import Path
 from bpe_transformer_tpu.settings import DEFAULT_OUTPUT_DIR, ENCODING
 from bpe_transformer_tpu.tokenization.pretokenization import Pretoken, count_pretokens
 
+#: Streaming read size and the pending-buffer cap that triggers the exact
+#: incremental add_prefix flush (module-level so tests can shrink them).
+STREAM_CHUNK_CHARS = 1 << 22
+PENDING_FLUSH_CHARS = 1 << 26
+
 Pair = tuple[int, int]
 
 
@@ -166,7 +171,7 @@ class BPETrainer:
                 max_keep = max(len(s) for s in specials) - 1
                 pending = ""
                 while True:
-                    chunk = f.read(1 << 22)
+                    chunk = f.read(STREAM_CHUNK_CHARS)
                     if not chunk:
                         break
                     pending += chunk
@@ -174,7 +179,17 @@ class BPETrainer:
                     if cut > 0:
                         feed(pending[:cut])
                         pending = pending[cut:]
-                    elif len(pending) > (1 << 26):
+                    elif len(pending) > PENDING_FLUSH_CHARS:
+                        if cut == 0:
+                            # The only special occurrence sits at index 0:
+                            # strip it (training=True discards specials) so
+                            # its bytes never reach add_prefix as ordinary
+                            # text.  Longest-first mirrors
+                            # split_on_special_tokens' overlap handling.
+                            for s in sorted(specials, key=len, reverse=True):
+                                if pending.startswith(s):
+                                    pending = pending[len(s):]
+                                    break
                         # No special in sight: keep memory bounded by exact
                         # token streaming, retaining enough characters to
                         # cover a special straddling the boundary.
@@ -193,7 +208,7 @@ class BPETrainer:
                 # input, and returns the undecided tail to carry over.
                 tail = b""
                 while True:
-                    chunk = f.read(1 << 22)
+                    chunk = f.read(STREAM_CHUNK_CHARS)
                     if not chunk:
                         break
                     data = tail + chunk.encode(ENCODING)

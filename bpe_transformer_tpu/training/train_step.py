@@ -41,13 +41,11 @@ def make_loss_fn(config: ModelConfig) -> Callable:
 
     if config.loss_chunk_size:
         from bpe_transformer_tpu.models.transformer import forward_hidden
-        from bpe_transformer_tpu.ops.losses import chunked_lm_cross_entropy
+        from bpe_transformer_tpu.ops.losses import lm_loss
 
         def loss_fn(params, x, y):
             hidden, aux = forward_hidden(params, x, config)
-            loss = chunked_lm_cross_entropy(
-                hidden, params["lm_head"], y, config.loss_chunk_size
-            )
+            loss = lm_loss(hidden, params["lm_head"], y, config.loss_chunk_size)
             if is_moe:
                 loss = loss + config.router_aux_weight * aux
             return loss
@@ -126,13 +124,11 @@ def make_eval_step(config: ModelConfig) -> Callable:
 
     if config.loss_chunk_size:
         from bpe_transformer_tpu.models.transformer import forward_hidden
-        from bpe_transformer_tpu.ops.losses import chunked_lm_cross_entropy
+        from bpe_transformer_tpu.ops.losses import lm_loss
 
         def eval_loss(params, x, y):
             hidden, _ = forward_hidden(params, x, config)
-            return chunked_lm_cross_entropy(
-                hidden, params["lm_head"], y, config.loss_chunk_size
-            )
+            return lm_loss(hidden, params["lm_head"], y, config.loss_chunk_size)
 
     else:
 

@@ -1,0 +1,85 @@
+"""Model-FLOPs accounting and MFU (model FLOPs utilization).
+
+The reference publishes no FLOPs math (its perf numbers are notebook
+wall-clocks, SURVEY §6); on TPU the meaningful single-chip metric is
+MFU = achieved model FLOPs/sec / peak chip FLOPs/sec.  This module provides
+the standard decoder-transformer estimate (the "6ND + attention" rule):
+
+    forward FLOPs   = 2 * N_matmul * tokens  +  4 * L * S * d_model * tokens
+    training FLOPs  = 3 * forward            (backward ~ 2x forward)
+
+where ``N_matmul`` counts parameters that participate in dense matmuls
+(attention/FFN projections and the LM head; the embedding gather is
+bandwidth, not FLOPs) and the second term is the attention score/value
+einsums (QK^T and AV, 2 matmuls of 2*S*d FLOPs per token per layer).
+"""
+
+from __future__ import annotations
+
+from bpe_transformer_tpu.models.config import ModelConfig
+
+#: Peak dense FLOPs/sec per chip, bf16, by device_kind substring.  Sources:
+#: public TPU spec sheets (v4 275 TF, v5e 197 TF, v5p 459 TF, v6e 918 TF,
+#: v3 123 TF per chip).  Matching is substring-based on
+#: ``jax.devices()[0].device_kind`` (e.g. "TPU v4").
+_PEAK_FLOPS_BY_KIND: tuple[tuple[str, float], ...] = (
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+)
+
+
+def matmul_param_count(config: ModelConfig) -> int:
+    """Parameters participating in dense matmuls (excludes embedding gather)."""
+    d, ff, L = config.d_model, config.d_ff, config.num_layers
+    attn = 4 * d * d  # q, k, v, output projections
+    if config.ffn_type == "moe":
+        # Per-token compute is one expert (top-1 Switch routing): the dense
+        # FLOPs seen by a token are a single expert's SwiGLU FFN (w1/w2/w3,
+        # models/moe.py init_moe_params) + the router projection.
+        ffn = 3 * d * ff + d * config.n_experts
+    elif config.ffn_type in ("silu", "gelu"):
+        ffn = 2 * d * ff
+    else:  # SwiGLU: w1, w3 (d->ff) and w2 (ff->d)
+        ffn = 3 * d * ff
+    lm_head = d * config.vocab_size
+    return L * (attn + ffn) + lm_head
+
+
+def train_step_flops(config: ModelConfig, batch: int, seq: int | None = None) -> float:
+    """Model FLOPs of one full training step (fwd + bwd) at the given shape."""
+    S = seq or config.context_length
+    tokens = batch * S
+    matmul = 2.0 * matmul_param_count(config) * tokens
+    attention = 4.0 * config.num_layers * S * config.d_model * tokens
+    return 3.0 * (matmul + attention)
+
+
+def peak_flops_per_chip(device_kind: str) -> float | None:
+    """Peak bf16 FLOPs/sec for a TPU device_kind string, or None if unknown."""
+    kind = device_kind.lower()
+    for pattern, peak in _PEAK_FLOPS_BY_KIND:
+        if pattern in kind:
+            return peak
+    return None
+
+
+def mfu(
+    config: ModelConfig,
+    batch: int,
+    step_time_s: float,
+    device_kind: str,
+    n_chips: int = 1,
+    seq: int | None = None,
+) -> float | None:
+    """Model FLOPs utilization in [0, 1], or None when the peak is unknown."""
+    peak = peak_flops_per_chip(device_kind)
+    if peak is None or step_time_s <= 0:
+        return None
+    achieved = train_step_flops(config, batch, seq) / step_time_s
+    return achieved / (peak * max(n_chips, 1))

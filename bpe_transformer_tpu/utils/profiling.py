@@ -75,16 +75,27 @@ def time_fn(
 
 
 class StepTimer:
-    """Windowed throughput counter: tokens/sec and tokens/sec/chip.
+    """Windowed throughput counter: tokens/sec, tokens/sec/chip, and MFU.
 
     ``update(n_tokens)`` after every step; ``snapshot()`` returns the rates
     over the window since the last snapshot and resets it. The training loop
     reads a device metric (its own sync point) before calling ``snapshot``,
     so these rates include real device time, not just dispatch time.
+
+    Pass ``flops_per_token`` (training FLOPs per token, e.g.
+    ``flops.train_step_flops(cfg, B) / (B * S)``) to get model-FLOPs
+    utilization in the snapshot; it is None when the device's peak FLOPs
+    are unknown (CPU, unrecognized TPU generation).
     """
 
-    def __init__(self, n_chips: int = 1):
+    def __init__(self, n_chips: int = 1, flops_per_token: float | None = None):
         self.n_chips = max(n_chips, 1)
+        self.flops_per_token = flops_per_token
+        self._peak_flops: float | None = None
+        if flops_per_token is not None:
+            from bpe_transformer_tpu.utils.flops import peak_flops_per_chip
+
+            self._peak_flops = peak_flops_per_chip(jax.devices()[0].device_kind)
         self._window_start = time.perf_counter()
         self._window_tokens = 0
         self.total_tokens = 0
@@ -103,6 +114,9 @@ class StepTimer:
             "window_seconds": elapsed,
             "window_tokens": self._window_tokens,
         }
+        if self.flops_per_token is not None and self._peak_flops is not None:
+            achieved = tok_per_sec * self.flops_per_token / self.n_chips
+            out["mfu"] = achieved / self._peak_flops
         self._window_start = now
         self._window_tokens = 0
         return out

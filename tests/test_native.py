@@ -235,6 +235,28 @@ def test_trainer_native_matches_python_multichunk(tmp_path):
     assert tn.merges == tp.merges
 
 
+def test_trainer_native_leading_special_long_gap(tmp_path, monkeypatch):
+    """A special token at index 0 of the pending buffer followed by a
+    special-free run longer than the flush threshold must not leak the
+    special's bytes into the pre-token counts (the add_prefix fallback
+    path)."""
+    from bpe_transformer_tpu.tokenization import trainer as trainer_mod
+
+    monkeypatch.setattr(trainer_mod, "STREAM_CHUNK_CHARS", 64)
+    monkeypatch.setattr(trainer_mod, "PENDING_FLUSH_CHARS", 256)
+    corpus = tmp_path / "lead.txt"
+    # Starts with the special, then >256 chars with no special at all.
+    corpus.write_text(
+        "<|endoftext|>" + "the quick brown fox story goes on. " * 40
+        + "\n<|endoftext|>tail doc\n",
+        encoding="utf-8",
+    )
+    tn = _native_trainer(300, ["<|endoftext|>"], corpus)
+    tp = _python_trainer(300, ["<|endoftext|>"], corpus)
+    assert tn.merges == tp.merges
+    assert tn.vocab == tp.vocab
+
+
 def test_counter_add_prefix_streaming_matches_single_shot():
     from bpe_transformer_tpu.native.engine import NativePretokenCounter
 
