@@ -178,15 +178,15 @@ def bench_jax(platform: str) -> None:
     )
 
 
-def bench_torch_cpu(measure_steps: int) -> float:
-    """The identical model + update in PyTorch on the host CPU (the
+def make_torch_lm(C):
+    """The identical model + update step in PyTorch on the host CPU (the
     reference's execution substrate; it defines this architecture via its
     test contract, `/root/reference/tests/adapters.py:282-361`, but never
-    ships a training loop)."""
+    ships a training loop).  Returns ``(model, train_step(ids, labels),
+    eval_loss(ids, labels))`` — shared by this benchmark and
+    benchmarks/val_parity.py."""
     import torch
     import torch.nn.functional as F
-
-    from bpe_transformer_tpu.models import TINYSTORIES_4L as C
 
     torch.manual_seed(0)
     dh = C.d_model // C.num_heads
@@ -249,22 +249,40 @@ def bench_torch_cpu(measure_steps: int) -> float:
     cos, sin = torch.cos(ang), torch.sin(ang)
     mask = torch.tril(torch.ones(s, s, dtype=torch.bool))
 
-    rng = np.random.default_rng(0)
-    ids = torch.from_numpy(rng.integers(0, C.vocab_size, size=(BATCH, s)))
-    labels = torch.roll(ids, -1, dims=1)
-
-    def one_step():
+    def train_step(ids, labels):
         opt.zero_grad()
         logits = model(ids, cos, sin, mask)
         loss = F.cross_entropy(logits.view(-1, C.vocab_size), labels.view(-1))
         loss.backward()
         torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
         opt.step()
+        return float(loss.detach())
 
-    one_step()  # warmup
+    @torch.no_grad()
+    def eval_loss(ids, labels):
+        logits = model(ids, cos, sin, mask)
+        return float(
+            F.cross_entropy(logits.view(-1, C.vocab_size), labels.view(-1))
+        )
+
+    return model, train_step, eval_loss
+
+
+def bench_torch_cpu(measure_steps: int) -> float:
+    import torch
+
+    from bpe_transformer_tpu.models import TINYSTORIES_4L as C
+
+    _, train_step, _ = make_torch_lm(C)
+    s = C.context_length
+    rng = np.random.default_rng(0)
+    ids = torch.from_numpy(rng.integers(0, C.vocab_size, size=(BATCH, s)))
+    labels = torch.roll(ids, -1, dims=1)
+
+    train_step(ids, labels)  # warmup
     start = time.perf_counter()
     for _ in range(measure_steps):
-        one_step()
+        train_step(ids, labels)
     elapsed = time.perf_counter() - start
     return measure_steps * BATCH * s / elapsed
 

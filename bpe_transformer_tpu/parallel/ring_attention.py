@@ -12,9 +12,22 @@ granularity).  After ``axis_size`` steps every query has attended to every
 key with O(S_local) memory per device: sequence length scales linearly with
 the number of chips.
 
-Causal masking uses global positions; blocks strictly above a query shard's
-diagonal are folded in as no-ops via a predicated select (the classic ring
-load-imbalance — a zig-zag schedule is the known follow-up optimization).
+Two schedules:
+
+* :func:`ring_self_attention` — contiguous shards.  Causal masking uses
+  global positions; blocks strictly above a query shard's diagonal are
+  folded in as no-ops via a predicated select, so under causal masking the
+  ring is load-imbalanced (device 0 needs 1 block, device n-1 needs n) and
+  every device still computes every visiting block.
+* :func:`zigzag_ring_self_attention` — striped ("zig-zag") shards: the
+  sequence is cut into ``2n`` chunks and device ``i`` holds chunks
+  ``(i, 2n-1-i)``, giving every device exactly ``2n+1`` visible
+  chunk-pair sub-blocks.  Each ring step then computes two half-size
+  products instead of one full block: per-device causal FLOPs drop from
+  ``n`` blocks to ``(2n+1)/4`` block-equivalents (~2x at large n) and the
+  work is identical on every device, so no one waits on the last rank.
+  Callers lay data out with :func:`zigzag_indices` and position tables
+  with :func:`zigzag_positions` (RoPE must see true global positions).
 """
 
 from __future__ import annotations
@@ -93,6 +106,132 @@ def ring_self_attention(
             v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
 
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+# ----------------------------------------------------- zig-zag schedule
+
+
+def zigzag_indices(seq_len: int, n_shards: int) -> jnp.ndarray:
+    """Global token order for zig-zag sharding.
+
+    Returns ``perm`` such that ``x[..., perm, :]`` (or ``ids[..., perm]``)
+    laid out contiguously gives shard ``i`` the chunks ``(i, 2n-1-i)`` of
+    the original sequence.  ``seq_len`` must divide by ``2 * n_shards``.
+    """
+    if seq_len % (2 * n_shards):
+        raise ValueError(
+            f"zig-zag needs seq_len ({seq_len}) divisible by 2*n_shards "
+            f"({2 * n_shards})"
+        )
+    c = seq_len // (2 * n_shards)
+    parts = []
+    for i in range(n_shards):
+        parts.append(jnp.arange(i * c, (i + 1) * c))
+        parts.append(jnp.arange((2 * n_shards - 1 - i) * c, (2 * n_shards - i) * c))
+    return jnp.concatenate(parts)
+
+
+def zigzag_inverse_indices(seq_len: int, n_shards: int) -> jnp.ndarray:
+    """Inverse permutation: maps zig-zag layout back to global order."""
+    perm = zigzag_indices(seq_len, n_shards)
+    return jnp.argsort(perm)
+
+
+def zigzag_positions(axis_index, s_local: int, n_shards: int) -> jnp.ndarray:
+    """Global positions of this shard's tokens (for RoPE), inside shard_map."""
+    c = s_local // 2
+    lo = axis_index * c + jnp.arange(c)
+    hi = (2 * n_shards - 1 - axis_index) * c + jnp.arange(c)
+    return jnp.concatenate([lo, hi])
+
+
+def zigzag_ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """Causal ring attention over zig-zag shards; call INSIDE shard_map.
+
+    Per-device layout: ``(..., S_local, D)`` where the first ``S_local/2``
+    rows are global chunk ``me`` and the rest chunk ``2n-1-me`` (produce it
+    with :func:`zigzag_indices`).  Exact same math as the contiguous ring,
+    but per step each device computes two half-size score blocks that are
+    both fully visible by construction:
+
+    * step 0 (own K/V): ``qa@ka`` (triangular), ``qb@ka`` (full),
+      ``qb@kb`` (triangular) — the only step with any masking;
+    * step s>0 with source shard ``src``: if ``src < me`` the visible work
+      is ``(qa+qb) @ ka``, else ``qb @ (ka+kb)`` — either way two ``(c, c)``
+      products, selected by operand (same SPMD program on every device).
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    s_local = q.shape[-2]
+    if s_local % 2:
+        raise ValueError(f"zig-zag local length must be even, got {s_local}")
+    c = s_local // 2
+    d = q.shape[-1]
+    scale = 1.0 / (d**0.5)
+
+    split = lambda x: (x[..., :c, :], x[..., c:, :])
+    qa, qb = split(q.astype(jnp.float32) * scale)
+    stat = lambda: (
+        jnp.full((*qa.shape[:-1], 1), NEG_INF, jnp.float32),
+        jnp.zeros((*qa.shape[:-1], 1), jnp.float32),
+        jnp.zeros(qa.shape, jnp.float32),
+    )
+    # Independent online-softmax state per local chunk.
+    state_a, state_b = stat(), stat()
+
+    def fold(state, scores, v_blk):
+        m, l, acc = state
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "...qk,...kv->...qv", p, v_blk.astype(jnp.float32)
+        )
+        return m_new, l_new, acc_new
+
+    dots = lambda qq, kk: jnp.einsum("...qd,...kd->...qk", qq, kk.astype(jnp.float32))
+    tri = jnp.arange(c)[:, None] >= jnp.arange(c)[None, :]
+
+    # Step 0: own K/V — the diagonal step.
+    ka, kb = split(k)
+    va, vb = split(v)
+    state_a = fold(state_a, jnp.where(tri, dots(qa, ka), NEG_INF), va)
+    state_b = fold(state_b, dots(qb, ka), va)
+    state_b = fold(state_b, jnp.where(tri, dots(qb, kb), NEG_INF), vb)
+
+    k_cur, v_cur = k, v
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(1, n):
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (me - step) % n
+        early = src < me  # the visiting shard's low chunk precedes ours
+        ka, kb = split(k_cur)
+        va, vb = split(v_cur)
+
+        # Product 1: (early ? qa : qb) @ ka — select the state in, fold
+        # once, scatter back (selects are elementwise; the fold's two
+        # matmuls run once).
+        q_sel = jnp.where(early, qa, qb)
+        st_in = tuple(jnp.where(early, a_, b_) for a_, b_ in zip(state_a, state_b))
+        folded = fold(st_in, dots(q_sel, ka), va)
+        state_a = tuple(jnp.where(early, f_, a_) for f_, a_ in zip(folded, state_a))
+        state_b = tuple(jnp.where(early, b_, f_) for f_, b_ in zip(folded, state_b))
+
+        # Product 2: qb @ (early ? ka : kb).
+        k_sel = jnp.where(early, ka, kb)
+        v_sel = jnp.where(early, va, vb)
+        state_b = fold(state_b, dots(qb, k_sel), v_sel)
+
+    finish = lambda st: st[2] / jnp.maximum(st[1], 1e-30)
+    out = jnp.concatenate([finish(state_a), finish(state_b)], axis=-2)
+    return out.astype(q.dtype)
 
 
 def make_ring_attention(mesh: Mesh, axis: str = "data", causal: bool = True):

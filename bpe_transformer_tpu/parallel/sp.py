@@ -28,7 +28,12 @@ from bpe_transformer_tpu.models.transformer import forward
 from bpe_transformer_tpu.ops.grad import clip_by_global_norm
 from bpe_transformer_tpu.optim.adamw import AdamWState, adamw_update
 from bpe_transformer_tpu.optim.schedule import cosine_schedule_jax
-from bpe_transformer_tpu.parallel.ring_attention import ring_self_attention
+from bpe_transformer_tpu.parallel.ring_attention import (
+    ring_self_attention,
+    zigzag_indices,
+    zigzag_positions,
+    zigzag_ring_self_attention,
+)
 from bpe_transformer_tpu.training.train_step import TrainHParams
 
 P = PartitionSpec
@@ -60,13 +65,19 @@ def make_sp_train_step(
     mesh: Mesh,
     data_axis: str = "data",
     seq_axis: str = "seq",
+    zigzag: bool = False,
 ) -> Callable:
     """Train step over a 2-D (data x seq) mesh: batch split on ``data``,
     every sequence split on ``seq``; params/opt-state replicated.
 
     The global batch must divide the data axis and ``context_length`` must
-    divide the seq axis.
+    divide the seq axis.  With ``zigzag=True`` the causal ring runs the
+    balanced striped schedule (~2x less attention work at large mesh sizes);
+    feed batches through :func:`shard_sp_batch` with ``zigzag=True`` so the
+    on-device layout matches, and note positions/loss are permutation-
+    consistent (targets ride the same permutation as inputs).
     """
+    n_seq = mesh.shape[seq_axis]
 
     def local_step(params, opt_state: AdamWState, x, y):
         def loss_fn(p):
@@ -76,11 +87,19 @@ def make_sp_train_step(
             from bpe_transformer_tpu.ops.losses import lm_loss
 
             s_local = x.shape[-1]
-            offset = jax.lax.axis_index(seq_axis) * s_local
-            positions = offset + jnp.arange(s_local)
-            attention_fn = partial(
-                ring_self_attention, axis_name=seq_axis, causal=True
-            )
+            if zigzag:
+                positions = zigzag_positions(
+                    jax.lax.axis_index(seq_axis), s_local, n_seq
+                )
+                attention_fn = partial(
+                    zigzag_ring_self_attention, axis_name=seq_axis
+                )
+            else:
+                offset = jax.lax.axis_index(seq_axis) * s_local
+                positions = offset + jnp.arange(s_local)
+                attention_fn = partial(
+                    ring_self_attention, axis_name=seq_axis, causal=True
+                )
             hidden, _ = forward_hidden(
                 p, x, config, positions=positions, attention_fn=attention_fn
             )
@@ -122,7 +141,22 @@ def make_sp_train_step(
     return jax.jit(mapped, donate_argnums=(0, 1))
 
 
-def shard_sp_batch(batch, mesh: Mesh, data_axis: str = "data", seq_axis: str = "seq"):
-    """Place ``(B, S)`` batch arrays split over (data, seq)."""
+def shard_sp_batch(
+    batch,
+    mesh: Mesh,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+    zigzag: bool = False,
+):
+    """Place ``(B, S)`` batch arrays split over (data, seq).
+
+    ``zigzag=True`` permutes the sequence axis into the striped layout
+    (shard ``i`` gets global chunks ``(i, 2n-1-i)``) before placement, for
+    :func:`make_sp_train_step`'s balanced schedule.
+    """
+    if zigzag:
+        n = mesh.shape[seq_axis]
+        perm = zigzag_indices(jax.tree_util.tree_leaves(batch)[0].shape[-1], n)
+        batch = jax.tree_util.tree_map(lambda a: a[..., perm], batch)
     sharding = NamedSharding(mesh, P(data_axis, seq_axis))
     return jax.device_put(batch, sharding)

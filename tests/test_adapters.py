@@ -67,6 +67,27 @@ def test_sdpa_adapter_matches_reference_snapshot(reference_snapshots):
     np.testing.assert_allclose(out.numpy(), expected, atol=1e-6, rtol=1e-4)
 
 
+def test_4d_sdpa_adapter_matches_reference_snapshot(reference_snapshots):
+    """Replays `test_4d_scaled_dot_product_attention.npz` with the seeded
+    fixtures of `/root/reference/tests/test_model.py:65-74` (the (batch*head)
+    leading dim split into (batch=2, head=2))."""
+    expected = dict(
+        np.load(reference_snapshots / "test_4d_scaled_dot_product_attention.npz")
+    )["array"]
+    torch.manual_seed(1)
+    q = torch.randn(4, 12, 64)
+    torch.manual_seed(2)
+    k = torch.randn(4, 16, 64)
+    torch.manual_seed(3)
+    v = torch.randn(4, 16, 64)
+    torch.manual_seed(5)
+    mask = torch.randn(4, 12, 16) > 0.5
+    q, k, v = (t.reshape(2, 2, *t.shape[1:]) for t in (q, k, v))
+    mask = mask.reshape(2, 2, 12, 16)
+    out = run_scaled_dot_product_attention(q, k, v, mask)
+    np.testing.assert_allclose(out.numpy(), expected, atol=1e-6, rtol=1e-4)
+
+
 def test_rope_adapter_matches_reference_snapshot(reference_snapshots):
     expected = dict(np.load(reference_snapshots / "test_rope.npz"))["array"]
     torch.manual_seed(4)
@@ -165,6 +186,19 @@ def test_adamw_cls_matches_torch():
     assert torch.allclose(actual, expected, atol=1e-4)
 
 
+def test_adamw_matches_torch_or_reference_snapshot(reference_snapshots):
+    """Replays `test_adamw.npz` with the reference's equivalence-class
+    semantics (`/root/reference/tests/test_optimizer.py:29-49`): the 1000-step
+    trace must match torch AdamW *or* the pinned reference weights (the two
+    differ in weight-decay application order at float32 resolution)."""
+    actual = _optimize(get_adamw_cls())
+    pytorch_weights = _optimize(torch.optim.AdamW)
+    if torch.allclose(actual, pytorch_weights, atol=1e-4):
+        return
+    expected = dict(np.load(reference_snapshots / "test_adamw.npz"))["array"]
+    np.testing.assert_allclose(actual.numpy(), expected, atol=1e-4)
+
+
 def test_lr_schedule_adapter():
     assert run_get_lr_cosine_schedule(0, 1.0, 0.1, 7, 21) == 0
     assert run_get_lr_cosine_schedule(7, 1.0, 0.1, 7, 21) == 1.0
@@ -240,3 +274,32 @@ def test_train_bpe_and_tokenizer_adapters(tiny_corpus):
     tok = get_tokenizer(vocab, merges, ["<|endoftext|>"])
     text = "the quick brown fox<|endoftext|>"
     assert tok.decode(tok.encode(text)) == text
+
+
+def test_train_bpe_special_tokens_reference_snapshot(reference_snapshots):
+    """Replays `test_train_bpe_special_tokens.pkl`
+    (`/root/reference/tests/test_train_bpe.py:66-89`).  The snapshot itself
+    is always validated; the full training replay needs the 5 MB corpus,
+    which the mounted reference lists in `.MISSING_LARGE_BLOBS` — when a
+    checkout supplies it, the parity assertion runs."""
+    import pickle
+
+    with open(reference_snapshots / "test_train_bpe_special_tokens.pkl", "rb") as f:
+        expected = pickle.load(f)
+    assert set(expected) >= {"vocab_keys", "vocab_values", "merges"}
+    assert expected["vocab_keys"] == set(range(1000))
+    assert b"<|endoftext|>" in expected["vocab_values"]
+    assert len(expected["merges"]) == 1000 - 256 - 1  # byte vocab + special
+
+    corpus = (
+        reference_snapshots.parent / "fixtures" / "tinystories_sample_5M.txt"
+    )
+    if not corpus.is_file():
+        pytest.skip("tinystories_sample_5M.txt absent (.MISSING_LARGE_BLOBS)")
+    vocab, merges = run_train_bpe(corpus, 1000, ["<|endoftext|>"])
+    for word in vocab.values():
+        if word != b"<|endoftext|>":
+            assert b"<|" not in word
+    assert set(vocab.keys()) == expected["vocab_keys"]
+    assert set(vocab.values()) == expected["vocab_values"]
+    assert merges == expected["merges"]

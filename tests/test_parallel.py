@@ -257,3 +257,86 @@ def test_hybrid_mesh_degenerate_and_validation():
         make_hybrid_mesh({"data": 8}, {"model": 2})
     with pytest.raises(ValueError, match="needs"):
         make_hybrid_mesh({"data": 8}, {"data": 2})
+
+
+# ------------------------------------------------- zig-zag ring attention
+
+
+def test_zigzag_ring_attention_matches_xla_and_ring():
+    """Balanced zig-zag schedule == materialized causal attention == the
+    contiguous ring, after the layout permutation round-trip."""
+    from functools import partial
+
+    from bpe_transformer_tpu.ops.core import causal_mask, scaled_dot_product_attention
+    from bpe_transformer_tpu.parallel.ring_attention import (
+        ring_self_attention,
+        zigzag_indices,
+        zigzag_inverse_indices,
+        zigzag_ring_self_attention,
+    )
+
+    n = 8
+    B, H, S, D = 2, 2, 64, 16
+    mesh = make_mesh({"seq": n})
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, H, S, D)).astype(np.float32))
+        for _ in range(3)
+    )
+    expected = scaled_dot_product_attention(q, k, v, causal_mask(S))
+
+    spec = PartitionSpec(None, None, "seq", None)
+    ring = jax.shard_map(
+        partial(ring_self_attention, axis_name="seq", causal=True),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+    )
+    np.testing.assert_allclose(np.asarray(ring(q, k, v)), np.asarray(expected), atol=1e-5)
+
+    perm = zigzag_indices(S, n)
+    inv = zigzag_inverse_indices(S, n)
+    zig = jax.shard_map(
+        partial(zigzag_ring_self_attention, axis_name="seq"),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+    )
+    out_zig = zig(q[..., perm, :], k[..., perm, :], v[..., perm, :])[..., inv, :]
+    np.testing.assert_allclose(np.asarray(out_zig), np.asarray(expected), atol=1e-5)
+
+
+def test_zigzag_positions_cover_sequence():
+    from bpe_transformer_tpu.parallel.ring_attention import (
+        zigzag_indices,
+        zigzag_positions,
+    )
+
+    n, S = 4, 64
+    all_pos = jnp.concatenate(
+        [zigzag_positions(i, S // n, n) for i in range(n)]
+    )
+    assert sorted(np.asarray(all_pos).tolist()) == list(range(S))
+    # positions agree with the layout permutation
+    np.testing.assert_array_equal(np.asarray(all_pos), np.asarray(zigzag_indices(S, n)))
+
+
+def test_sp_zigzag_step_matches_single_device():
+    """Zig-zag context-parallel step == single-device step: the permutation
+    is transparent to the loss (targets ride the same layout)."""
+    from bpe_transformer_tpu.parallel import make_sp_train_step, shard_sp_batch
+
+    params, opt_state, x, y = _setup()
+    single = make_train_step(CFG, HP)
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    params2, opt_state2, x2, y2 = _setup()
+    step = make_sp_train_step(CFG, HP, mesh, zigzag=True)
+    x2, y2 = shard_sp_batch((x2, y2), mesh, zigzag=True)
+    p2, s2, m2 = step(params2, opt_state2, x2, y2)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        p1,
+        p2,
+    )
