@@ -9,6 +9,9 @@ from __future__ import annotations
 import json
 import sys
 import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import numpy as np
 
@@ -24,7 +27,6 @@ from bpe_transformer_tpu.ops.rope import apply_rope, rope_tables
 
 BATCH, HEADS, D_HEAD = 1, 8, 64
 SEQ_LENS = (1024, 4096, 16384)
-ITERS = 20
 
 
 def _sync(x) -> float:
@@ -32,15 +34,29 @@ def _sync(x) -> float:
     return float(jax.device_get(x.reshape(-1)[0]))
 
 
-def _bench(fn, *args) -> float:
-    jitted = jax.jit(fn)
-    _sync(jitted(*args))
-    start = time.perf_counter()
-    out = None
-    for _ in range(ITERS):
-        out = jitted(*args)
-    _sync(out)
-    return (time.perf_counter() - start) / ITERS
+def _bench(fn, *args, iters: int = 10) -> float | None:
+    """Mean seconds/call, or None when the case can't run (e.g. the XLA
+    materialized path OOMing at seq 16k — which is the point of flash)."""
+    try:
+        jitted = jax.jit(fn)
+        _sync(jitted(*args))
+        start = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = jitted(*args)
+        _sync(out)
+        return (time.perf_counter() - start) / iters
+    except Exception as exc:  # noqa: BLE001 - report the case as absent
+        print(f"case failed: {exc!r}"[:300], file=sys.stderr)
+        return None
+
+
+def _ms(t: float | None):
+    return round(t * 1e3, 3) if t is not None else None
+
+
+def _ratio(a: float | None, b: float | None):
+    return round(a / b, 2) if a and b else None
 
 
 def main() -> int:
@@ -64,31 +80,82 @@ def main() -> int:
             return fn
 
         cos_s, sin_s = cos[:seq], sin[:seq]
-        t_xla = _bench(roped(lambda q, k, v: _xla_attention(q, k, v, True)), q, k, v)
+        iters = 10 if seq < 16384 else 3
+        t_xla = _bench(
+            roped(lambda q, k, v: _xla_attention(q, k, v, True)), q, k, v,
+            iters=iters,
+        )
         t_flash = _bench(
             roped(
                 lambda q, k, v: flash_attention(q, k, v, True, 512, 512, not on_tpu)
             ),
             q, k, v,
+            iters=iters,
         )
         t_fused = _bench(
             lambda q, k, v: flash_attention_with_rope(
                 q, k, v, cos_s, sin_s, True, 512, 512, not on_tpu
             ),
             q, k, v,
+            iters=iters,
+        )
+
+        # Backward (training) path: grad of a scalar through attention.
+        # The Pallas backward recomputes score blocks in-kernel, so peak
+        # memory stays O(S) per row — the XLA backward materializes the
+        # (S, S) probability matrix and its cotangent.
+        def grad_of(attn):
+            g = jax.grad(
+                lambda q, k, v: attn(q, k, v).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2),
+            )
+
+            # Reduce ALL THREE grads into the timed output: syncing only dq
+            # would let jit dead-code-eliminate the XLA path's separate
+            # dk/dv einsums while the monolithic Pallas backward kernel
+            # still computes everything — biasing the comparison.
+            def timed(*a):
+                dq, dk, dv = g(*a)
+                return (
+                    dq.astype(jnp.float32).mean()
+                    + dk.astype(jnp.float32).mean()
+                    + dv.astype(jnp.float32).mean()
+                )
+
+            return timed
+
+        t_xla_bwd = _bench(
+            grad_of(roped(lambda q, k, v: _xla_attention(q, k, v, True))),
+            q, k, v,
+            iters=iters,
+        )
+        t_flash_bwd = _bench(
+            grad_of(
+                roped(
+                    lambda q, k, v: flash_attention(
+                        q, k, v, True, 512, 512, not on_tpu
+                    )
+                )
+            ),
+            q, k, v,
+            iters=iters,
         )
         print(
             json.dumps(
                 {
                     "metric": f"rope+causal_attention seq={seq} (B=1,H=8,D=64,bf16)",
-                    "xla_ms": round(t_xla * 1e3, 3),
-                    "pallas_ms": round(t_flash * 1e3, 3),
-                    "pallas_fused_rope_ms": round(t_fused * 1e3, 3),
-                    "speedup": round(t_xla / t_flash, 2),
-                    "speedup_fused": round(t_xla / t_fused, 2),
+                    "xla_ms": _ms(t_xla),
+                    "pallas_ms": _ms(t_flash),
+                    "pallas_fused_rope_ms": _ms(t_fused),
+                    "speedup": _ratio(t_xla, t_flash),
+                    "speedup_fused": _ratio(t_xla, t_fused),
+                    "xla_bwd_ms": _ms(t_xla_bwd),
+                    "pallas_bwd_ms": _ms(t_flash_bwd),
+                    "speedup_bwd": _ratio(t_xla_bwd, t_flash_bwd),
                     "device": str(jax.devices()[0]),
                 }
-            )
+            ),
+            flush=True,
         )
     return 0
 

@@ -1,5 +1,15 @@
 """Checkpoint/resume: host-side pytree serialization."""
 
-from bpe_transformer_tpu.checkpointing.checkpoint import load_checkpoint, save_checkpoint
+from bpe_transformer_tpu.checkpointing.checkpoint import (
+    load_checkpoint,
+    load_checkpoint_sharded,
+    save_checkpoint,
+    save_checkpoint_sharded,
+)
 
-__all__ = ["load_checkpoint", "save_checkpoint"]
+__all__ = [
+    "load_checkpoint",
+    "load_checkpoint_sharded",
+    "save_checkpoint",
+    "save_checkpoint_sharded",
+]

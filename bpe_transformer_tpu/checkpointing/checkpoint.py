@@ -6,16 +6,28 @@ iteration) to a path or file-like object; loading restores both and returns
 the iteration (roundtrip incl. optimizer internals pinned by
 `test_serialization.py:57-121`).
 
-Format: a pickled dict of numpy arrays (leaves pulled off-device with
-``jax.device_get``) plus the pytree structure, so any params/opt-state shape
-this framework produces roundtrips exactly.  Preemption-safe: writes go to a
-temp file and rename into place when given a path.
+Two formats:
+
+* **single-file** (`save_checkpoint`): a pickled dict of numpy arrays
+  (leaves pulled off-device with ``jax.device_get``) — fine at small scale
+  and required for the reference's file-like-object contract;
+* **sharded directory** (`save_checkpoint_sharded`): one ``.npy`` file per
+  device shard of every leaf, streamed one shard at a time, plus a JSON
+  manifest — peak host memory is one *shard*, not the full tree, which is
+  what FSDP-scale states need.  Loading reassembles leaf by leaf and can
+  place each leaf directly onto a target sharding (resume re-placement)
+  without ever holding the whole tree in a single buffer.
+
+Both are preemption-safe: writes go to a temp file/directory and rename into
+place.  :func:`load_checkpoint` auto-detects the format.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
+import shutil
 import tempfile
 from pathlib import Path
 from typing import Any, BinaryIO
@@ -24,6 +36,8 @@ import jax
 import numpy as np
 
 _FORMAT_VERSION = 1
+_SHARDED_FORMAT_VERSION = 2
+_MANIFEST = "manifest.json"
 
 
 def _to_host(tree):
@@ -64,7 +78,10 @@ def save_checkpoint(
 
 def load_checkpoint(src: str | os.PathLike | BinaryIO) -> dict:
     """Load a snapshot; returns the payload dict (params, opt_state,
-    iteration, extra)."""
+    iteration, extra).  Accepts a single-file checkpoint, a file-like
+    object, or a sharded checkpoint directory (auto-detected)."""
+    if not hasattr(src, "read") and Path(src).is_dir():
+        return load_checkpoint_sharded(src)
     if hasattr(src, "read"):
         payload = pickle.load(src)
     else:
@@ -74,3 +91,158 @@ def load_checkpoint(src: str | os.PathLike | BinaryIO) -> dict:
     if version != _FORMAT_VERSION:
         raise ValueError(f"unsupported checkpoint format version: {version}")
     return payload
+
+
+# ------------------------------------------------- sharded directory format
+
+
+def _distinct_shards(leaf) -> list[tuple[list[list[int]], Any]]:
+    """(index, shard) for each DISTINCT index range of a leaf.
+
+    A leaf replicated over one mesh axis and sharded over another has
+    multiple addressable shards per index range; writing one per range
+    keeps the checkpoint at exactly one copy of the data.
+    """
+    seen = set()
+    out = []
+    for shard in leaf.addressable_shards:
+        index = []
+        for sl, dim in zip(shard.index, leaf.shape):
+            start = 0 if sl.start is None else int(sl.start)
+            stop = dim if sl.stop is None else int(sl.stop)
+            index.append([start, stop])
+        key = tuple(tuple(r) for r in index)
+        if key not in seen:
+            seen.add(key)
+            out.append((index, shard))
+    return out
+
+
+def save_checkpoint_sharded(
+    out_dir: str | os.PathLike,
+    *,
+    params: Any,
+    opt_state: Any = None,
+    iteration: int = 0,
+    extra: dict | None = None,
+) -> None:
+    """Stream a training state into a checkpoint DIRECTORY, shard by shard.
+
+    Every pytree leaf is written as one ``.npy`` per device shard (a leaf on
+    N devices under FSDP yields N files, each 1/N of the leaf); replicated
+    or host leaves yield a single file.  Peak host memory is therefore one
+    shard, never the assembled tree.  The pytree structure goes to
+    ``treedef.pkl`` (structure only, no array data) and shard geometry to
+    ``manifest.json``.  The directory is built under a temp name and renamed
+    into place, so a preempted save never leaves a partial checkpoint at
+    ``out_dir``.
+    """
+    out_dir = Path(out_dir)
+    out_dir.parent.mkdir(parents=True, exist_ok=True)
+    tmp_dir = Path(
+        tempfile.mkdtemp(dir=out_dir.parent, prefix=out_dir.name + ".tmp")
+    )
+    try:
+        tree = {"params": params, "opt_state": opt_state}
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        with open(tmp_dir / "treedef.pkl", "wb") as f:
+            pickle.dump(treedef, f)
+
+        leaf_records = []
+        for i, leaf in enumerate(leaves):
+            name = f"leaf_{i:05d}"
+            is_sharded = (
+                isinstance(leaf, jax.Array)
+                and hasattr(leaf, "addressable_shards")
+                and len(leaf.addressable_shards) > 1
+                and not leaf.is_fully_replicated
+            )
+            record = {
+                "name": name,
+                "shape": list(np.shape(leaf)),
+                "dtype": str(np.asarray(jax.device_get(leaf)).dtype)
+                if np.ndim(leaf) == 0
+                else str(leaf.dtype),
+            }
+            if is_sharded:
+                distinct = _distinct_shards(leaf)
+                record["shards"] = [{"index": index} for index, _ in distinct]
+                for j, (_, shard) in enumerate(distinct):
+                    np.save(tmp_dir / f"{name}.{j:03d}.npy", np.asarray(shard.data))
+            else:
+                np.save(tmp_dir / f"{name}.npy", np.asarray(jax.device_get(leaf)))
+            leaf_records.append(record)
+
+        manifest = {
+            "format_version": _SHARDED_FORMAT_VERSION,
+            "iteration": int(iteration),
+            "extra": extra or {},
+            "leaves": leaf_records,
+        }
+        with open(tmp_dir / _MANIFEST, "w") as f:
+            json.dump(manifest, f)
+        if out_dir.exists():
+            shutil.rmtree(out_dir)
+        os.replace(tmp_dir, out_dir)
+    except BaseException:
+        if tmp_dir.exists():
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+        raise
+
+
+def load_checkpoint_sharded(
+    src_dir: str | os.PathLike, shardings: Any | None = None
+) -> dict:
+    """Load a sharded checkpoint directory; returns the same payload dict as
+    :func:`load_checkpoint`.
+
+    Leaves are reassembled ONE AT A TIME from their shard files; with
+    ``shardings`` (a pytree of `jax.sharding.Sharding` matching
+    ``{"params": ..., "opt_state": ...}``) each leaf is placed onto its
+    target devices as soon as it is assembled, so resume re-placement never
+    stages the whole tree on host.
+    """
+    src_dir = Path(src_dir)
+    with open(src_dir / _MANIFEST) as f:
+        manifest = json.load(f)
+    if manifest.get("format_version") != _SHARDED_FORMAT_VERSION:
+        raise ValueError(
+            "unsupported sharded checkpoint format version: "
+            f"{manifest.get('format_version')}"
+        )
+    with open(src_dir / "treedef.pkl", "rb") as f:
+        treedef = pickle.load(f)
+
+    placement_leaves = None
+    if shardings is not None:
+        placement_leaves = jax.tree_util.tree_flatten(shardings)[0]
+        if len(placement_leaves) != len(manifest["leaves"]):
+            raise ValueError(
+                f"shardings tree has {len(placement_leaves)} leaves, "
+                f"checkpoint has {len(manifest['leaves'])}"
+            )
+
+    leaves = []
+    for i, record in enumerate(manifest["leaves"]):
+        name = record["name"]
+        if "shards" in record:
+            value = np.empty(record["shape"], dtype=np.dtype(record["dtype"]))
+            for j, shard in enumerate(record["shards"]):
+                idx = tuple(slice(start, stop) for start, stop in shard["index"])
+                value[idx] = np.load(src_dir / f"{name}.{j:03d}.npy")
+        else:
+            value = np.load(src_dir / f"{name}.npy")
+            if not record["shape"]:  # 0-d leaf saved from a python scalar
+                value = value[()]
+        if placement_leaves is not None:
+            value = jax.device_put(value, placement_leaves[i])
+        leaves.append(value)
+
+    tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    return {
+        "format_version": _FORMAT_VERSION,
+        "params": tree["params"],
+        "opt_state": tree["opt_state"],
+        "iteration": manifest["iteration"],
+        "extra": manifest["extra"],
+    }

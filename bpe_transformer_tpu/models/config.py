@@ -33,6 +33,9 @@ class ModelConfig:
     n_experts: int = 0
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.01
+    #: Experts per token: 1 = Switch routing, 2 = GShard-style top-2 (gates
+    #: renormalized over the chosen experts).
+    router_top_k: int = 1
     # TPU execution knobs (not part of the reference schema).
     activation_dtype: str = "float32"  # "bfloat16" for the perf path
     remat: bool = False  # rematerialize each block on the backward pass
@@ -59,6 +62,13 @@ class ModelConfig:
             raise ValueError(
                 'ffn_type="moe" requires n_experts >= 1 (got '
                 f"{self.n_experts}); set n_experts in the model config"
+            )
+        if self.ffn_type == "moe" and not (
+            1 <= self.router_top_k <= self.n_experts
+        ):
+            raise ValueError(
+                f"router_top_k={self.router_top_k} must be in "
+                f"[1, n_experts={self.n_experts}]"
             )
 
     @classmethod

@@ -56,14 +56,44 @@ def _rope_qk(q, k, positions, config):
     return apply_rope(q, pos, cos, sin), apply_rope(k, pos, cos, sin)
 
 
-def _ffn_dense(x, ffn, config):
+def _ffn_decode(x, ffn, config):
+    """FFN dispatch mirroring the training forward's `_ffn` (aux discarded).
+
+    MoE note: routing capacity is computed over the tokens of THIS call —
+    the whole prompt at prefill, ``batch`` tokens per decode step — so
+    cached decoding matches the uncached forward exactly only when capacity
+    is not binding (standard inference practice: generous capacity_factor).
+    """
     if config.ffn_type in (None, "swiglu"):
         return swiglu(x, ffn["w1"], ffn["w2"], ffn["w3"])
     if config.ffn_type == "silu":
         return linear(silu(linear(x, ffn["w1"])), ffn["w2"])
-    raise NotImplementedError(
-        f"cached decoding supports swiglu/silu FFNs, got {config.ffn_type!r}"
-    )
+    if config.ffn_type == "gelu":
+        from bpe_transformer_tpu.kernels.pallas.gelu import gelu
+
+        return linear(gelu(linear(x, ffn["w1"])), ffn["w2"])
+    if config.ffn_type == "moe":
+        from bpe_transformer_tpu.models.moe import switch_ffn
+
+        out, _ = switch_ffn(x, ffn, config)
+        return out
+    raise ValueError(f"unknown ffn_type: {config.ffn_type!r}")
+
+
+def _block_apply(x, block_params, config, attend):
+    """One block around a caller-supplied ``attend(h) -> attention output``.
+
+    Mirrors `transformer_block_aux` (models/transformer.py): pre-norm by
+    default, post-norm under the ablation flag.
+    """
+    if config.use_post_norm:
+        x = _norm(x + attend(x), block_params["ln1"], config)
+        f = _ffn_decode(x, block_params["ffn"], config)
+        return _norm(x + f, block_params["ln2"], config)
+    h = _norm(x, block_params["ln1"], config)
+    x = x + attend(h)
+    h = _norm(x, block_params["ln2"], config)
+    return x + _ffn_decode(h, block_params["ffn"], config)
 
 
 def _norm(x, w, config):
@@ -93,21 +123,25 @@ def prefill(
 
     new_cache = []
     for block_params, layer_cache in zip(params["layers"], cache):
-        h = _norm(x, block_params["ln1"], config)
-        q, k, v = _project_qkv(h, block_params["attn"], config.num_heads)
-        q, k = _rope_qk(q, k, positions, config)
-        layer_cache = {
-            "k": lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, 0, 0)),
-            "v": lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, 0, 0)),
-        }
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-        scores = jnp.where(mask, scores, -jnp.inf)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-        att = merge_heads(jnp.einsum("bhqk,bhkd->bhqd", probs, v))
-        x = x + linear(att, block_params["attn"]["output_proj"])
-        h = _norm(x, block_params["ln2"], config)
-        x = x + _ffn_dense(h, block_params["ffn"], config)
-        new_cache.append(layer_cache)
+
+        def attend(h, block_params=block_params, layer_cache=layer_cache):
+            q, k, v = _project_qkv(h, block_params["attn"], config.num_heads)
+            q, k = _rope_qk(q, k, positions, config)
+            new_cache.append(
+                {
+                    "k": lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, 0, 0)),
+                    "v": lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, 0, 0)),
+                }
+            )
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            scores = jnp.where(mask, scores, -jnp.inf)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+                h.dtype
+            )
+            att = merge_heads(jnp.einsum("bhqk,bhkd->bhqd", probs, v))
+            return linear(att, block_params["attn"]["output_proj"])
+
+        x = _block_apply(x, block_params, config, attend)
 
     x = _norm(x, params["ln_final"], config)
     logits = linear(x[:, -1].astype(jnp.float32), params["lm_head"].astype(jnp.float32))
@@ -135,19 +169,22 @@ def decode_step(
 
     new_cache = []
     for block_params, layer_cache in zip(params["layers"], cache):
-        h = _norm(x, block_params["ln1"], config)
-        q, k, v = _project_qkv(h, block_params["attn"], config.num_heads)
-        q, k = _rope_qk(q, k, positions, config)
-        k_cache = lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, pos, 0))
-        v_cache = lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, pos, 0))
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale  # (B,H,1,ctx)
-        scores = jnp.where(visible[None, None, None, :], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-        att = merge_heads(jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache))
-        x = x + linear(att, block_params["attn"]["output_proj"])
-        h = _norm(x, block_params["ln2"], config)
-        x = x + _ffn_dense(h, block_params["ffn"], config)
-        new_cache.append({"k": k_cache, "v": v_cache})
+
+        def attend(h, block_params=block_params, layer_cache=layer_cache):
+            q, k, v = _project_qkv(h, block_params["attn"], config.num_heads)
+            q, k = _rope_qk(q, k, positions, config)
+            k_cache = lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, pos, 0))
+            v_cache = lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, pos, 0))
+            new_cache.append({"k": k_cache, "v": v_cache})
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale  # (B,H,1,ctx)
+            scores = jnp.where(visible[None, None, None, :], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+                h.dtype
+            )
+            att = merge_heads(jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache))
+            return linear(att, block_params["attn"]["output_proj"])
+
+        x = _block_apply(x, block_params, config, attend)
 
     x = _norm(x, params["ln_final"], config)
     logits = linear(x[:, 0].astype(jnp.float32), params["lm_head"].astype(jnp.float32))

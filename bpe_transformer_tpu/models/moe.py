@@ -1,4 +1,5 @@
-"""Mixture-of-experts FFN (Switch-style top-1 routing) with expert parallelism.
+"""Mixture-of-experts FFN (top-1 Switch / top-k GShard routing) with expert
+parallelism.
 
 No reference precedent (SURVEY §2.4 lists EP as absent); built TPU-first in
 the GSPMD dense-dispatch formulation: expert weights are stacked on a leading
@@ -7,9 +8,12 @@ and expert compute is a single batched einsum over all experts.  Sharding the
 expert dim over an ``expert`` mesh axis turns the dispatch einsums into
 all-to-alls over ICI — no per-expert Python loops, fully static shapes.
 
-Semantics (Switch Transformer, Fedus et al. 2021 — public):
+Semantics (Switch Transformer, Fedus et al. 2021; GShard, Lepikhin et al.
+2020 — both public):
 
-* each token routes to its argmax expert with gate = softmax prob;
+* each token routes to its ``router_top_k`` highest-probability experts;
+  with k=1 the gate is the raw softmax prob (Switch), with k>1 gates are
+  renormalized over the chosen experts (GShard top-2);
 * per-expert capacity ``ceil(capacity_factor * tokens / n_experts)``;
   overflow tokens are dropped (their FFN output is zero, the residual
   connection carries them through);
@@ -55,7 +59,13 @@ def expert_capacity(n_tokens: int, n_experts: int, capacity_factor: float) -> in
 def switch_ffn(
     x: Array, moe_params: dict, config: ModelConfig
 ) -> tuple[Array, Array]:
-    """Top-1 routed SwiGLU experts.  Returns ``(output, aux_loss)``.
+    """Top-k routed SwiGLU experts.  Returns ``(output, aux_loss)``.
+
+    ``router_top_k == 1`` is Switch routing (gate = raw softmax prob of the
+    winning expert); ``k > 1`` is GShard-style top-k (gates renormalized over
+    the chosen experts).  Capacity fills rank-major — every token's first
+    choice is queued before any token's second choice — so a congested
+    expert sheds low-priority assignments first.
 
     ``x``: (..., d_model); routing flattens all leading dims into one token
     axis (static shape under jit).
@@ -65,6 +75,7 @@ def switch_ffn(
     n = math.prod(orig_shape[:-1])
     tokens = x.reshape(n, d)
     e = config.n_experts
+    top_k = config.router_top_k
     cap = expert_capacity(n, e, config.capacity_factor)
 
     # Router in float32 for stable softmax/argmax.
@@ -72,17 +83,26 @@ def switch_ffn(
         "nd,ed->ne", tokens.astype(jnp.float32), moe_params["router"].astype(jnp.float32)
     )
     probs = jax.nn.softmax(logits, axis=-1)  # (n, e)
-    expert_idx = jnp.argmax(probs, axis=-1)  # (n,)
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]  # (n,)
+    topk_probs, topk_idx = jax.lax.top_k(probs, top_k)  # (n, k)
+    if top_k == 1:
+        gates = topk_probs  # Switch: raw winning probability
+    else:
+        gates = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
 
-    assign = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (n, e)
-    # Position of each token within its expert's queue (order = token order).
-    pos = jnp.cumsum(assign, axis=0) * assign - assign  # (n, e): 0-based, 0 elsewhere
-    keep = assign * (pos < cap)  # drop overflow tokens
-    dispatch = keep[:, :, None] * jax.nn.one_hot(
-        pos.astype(jnp.int32), cap, dtype=jnp.float32
-    )  # (n, e, cap)
-    combine = gate[:, None, None] * dispatch  # (n, e, cap)
+    assign = jax.nn.one_hot(topk_idx.T, e, dtype=jnp.float32)  # (k, n, e)
+    # Queue position of each (rank, token) assignment within its expert,
+    # rank-major: flatten (k, n) so all rank-0 rows precede rank-1 rows.
+    flat = assign.reshape(top_k * n, e)
+    pos = jnp.cumsum(flat, axis=0) * flat - flat  # 0-based, 0 elsewhere
+    keep = flat * (pos < cap)  # drop overflow assignments
+    dispatch = (
+        keep[:, :, None]
+        * jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+    ).reshape(top_k, n, e, cap)
+    combine = gates.T[:, :, None, None] * dispatch  # (k, n, e, cap)
+    # A token holds at most one slot per expert, so summing ranks is exact.
+    dispatch = jnp.sum(dispatch, axis=0)  # (n, e, cap)
+    combine = jnp.sum(combine, axis=0)  # (n, e, cap)
 
     # Dispatch -> expert SwiGLU -> combine, all batched over the expert dim.
     compute_dtype = tokens.dtype
@@ -93,8 +113,10 @@ def switch_ffn(
     expert_out = jnp.einsum("ecf,edf->ecd", h, moe_params["w2"])
     out = jnp.einsum("nec,ecd->nd", combine.astype(compute_dtype), expert_out)
 
-    # Load-balance loss over the *pre-capacity* assignments.
-    frac_tokens = jnp.mean(assign, axis=0)  # (e,)
+    # Load-balance loss over the *pre-capacity* first-choice assignments
+    # (the Switch definition; ranks >= 1 follow the same router so the
+    # gradient signal is unchanged).
+    frac_tokens = jnp.mean(assign[0], axis=0)  # (e,)
     frac_probs = jnp.mean(probs, axis=0)  # (e,)
     aux = e * jnp.sum(frac_tokens * frac_probs)
 

@@ -100,10 +100,17 @@ def make_sp_train_step(
                 attention_fn = partial(
                     ring_self_attention, axis_name=seq_axis, causal=True
                 )
-            hidden, _ = forward_hidden(
+            hidden, aux = forward_hidden(
                 p, x, config, positions=positions, attention_fn=attention_fn
             )
-            return lm_loss(hidden, p["lm_head"], y, config.loss_chunk_size)
+            loss = lm_loss(hidden, p["lm_head"], y, config.loss_chunk_size)
+            if config.ffn_type == "moe":
+                # Load-balance aux per dispatch group (the Switch
+                # convention): each shard routes its local tokens and
+                # regularizes its own expert loads; the pmean below averages
+                # the shard auxes (equal-size shards).
+                loss = loss + config.router_aux_weight * aux
+            return loss
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         # Equal-size shards: the global mean is the mean of shard means.

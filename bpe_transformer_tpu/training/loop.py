@@ -17,7 +17,11 @@ from pathlib import Path
 import jax
 import numpy as np
 
-from bpe_transformer_tpu.checkpointing import load_checkpoint, save_checkpoint
+from bpe_transformer_tpu.checkpointing import (
+    load_checkpoint,
+    save_checkpoint,
+    save_checkpoint_sharded,
+)
 from bpe_transformer_tpu.data.dataset import get_batch
 from bpe_transformer_tpu.models.config import ModelConfig
 from bpe_transformer_tpu.models.transformer import init_params
@@ -77,8 +81,6 @@ def train(
         shard_sp_batch,
     )
 
-    rng = np.random.default_rng(loop.seed)
-
     mesh = None
     if loop.parallel is not None:
         mesh_axes = loop.mesh_axes
@@ -105,12 +107,6 @@ def train(
                     f'"{needed}" axis, e.g. --mesh data=2,{needed}=4'
                 )
         if loop.parallel == "sp":
-            if model_config.ffn_type == "moe":
-                raise NotImplementedError(
-                    'parallel="sp" builds its loss from the ring-attention '
-                    "forward and does not yet add the MoE router aux loss; "
-                    "use an ep strategy instead"
-                )
             seq_size = mesh.shape.get("seq")
             if seq_size is None:
                 raise ValueError(
@@ -126,9 +122,37 @@ def train(
     start_iteration = 0
     if resume_from is not None:
         resume_from = Path(resume_from)
-        if resume_from.is_dir():  # checkpoint dir -> most recent snapshot
+        # A directory may be a checkpoints PARENT (resume from its latest
+        # snapshot) or a sharded checkpoint itself (has a manifest).
+        if resume_from.is_dir() and not (resume_from / "manifest.json").exists():
             resume_from = resume_from / "latest.ckpt"
-        payload = load_checkpoint(resume_from)
+        gspmd = mesh is not None and loop.parallel not in ("dp", "sp", "pp")
+        if gspmd and (Path(resume_from) / "manifest.json").exists():
+            # Streaming re-placement: build the target shardings from the
+            # ABSTRACT param tree (no init compute) so each leaf lands on
+            # its mesh devices as it is read — the full FSDP state is never
+            # staged on host in one buffer.
+            from bpe_transformer_tpu.checkpointing import load_checkpoint_sharded
+            from bpe_transformer_tpu.parallel.sharding import param_shardings
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            abstract = jax.eval_shape(
+                lambda: init_params(jax.random.PRNGKey(0), model_config)
+            )
+            pshard = param_shardings(abstract, mesh, loop.parallel)
+            payload = load_checkpoint_sharded(
+                resume_from,
+                shardings={
+                    "params": pshard,
+                    "opt_state": AdamWState(
+                        step=NamedSharding(mesh, PartitionSpec()),
+                        m=pshard,
+                        v=pshard,
+                    ),
+                },
+            )
+        else:
+            payload = load_checkpoint(resume_from)
         params = payload["params"]
         opt_state = (
             AdamWState(*payload["opt_state"])
@@ -201,6 +225,11 @@ def train(
         )
         place = lambda b: shard_batch(b, mesh)
 
+    # GSPMD/pipeline strategies hold device-sharded params; checkpoint those
+    # through the streaming directory format.  dp/sp keep replicated params
+    # (single-file pickle is fine and keeps file-like compatibility).
+    sharded_ckpt = mesh is not None and loop.parallel not in ("dp", "sp")
+
     eval_step = make_eval_step(model_config)
     n_chips = len(jax.devices()) if mesh is not None else 1
     tokens_per_step = loop.batch_size * model_config.context_length
@@ -242,8 +271,12 @@ def train(
     # handle and finishes the wandb run.
     try:
         for iteration in range(start_iteration, loop.steps):
+            # Per-iteration seeding (not one stream advanced per step) so a
+            # resumed run samples the SAME batch at the same iteration as an
+            # uninterrupted one — preemption-safe determinism.
+            step_rng = np.random.default_rng((loop.seed, iteration))
             x, y = get_batch(
-                train_data, loop.batch_size, model_config.context_length, rng
+                train_data, loop.batch_size, model_config.context_length, step_rng
             )
             x, y = place((jax.numpy.asarray(x), jax.numpy.asarray(y)))
             params, opt_state, metrics = step_fn(params, opt_state, x, y)
@@ -280,15 +313,26 @@ def train(
                 (iteration + 1) % loop.checkpoint_every == 0 or is_last
             ):
                 ckpt_path = Path(loop.checkpoint_dir) / f"step_{iteration + 1:08d}.ckpt"
-                save_checkpoint(
-                    ckpt_path,
+                latest = Path(loop.checkpoint_dir) / "latest.ckpt"
+                state_kwargs = dict(
                     params=params,
                     opt_state=opt_state,
                     iteration=iteration + 1,
                     extra={"val_loss": val_loss, "train_loss": last_loss},
                 )
-                # latest.ckpt is a byte copy — don't pay device_get + pickle twice.
-                shutil.copyfile(ckpt_path, Path(loop.checkpoint_dir) / "latest.ckpt")
+                if sharded_ckpt:
+                    # GSPMD-sharded states stream shard-by-shard into a
+                    # checkpoint DIRECTORY — the full tree is never staged
+                    # on host in one buffer (FSDP-scale requirement).
+                    save_checkpoint_sharded(ckpt_path, **state_kwargs)
+                    if latest.is_symlink() or latest.exists():
+                        latest.unlink()
+                    latest.symlink_to(ckpt_path.name)
+                else:
+                    save_checkpoint(ckpt_path, **state_kwargs)
+                    # latest.ckpt is a byte copy — don't pay device_get +
+                    # pickle twice.
+                    shutil.copyfile(ckpt_path, latest)
 
     finally:
         sinks.close()

@@ -53,8 +53,6 @@ def generate_ids(
 
     if (
         len(prompt) + max_new_tokens <= ctx
-        and config.ffn_type in (None, "swiglu", "silu")
-        and not config.use_post_norm  # decode.py hardcodes pre-norm blocks
         and config.activation_dtype == "float32"  # decode.py runs in f32
     ):
         # KV-cached fast path: O(1) work per token, one XLA program for the
@@ -76,7 +74,7 @@ def generate_ids(
         return out
 
     # Sliding-window fallback (prompt + continuation exceed the context, or
-    # FFN variants the cached path doesn't cover): full forward per token.
+    # bf16 activations): full forward per token.
     buf = np.zeros(ctx, dtype=np.int32)
     buf[: len(prompt)] = prompt
     length = len(prompt)
