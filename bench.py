@@ -128,26 +128,37 @@ def bench_jax(platform: str) -> None:
     )
     warmup_steps = 10 if on_accel else 1
     measure_steps = 100 if on_accel else 6
+    # Scanned multi-update dispatch (identical math, one launch per
+    # INNER_STEPS updates): a ~12 ms device step behind a relayed backend
+    # loses real throughput to launch latency otherwise.
+    inner = int(os.environ.get("BENCH_INNER_STEPS", "10" if on_accel else "1"))
 
     params = init_params(jax.random.PRNGKey(0), config)
     opt_state = adamw_init(params)
-    step = make_train_step(config, TrainHParams())
 
     rng = np.random.default_rng(0)
     ids = rng.integers(0, config.vocab_size, size=(BATCH, config.context_length))
     x = jnp.asarray(ids)
     y = jnp.asarray(np.roll(ids, -1, axis=1))
+    if inner > 1:
+        from bpe_transformer_tpu.training.train_step import make_scanned_train_step
+
+        step = make_scanned_train_step(config, TrainHParams(), inner)
+        x = jnp.broadcast_to(x, (inner, *x.shape))
+        y = jnp.broadcast_to(y, (inner, *y.shape))
+    else:
+        step = make_train_step(config, TrainHParams())
 
     # A value fetch is the only reliable execution barrier on every backend
     # (block_until_ready has proven unreliable on relayed remote devices).
-    for _ in range(warmup_steps):
+    for _ in range(max(warmup_steps // inner, 1)):
         params, opt_state, metrics = step(params, opt_state, x, y)
     float(jax.device_get(metrics["loss"]))
 
     # Measure in blocks, updating RESULT after each: if the deadline fires
     # mid-measurement, the watchdog still reports a real (partial) number.
     device = jax.devices()[0]
-    block = max(measure_steps // 10, 1)
+    block = max(measure_steps // (10 * inner), 1)
     done = 0
     loss = float("nan")
     start = time.perf_counter()
@@ -155,7 +166,7 @@ def bench_jax(platform: str) -> None:
         for _ in range(block):
             params, opt_state, metrics = step(params, opt_state, x, y)
         loss = float(jax.device_get(metrics["loss"]))
-        done += block
+        done += block * inner
         step_time = (time.perf_counter() - start) / done
         tokens_per_sec = BATCH * config.context_length / step_time
         utilization = mfu(config, BATCH, step_time, device.device_kind)
@@ -166,6 +177,7 @@ def bench_jax(platform: str) -> None:
             mfu=round(utilization, 4) if utilization is not None else None,
             steps_per_sec=round(1.0 / step_time, 3),
             measure_steps=done,
+            inner_steps=inner,
             flops_per_step=train_step_flops(config, BATCH),
         )
         if _remaining() < 45:  # leave room for the torch baseline

@@ -26,6 +26,7 @@ from bpe_transformer_tpu.kernels.pallas.flash_attention import (
 from bpe_transformer_tpu.ops.rope import apply_rope, rope_tables
 
 BATCH, HEADS, D_HEAD = 1, 8, 64
+# Override with e.g. `--seq 16384` to split long runs across invocations.
 SEQ_LENS = (1024, 4096, 16384)
 
 
@@ -34,12 +35,19 @@ def _sync(x) -> float:
     return float(jax.device_get(x.reshape(-1)[0]))
 
 
-def _bench(fn, *args, iters: int = 10) -> float | None:
+def _bench(fn, *args, label: str = "", iters: int = 10) -> float | None:
     """Mean seconds/call, or None when the case can't run (e.g. the XLA
     materialized path OOMing at seq 16k — which is the point of flash)."""
     try:
         jitted = jax.jit(fn)
+        t_compile = time.perf_counter()
         _sync(jitted(*args))
+        print(
+            f"  {label}: compiled+first-run in "
+            f"{time.perf_counter() - t_compile:.1f}s",
+            file=sys.stderr,
+            flush=True,
+        )
         start = time.perf_counter()
         out = None
         for _ in range(iters):
@@ -60,11 +68,16 @@ def _ratio(a: float | None, b: float | None):
 
 
 def main() -> int:
+    seq_lens = SEQ_LENS
+    if "--seq" in sys.argv:
+        arg = sys.argv[sys.argv.index("--seq") + 1]
+        seq_lens = tuple(int(s) for s in arg.split(","))
+
     rng = np.random.default_rng(0)
-    cos, sin = rope_tables(D_HEAD, max(SEQ_LENS))
+    cos, sin = rope_tables(D_HEAD, max(seq_lens))
     on_tpu = jax.default_backend() == "tpu"
 
-    for seq in SEQ_LENS:
+    for seq in seq_lens:
         shape = (BATCH, HEADS, seq, D_HEAD)
         q, k, v = (
             jnp.asarray(rng.standard_normal(shape), dtype=jnp.bfloat16)
@@ -83,21 +96,21 @@ def main() -> int:
         iters = 10 if seq < 16384 else 3
         t_xla = _bench(
             roped(lambda q, k, v: _xla_attention(q, k, v, True)), q, k, v,
-            iters=iters,
+            label=f"xla_fwd@{seq}", iters=iters,
         )
         t_flash = _bench(
             roped(
                 lambda q, k, v: flash_attention(q, k, v, True, 512, 512, not on_tpu)
             ),
             q, k, v,
-            iters=iters,
+            label=f"flash_fwd@{seq}", iters=iters,
         )
         t_fused = _bench(
             lambda q, k, v: flash_attention_with_rope(
                 q, k, v, cos_s, sin_s, True, 512, 512, not on_tpu
             ),
             q, k, v,
-            iters=iters,
+            label=f"fused_fwd@{seq}", iters=iters,
         )
 
         # Backward (training) path: grad of a scalar through attention.
@@ -127,7 +140,7 @@ def main() -> int:
         t_xla_bwd = _bench(
             grad_of(roped(lambda q, k, v: _xla_attention(q, k, v, True))),
             q, k, v,
-            iters=iters,
+            label=f"xla_bwd@{seq}", iters=iters,
         )
         t_flash_bwd = _bench(
             grad_of(
@@ -138,7 +151,7 @@ def main() -> int:
                 )
             ),
             q, k, v,
-            iters=iters,
+            label=f"flash_bwd@{seq}", iters=iters,
         )
         print(
             json.dumps(

@@ -30,7 +30,10 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from bpe_transformer_tpu.models.config import ModelConfig
-from bpe_transformer_tpu.models.transformer import Params, transformer_block
+from bpe_transformer_tpu.models.transformer import (
+    Params,
+    transformer_block_aux,
+)
 from bpe_transformer_tpu.ops.core import embedding, rmsnorm
 from bpe_transformer_tpu.ops.rope import rope_tables
 from bpe_transformer_tpu.optim.adamw import AdamWState, adamw_init, adamw_update
@@ -135,19 +138,21 @@ def _pp_loss_fn(
         per_stage = jax.tree_util.tree_leaves(stages)[0].shape[1]
 
         def apply_stage(act):
+            aux_sum = jnp.zeros((), jnp.float32)
             for i in range(per_stage):
                 block_params = jax.tree_util.tree_map(
                     lambda l: l[0, i].astype(act_dtype), stages
                 )
-                block = transformer_block
+                block = transformer_block_aux
                 if config.remat:
                     block = jax.checkpoint(
-                        transformer_block, static_argnums=(2, 5)
+                        transformer_block_aux, static_argnums=(2, 5)
                     )
-                act = block(
+                act, aux = block(
                     act, block_params, config, rope_cos_sin, positions, None
                 )
-            return act
+                aux_sum = aux_sum + aux
+            return act, aux_sum
 
         def head_loss(act, targets):
             if not config.remove_rmsnorm:
@@ -160,7 +165,7 @@ def _pp_loss_fn(
         ticks = num_micro + pp_size - 1
 
         def tick(carry, t):
-            recv, loss_sum = carry
+            recv, loss_sum, aux_total = carry
             # Only rank 0 pays for the embedding lookup; other ranks take the
             # ppermute'd activation (lax.cond executes a single branch).
             enter = jnp.clip(t, 0, num_micro - 1)
@@ -172,7 +177,15 @@ def _pp_loss_fn(
                 ).astype(act_dtype),
                 lambda: recv,
             )
-            act_out = apply_stage(act_in)
+            act_out, aux = apply_stage(act_in)
+            # MoE router aux: count only the ticks where THIS rank holds a
+            # real microbatch (warmup/drain ticks process garbage
+            # activations whose routing must not leak into the loss or its
+            # gradient).  Each rank contributes its own stages' aux to its
+            # LOCAL loss — the sum over ranks seeds exactly once per term,
+            # same argument as the head loss below.
+            valid = (t >= rank) & (t - rank < num_micro)
+            aux_total = aux_total + jnp.where(valid, aux, 0.0)
 
             # Only the last rank pays for the full-vocab head matmul + CE.
             done = t - (pp_size - 1)
@@ -189,21 +202,26 @@ def _pp_loss_fn(
             loss_sum = loss_sum + mb_loss
 
             recv_next = lax.ppermute(act_out, pp_axis, fwd_perm)
-            return (recv_next, loss_sum), None
+            return (recv_next, loss_sum, aux_total), None
 
         d = config.d_model
         init = (
             jnp.zeros((mb, seq, d), act_dtype),
             jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
         )
-        (_, loss_sum), _ = lax.scan(tick, init, jnp.arange(ticks))
-        # LOCAL loss: nonzero only on the last rank.  Deliberately NOT
-        # psum'd here — differentiating a psum inside shard_map would seed
-        # one cotangent per rank and overcount stage gradients pp times;
-        # with the local loss, the single real seed (last rank) flows back
-        # through the ppermute transposes and every rank receives exactly
-        # its true gradient.  The caller psums the VALUE for metrics.
-        return loss_sum / num_micro
+        (_, loss_sum, aux_total), _ = lax.scan(tick, init, jnp.arange(ticks))
+        # LOCAL loss: CE is nonzero only on the last rank; each rank adds
+        # its own stages' router aux.  Deliberately NOT psum'd here —
+        # differentiating a psum inside shard_map would seed one cotangent
+        # per rank and overcount stage gradients pp times; with local
+        # losses the total = sum of rank-local terms, each seeded exactly
+        # once, and the ppermute transposes route every rank its true
+        # gradient.  The caller psums the VALUE for metrics.
+        local = loss_sum
+        if config.ffn_type == "moe":
+            local = local + config.router_aux_weight * aux_total
+        return local / num_micro
 
     return loss_fn
 
@@ -228,15 +246,6 @@ def make_pp_train_step(
     :func:`jax.eval_shape`-compatible :func:`~bpe_transformer_tpu.optim.
     adamw.adamw_init` over it.
     """
-    if config.ffn_type == "moe":
-        # The pipeline stage applies the aux-free transformer_block: running
-        # a MoE config here would silently drop the router load-balance loss
-        # and let routing collapse unregularized.  Fail as loudly as the
-        # training loop does.
-        raise NotImplementedError(
-            "pipeline parallelism does not yet thread the MoE router aux "
-            'loss; use strategy "dp_ep" for ffn_type="moe"'
-        )
     if pp_axis not in mesh.shape:
         raise ValueError(f"mesh {dict(mesh.shape)} lacks axis {pp_axis!r}")
     pp_size = mesh.shape[pp_axis]

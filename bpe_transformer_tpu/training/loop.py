@@ -56,6 +56,10 @@ class LoopConfig:
     parallel: str | None = None
     mesh_axes: dict | None = None  # e.g. {"data": 8} or {"data": 4, "model": 2}
     pp_microbatches: int = 4  # pipeline microbatches (parallel="pp")
+    #: Optimizer updates per XLA dispatch (lax.scan over the update body).
+    #: >1 amortizes host launch latency for small models — identical math.
+    #: Single-device only; log/eval/checkpoint cadences must be multiples.
+    inner_steps: int = 1
 
 
 def train(
@@ -175,11 +179,6 @@ def train(
         )
 
         pp_size = mesh.shape["pp"]
-        if model_config.ffn_type == "moe":
-            raise NotImplementedError(
-                'parallel="pp" does not yet thread the MoE router aux loss '
-                "through the pipeline schedule; use an ep strategy instead"
-            )
         # A resumed checkpoint may already carry the stacked pipeline layout;
         # a dense checkpoint (params AND optimizer moments) is re-stacked.
         if "stages" in params:
@@ -203,8 +202,32 @@ def train(
     if opt_state is None:
         opt_state = adamw_init(params)
 
+    stride = loop.inner_steps
+    if stride > 1:
+        if loop.parallel is not None:
+            raise NotImplementedError(
+                "inner_steps > 1 is single-device only (the scan would have "
+                "to live inside the sharded program); set parallel=None"
+            )
+        for name, every in (
+            ("log_every", loop.log_every),
+            ("eval_every", loop.eval_every),
+            ("checkpoint_every", loop.checkpoint_every),
+        ):
+            if every % stride:
+                raise ValueError(
+                    f"{name}={every} must be a multiple of inner_steps={stride}"
+                )
+
     if mesh is None:
-        step_fn = make_train_step(model_config, hparams)
+        if stride > 1:
+            from bpe_transformer_tpu.training.train_step import (
+                make_scanned_train_step,
+            )
+
+            step_fn = make_scanned_train_step(model_config, hparams, stride)
+        else:
+            step_fn = make_train_step(model_config, hparams)
         place = lambda b: b
     elif loop.parallel == "dp":
         step_fn = make_dp_train_step(model_config, hparams, mesh)
@@ -270,24 +293,43 @@ def train(
     # finally-close so an interrupt/OOM mid-run still flushes the JSONL
     # handle and finishes the wandb run.
     try:
-        for iteration in range(start_iteration, loop.steps):
+        iteration = start_iteration
+        while iteration < loop.steps:
             # Per-iteration seeding (not one stream advanced per step) so a
             # resumed run samples the SAME batch at the same iteration as an
             # uninterrupted one — preemption-safe determinism.
-            step_rng = np.random.default_rng((loop.seed, iteration))
-            x, y = get_batch(
-                train_data, loop.batch_size, model_config.context_length, step_rng
-            )
-            x, y = place((jax.numpy.asarray(x), jax.numpy.asarray(y)))
+            if stride > 1:
+                n = min(stride, loop.steps - iteration)
+                batches = [
+                    get_batch(
+                        train_data,
+                        loop.batch_size,
+                        model_config.context_length,
+                        np.random.default_rng((loop.seed, iteration + j)),
+                    )
+                    for j in range(n)
+                ]
+                x = jax.numpy.asarray(np.stack([b[0] for b in batches]))
+                y = jax.numpy.asarray(np.stack([b[1] for b in batches]))
+                if n != stride:  # tail shorter than the compiled scan length
+                    step_fn = make_scanned_train_step(model_config, hparams, n)
+            else:
+                n = 1
+                step_rng = np.random.default_rng((loop.seed, iteration))
+                x, y = get_batch(
+                    train_data, loop.batch_size, model_config.context_length, step_rng
+                )
+                x, y = place((jax.numpy.asarray(x), jax.numpy.asarray(y)))
             params, opt_state, metrics = step_fn(params, opt_state, x, y)
-            timer.update(tokens_per_step)
+            timer.update(tokens_per_step * n)
+            iteration += n
 
-            is_last = iteration + 1 == loop.steps
-            if (iteration + 1) % loop.log_every == 0 or is_last:
+            is_last = iteration == loop.steps
+            if iteration % loop.log_every == 0 or is_last:
                 last_loss = float(metrics["loss"])  # device sync point
                 rates = timer.snapshot()
                 record = {
-                    "step": iteration + 1,
+                    "step": iteration,
                     "loss": last_loss,
                     "lr": float(metrics["lr"]),
                     "grad_norm": float(metrics["grad_norm"]),
@@ -303,21 +345,21 @@ def train(
                 )
 
             if val_data is not None and (
-                (iteration + 1) % loop.eval_every == 0 or is_last
+                iteration % loop.eval_every == 0 or is_last
             ):
                 val_loss = run_eval()
-                sinks.log({"step": iteration + 1, "val_loss": val_loss})
-                log_fn(f"step {iteration + 1:>6d}  val_loss {val_loss:.4f}")
+                sinks.log({"step": iteration, "val_loss": val_loss})
+                log_fn(f"step {iteration:>6d}  val_loss {val_loss:.4f}")
 
             if loop.checkpoint_dir is not None and (
-                (iteration + 1) % loop.checkpoint_every == 0 or is_last
+                iteration % loop.checkpoint_every == 0 or is_last
             ):
-                ckpt_path = Path(loop.checkpoint_dir) / f"step_{iteration + 1:08d}.ckpt"
+                ckpt_path = Path(loop.checkpoint_dir) / f"step_{iteration:08d}.ckpt"
                 latest = Path(loop.checkpoint_dir) / "latest.ckpt"
                 state_kwargs = dict(
                     params=params,
                     opt_state=opt_state,
-                    iteration=iteration + 1,
+                    iteration=iteration,
                     extra={"val_loss": val_loss, "train_loss": last_loss},
                 )
                 if sharded_ckpt:

@@ -115,6 +115,40 @@ def make_train_step(config: ModelConfig, hparams: TrainHParams) -> Callable:
     return jax.jit(train_step_fn(config, hparams), donate_argnums=(0, 1))
 
 
+def make_scanned_train_step(
+    config: ModelConfig, hparams: TrainHParams, inner_steps: int
+) -> Callable:
+    """``inner_steps`` optimizer updates in ONE XLA program via ``lax.scan``.
+
+    For small models a single update is microseconds of device work, so
+    throughput is bounded by per-dispatch host latency (severe on relayed/
+    tunneled backends); scanning the update body amortizes that launch cost
+    over ``inner_steps`` real updates — identical math, one dispatch.
+
+    Signature: ``(params, opt_state, xs, ys) -> (params, opt_state,
+    metrics)`` where ``xs``/``ys`` carry a leading ``(inner_steps,)`` batch
+    dim and ``metrics`` reports the LAST inner step (one device sync per
+    call, like the per-step fn).
+    """
+    if inner_steps < 1:
+        raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
+    body = train_step_fn(config, hparams)
+
+    def multi(params, opt_state: AdamWState, xs, ys):
+        def scan_body(carry, batch):
+            p, s = carry
+            p, s, metrics = body(p, s, batch[0], batch[1])
+            return (p, s), metrics
+
+        (params, opt_state), metrics = jax.lax.scan(
+            scan_body, (params, opt_state), (xs, ys)
+        )
+        last = jax.tree_util.tree_map(lambda a: a[-1], metrics)
+        return params, opt_state, last
+
+    return jax.jit(multi, donate_argnums=(0, 1))
+
+
 def make_eval_step(config: ModelConfig) -> Callable:
     """Pure cross-entropy eval (no MoE router aux — that's a training
     regularizer; val_loss stays a log-perplexity comparable across configs).
