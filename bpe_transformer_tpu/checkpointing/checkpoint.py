@@ -181,9 +181,20 @@ def save_checkpoint_sharded(
         }
         with open(tmp_dir / _MANIFEST, "w") as f:
             json.dump(manifest, f)
+        # os.replace cannot atomically swap non-empty directories; displace
+        # any existing checkpoint with a RENAME (cheap, near-atomic window)
+        # and only rmtree the displaced copy AFTER the new one is in place —
+        # a preemption mid-save leaves either the old or the new checkpoint
+        # at out_dir, never neither.
+        displaced = None
         if out_dir.exists():
-            shutil.rmtree(out_dir)
+            displaced = Path(
+                tempfile.mkdtemp(dir=out_dir.parent, prefix=out_dir.name + ".old")
+            )
+            os.rename(out_dir, displaced / "d")
         os.replace(tmp_dir, out_dir)
+        if displaced is not None:
+            shutil.rmtree(displaced, ignore_errors=True)
     except BaseException:
         if tmp_dir.exists():
             shutil.rmtree(tmp_dir, ignore_errors=True)

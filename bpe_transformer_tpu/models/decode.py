@@ -31,9 +31,7 @@ from bpe_transformer_tpu.ops.core import (
     linear,
     merge_heads,
     rmsnorm,
-    silu,
     split_heads,
-    swiglu,
 )
 from bpe_transformer_tpu.ops.rope import apply_rope, rope_tables
 
@@ -57,27 +55,16 @@ def _rope_qk(q, k, positions, config):
 
 
 def _ffn_decode(x, ffn, config):
-    """FFN dispatch mirroring the training forward's `_ffn` (aux discarded).
+    """The training forward's FFN dispatch with the aux loss discarded.
 
     MoE note: routing capacity is computed over the tokens of THIS call —
     the whole prompt at prefill, ``batch`` tokens per decode step — so
     cached decoding matches the uncached forward exactly only when capacity
     is not binding (standard inference practice: generous capacity_factor).
     """
-    if config.ffn_type in (None, "swiglu"):
-        return swiglu(x, ffn["w1"], ffn["w2"], ffn["w3"])
-    if config.ffn_type == "silu":
-        return linear(silu(linear(x, ffn["w1"])), ffn["w2"])
-    if config.ffn_type == "gelu":
-        from bpe_transformer_tpu.kernels.pallas.gelu import gelu
+    from bpe_transformer_tpu.models.transformer import _ffn
 
-        return linear(gelu(linear(x, ffn["w1"])), ffn["w2"])
-    if config.ffn_type == "moe":
-        from bpe_transformer_tpu.models.moe import switch_ffn
-
-        out, _ = switch_ffn(x, ffn, config)
-        return out
-    raise ValueError(f"unknown ffn_type: {config.ffn_type!r}")
+    return _ffn(x, ffn, config)[0]
 
 
 def _block_apply(x, block_params, config, attend):

@@ -111,6 +111,8 @@ def cmd_train(args) -> int:
         parallel=args.parallel,
         mesh_axes=mesh_axes,
         pp_microbatches=args.pp_microbatches,
+        inner_steps=args.inner_steps,
+        grad_accum_steps=args.grad_accum_steps,
     )
     train_data = load_token_file(args.data, args.dtype)
     val_data = load_token_file(args.val_data, args.dtype) if args.val_data else None
@@ -230,6 +232,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--mesh",
         default=None,
         help='mesh axes, e.g. "data=8", "data=4,model=2", "data=2,pp=4"',
+    )
+    p.add_argument(
+        "--inner-steps",
+        type=int,
+        default=1,
+        help="optimizer updates per XLA dispatch (lax.scan; single device)",
+    )
+    p.add_argument(
+        "--grad-accum-steps",
+        type=int,
+        default=1,
+        help="microbatches per optimizer update (sequential gradient "
+        "accumulation; single device; must divide --batch-size)",
     )
     p.add_argument("--seed", type=int, default=0)
     p.set_defaults(fn=cmd_train)

@@ -60,6 +60,12 @@ class LoopConfig:
     #: >1 amortizes host launch latency for small models — identical math.
     #: Single-device only; log/eval/checkpoint cadences must be multiples.
     inner_steps: int = 1
+    #: Microbatches per optimizer update (gradient accumulation): each
+    #: batch of ``batch_size`` is split into this many sequential
+    #: microbatches, capping activation memory at one microbatch while the
+    #: update math is identical.  Single-device only; must divide
+    #: batch_size; mutually exclusive with inner_steps > 1.
+    grad_accum_steps: int = 1
 
 
 def train(
@@ -219,6 +225,23 @@ def train(
                     f"{name}={every} must be a multiple of inner_steps={stride}"
                 )
 
+    accum = loop.grad_accum_steps
+    if accum > 1:
+        if loop.parallel is not None:
+            raise NotImplementedError(
+                "grad_accum_steps > 1 is single-device only; shard the batch "
+                "over a mesh instead (parallel='dp'/'fsdp')"
+            )
+        if stride > 1:
+            raise ValueError(
+                "grad_accum_steps and inner_steps cannot both exceed 1"
+            )
+        if loop.batch_size % accum:
+            raise ValueError(
+                f"batch_size={loop.batch_size} must divide by "
+                f"grad_accum_steps={accum}"
+            )
+
     if mesh is None:
         if stride > 1:
             from bpe_transformer_tpu.training.train_step import (
@@ -226,6 +249,12 @@ def train(
             )
 
             step_fn = make_scanned_train_step(model_config, hparams, stride)
+        elif accum > 1:
+            from bpe_transformer_tpu.training.train_step import (
+                make_grad_accum_train_step,
+            )
+
+            step_fn = make_grad_accum_train_step(model_config, hparams, accum)
         else:
             step_fn = make_train_step(model_config, hparams)
         place = lambda b: b
@@ -319,6 +348,10 @@ def train(
                 x, y = get_batch(
                     train_data, loop.batch_size, model_config.context_length, step_rng
                 )
+                if accum > 1:  # (B, S) -> (accum, B/accum, S) microbatches
+                    micro = loop.batch_size // accum
+                    x = x.reshape(accum, micro, -1)
+                    y = y.reshape(accum, micro, -1)
                 x, y = place((jax.numpy.asarray(x), jax.numpy.asarray(y)))
             params, opt_state, metrics = step_fn(params, opt_state, x, y)
             timer.update(tokens_per_step * n)
@@ -372,6 +405,11 @@ def train(
                     latest.symlink_to(ckpt_path.name)
                 else:
                     save_checkpoint(ckpt_path, **state_kwargs)
+                    # A prior sharded run may have left latest as a symlink
+                    # to a checkpoint DIRECTORY — copyfile would follow it
+                    # and raise; clear it first.
+                    if latest.is_symlink() or latest.is_dir():
+                        latest.unlink()
                     # latest.ckpt is a byte copy — don't pay device_get +
                     # pickle twice.
                     shutil.copyfile(ckpt_path, latest)

@@ -115,6 +115,73 @@ def make_train_step(config: ModelConfig, hparams: TrainHParams) -> Callable:
     return jax.jit(train_step_fn(config, hparams), donate_argnums=(0, 1))
 
 
+def make_grad_accum_train_step(
+    config: ModelConfig, hparams: TrainHParams, accum_steps: int
+) -> Callable:
+    """One optimizer update from ``accum_steps`` microbatch gradients.
+
+    The microbatch loop is a ``lax.scan`` over a leading ``(accum_steps,)``
+    batch dim, so peak activation memory is ONE microbatch's forward/backward
+    while the effective batch is ``accum_steps x`` larger — the standard way
+    to train batch sizes that don't fit HBM on one chip.  Gradients and the
+    loss are averaged (identical to a single step on the concatenated batch,
+    since the loss is a mean over examples and microbatches are equal-size).
+
+    Signature: ``(params, opt_state, xs, ys) -> (params, opt_state,
+    metrics)`` with ``xs/ys: (accum_steps, micro_batch, seq)``.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    loss_fn = make_loss_fn(config)
+
+    def step(params, opt_state: AdamWState, xs, ys):
+        grad_fn = jax.value_and_grad(loss_fn)
+
+        def body(carry, batch):
+            loss_sum, grad_sum = carry
+            loss, grads = grad_fn(params, batch[0], batch[1])
+            grad_sum = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), grad_sum, grads
+            )
+            return (loss_sum + loss, grad_sum), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros), (xs, ys)
+        )
+        inv = 1.0 / accum_steps
+        loss = loss_sum * inv
+        grads = jax.tree_util.tree_map(lambda g: g * inv, grad_sum)
+
+        grads, grad_norm = clip_by_global_norm(grads, hparams.grad_clip_norm)
+        lr = cosine_schedule_jax(
+            opt_state.step,
+            hparams.max_learning_rate,
+            hparams.min_learning_rate,
+            hparams.warmup_iters,
+            hparams.cosine_cycle_iters,
+        )
+        params, opt_state = adamw_update(
+            params,
+            grads,
+            opt_state,
+            lr,
+            betas=hparams.betas,
+            eps=hparams.eps,
+            weight_decay=hparams.weight_decay,
+        )
+        metrics = {
+            "loss": loss.astype(jnp.float32),
+            "lr": lr.astype(jnp.float32),
+            "grad_norm": grad_norm,
+        }
+        return params, opt_state, metrics
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
 def make_scanned_train_step(
     config: ModelConfig, hparams: TrainHParams, inner_steps: int
 ) -> Callable:

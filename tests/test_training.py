@@ -304,3 +304,65 @@ def test_loop_inner_steps_trains_and_logs(tmp_path):
     )
     assert [h["step"] for h in summary["history"]] == [4, 8, 12, 16]
     assert summary["history"][-1]["loss"] < summary["history"][0]["loss"]
+
+
+def test_grad_accum_matches_full_batch_step():
+    """accum_steps microbatch gradients averaged in-scan == one step on the
+    concatenated batch (the loss is a mean over equal-size microbatches)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.training.train_step import (
+        TrainHParams,
+        make_grad_accum_train_step,
+        make_train_step,
+    )
+
+    cfg = dataclasses.replace(TS_TEST_CONFIG, vocab_size=256)
+    hp = TrainHParams(warmup_iters=2, cosine_cycle_iters=20)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 256, size=(8, cfg.context_length)))
+    y = jnp.asarray(rng.integers(0, 256, size=(8, cfg.context_length)))
+
+    p1 = init_params(jax.random.PRNGKey(0), cfg)
+    s1 = adamw_init(p1)
+    p1, s1, m1 = make_train_step(cfg, hp)(p1, s1, x, y)
+
+    p2 = init_params(jax.random.PRNGKey(0), cfg)
+    s2 = adamw_init(p2)
+    step = make_grad_accum_train_step(cfg, hp, 4)
+    xs = x.reshape(4, 2, -1)
+    ys = y.reshape(4, 2, -1)
+    p2, s2, m2 = step(p2, s2, xs, ys)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        ),
+        p1,
+        p2,
+    )
+
+
+def test_loop_grad_accum_trains():
+    from bpe_transformer_tpu.models.config import ModelConfig
+    from bpe_transformer_tpu.training.loop import LoopConfig, train
+    from bpe_transformer_tpu.training.train_step import TrainHParams
+
+    cfg = ModelConfig(vocab_size=128, context_length=16, d_model=32,
+                      num_layers=2, num_heads=2, d_ff=64)
+    data = np.tile(np.arange(cfg.vocab_size, dtype=np.int32), 100)
+    summary = train(
+        cfg,
+        TrainHParams(warmup_iters=2, cosine_cycle_iters=50),
+        LoopConfig(steps=12, batch_size=8, log_every=4, eval_every=1000,
+                   checkpoint_every=1000, grad_accum_steps=4),
+        train_data=data,
+        log_fn=lambda *_: None,
+    )
+    assert summary["history"][-1]["loss"] < summary["history"][0]["loss"]
