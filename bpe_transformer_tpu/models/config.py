@@ -48,6 +48,11 @@ class ModelConfig:
     # O(batch * chunk * vocab) instead of O(batch * seq * vocab).
     # None -> materialize full logits.  Must divide context_length.
     loss_chunk_size: int | None = None
+    # Sequence-parallel ring attention: sub-chunk each visiting K/V shard
+    # so per-device score memory is O(S_local * chunk) instead of
+    # O(S_local^2).  Must divide the local shard length.  None -> one full
+    # block per ring step.
+    ring_kv_chunk: int | None = None
 
     @property
     def d_head(self) -> int:

@@ -18,7 +18,11 @@ Two schedules:
   global positions; blocks strictly above a query shard's diagonal are
   folded in as no-ops via a predicated select, so under causal masking the
   ring is load-imbalanced (device 0 needs 1 block, device n-1 needs n) and
-  every device still computes every visiting block.
+  every device still computes every visiting block.  ``kv_chunk`` bounds
+  per-device score memory at O(S_local * chunk) by sub-chunking each
+  visiting block in a rematerialized scan (blockwise/flash math at shard
+  granularity — set ``ModelConfig.ring_kv_chunk`` to enable in sp
+  training).
 * :func:`zigzag_ring_self_attention` — striped ("zig-zag") shards: the
   sequence is cut into ``2n`` chunks and device ``i`` holds chunks
   ``(i, 2n-1-i)``, giving every device exactly ``2n+1`` visible
@@ -43,17 +47,76 @@ from bpe_transformer_tpu.ops.core import MASK_VALUE as NEG_INF
 P = PartitionSpec
 
 
+def _fold_visiting_block(
+    q32, k_blk, v_blk, state, row_base, col_base, causal, kv_chunk
+):
+    """Fold one visiting K/V block into the online-softmax ``state``.
+
+    ``kv_chunk`` (dividing the block's key length) processes the block in
+    sub-chunks inside a rematerialized ``lax.scan``: peak per-device score
+    memory drops from O(S_local^2) to O(S_local * kv_chunk) — the blockwise
+    (flash) trick at shard granularity, with the chunk body recomputed on
+    the backward pass instead of storing its scores.
+    """
+    s_q = q32.shape[-2]
+    s_kv = k_blk.shape[-2]
+    rows = jnp.arange(s_q)[:, None]
+
+    def fold(state, k_c, v_c, col0, width):
+        m, l, acc = state
+        scores = jnp.einsum("...qd,...kd->...qk", q32, k_c.astype(jnp.float32))
+        if causal:
+            cols = jnp.arange(width)[None, :]
+            keep = (row_base + rows) >= (col_base + col0 + cols)
+            scores = jnp.where(keep, scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "...qk,...kv->...qv", p, v_c.astype(jnp.float32)
+        )
+        return m_new, l_new, acc_new
+
+    if not kv_chunk or kv_chunk >= s_kv:
+        return fold(state, k_blk, v_blk, 0, s_kv)
+
+    if s_kv % kv_chunk:
+        raise ValueError(
+            f"kv_chunk {kv_chunk} must divide the shard length {s_kv}"
+        )
+    n_chunks = s_kv // kv_chunk
+    d = k_blk.shape[-1]
+    # Chunk axis must lead for lax.scan.
+    to_chunks = lambda x: jnp.moveaxis(
+        x.reshape(*x.shape[:-2], n_chunks, kv_chunk, d), -3, 0
+    )
+
+    @jax.checkpoint
+    def body(state, inp):
+        k_c, v_c, col0 = inp
+        return fold(state, k_c, v_c, col0, kv_chunk), None
+
+    col0s = jnp.arange(n_chunks) * kv_chunk
+    state, _ = jax.lax.scan(body, state, (to_chunks(k_blk), to_chunks(v_blk), col0s))
+    return state
+
+
 def ring_self_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     axis_name: str,
     causal: bool = True,
+    kv_chunk: int | None = None,
 ) -> jax.Array:
     """Attention on sequence shards; call INSIDE shard_map over ``axis_name``.
 
     Shapes (per device): ``q, k, v: (..., S_local, D)``; the global sequence
-    is the concatenation of shards in mesh-axis order.
+    is the concatenation of shards in mesh-axis order.  ``kv_chunk`` bounds
+    per-device score memory at O(S_local * kv_chunk) (blockwise
+    online-softmax within each visiting shard, rematerialized on backward);
+    ``None`` materializes one full (S_local, S_local) block per ring step.
     """
     n = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
@@ -67,27 +130,19 @@ def ring_self_attention(
     l = jnp.zeros(stat_shape, jnp.float32)
     acc = jnp.zeros(q.shape, jnp.float32)
 
-    rows = jnp.arange(s_local)[:, None]
-    cols = jnp.arange(s_local)[None, :]
-
     k_cur, v_cur = k, v
     for step in range(n):
         src = (me - step) % n  # which shard's K/V we hold this step
 
-        scores = jnp.einsum(
-            "...qd,...kd->...qk", q32, k_cur.astype(jnp.float32)
-        )
-        if causal:
-            # global row index = me*S+r, global col = src*S+c
-            keep = (me * s_local + rows) >= (src * s_local + cols)
-            scores = jnp.where(keep, scores, NEG_INF)
-
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.einsum(
-            "...qk,...kv->...qv", p, v_cur.astype(jnp.float32)
+        m_new, l_new, acc_new = _fold_visiting_block(
+            q32,
+            k_cur,
+            v_cur,
+            (m, l, acc),
+            me * s_local,
+            src * s_local,
+            causal,
+            kv_chunk,
         )
 
         if causal:

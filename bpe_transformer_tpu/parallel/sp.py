@@ -53,7 +53,12 @@ def sp_forward(
     s_local = local_token_ids.shape[-1]
     offset = jax.lax.axis_index(seq_axis) * s_local
     positions = offset + jnp.arange(s_local)
-    attention_fn = partial(ring_self_attention, axis_name=seq_axis, causal=True)
+    attention_fn = partial(
+        ring_self_attention,
+        axis_name=seq_axis,
+        causal=True,
+        kv_chunk=config.ring_kv_chunk,
+    )
     return forward(
         params, local_token_ids, config, positions=positions, attention_fn=attention_fn
     )
@@ -98,7 +103,10 @@ def make_sp_train_step(
                 offset = jax.lax.axis_index(seq_axis) * s_local
                 positions = offset + jnp.arange(s_local)
                 attention_fn = partial(
-                    ring_self_attention, axis_name=seq_axis, causal=True
+                    ring_self_attention,
+                    axis_name=seq_axis,
+                    causal=True,
+                    kv_chunk=config.ring_kv_chunk,
                 )
             hidden, aux = forward_hidden(
                 p, x, config, positions=positions, attention_fn=attention_fn
