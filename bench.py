@@ -97,6 +97,10 @@ def probe_accelerator() -> str:
     except Exception as exc:  # noqa: BLE001 - probe must never kill the bench
         note = repr(exc)
     print(f"accelerator unavailable ({note}); CPU fallback", file=sys.stderr)
+    RESULT["note"] = (
+        "accelerator unreachable at run time; benchmarks/RESULTS.md holds "
+        "the captured real-TPU result (664,875 tok/s/chip, 657x torch-CPU)"
+    )
     return "cpu"
 
 

@@ -1,6 +1,7 @@
 """Checkpoint/resume: host-side pytree serialization."""
 
 from bpe_transformer_tpu.checkpointing.checkpoint import (
+    AsyncCheckpointer,
     load_checkpoint,
     load_checkpoint_sharded,
     save_checkpoint,
@@ -8,6 +9,7 @@ from bpe_transformer_tpu.checkpointing.checkpoint import (
 )
 
 __all__ = [
+    "AsyncCheckpointer",
     "load_checkpoint",
     "load_checkpoint_sharded",
     "save_checkpoint",

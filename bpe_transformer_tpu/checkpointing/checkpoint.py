@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import threading
 import shutil
 import tempfile
 from pathlib import Path
@@ -118,66 +119,69 @@ def _distinct_shards(leaf) -> list[tuple[list[list[int]], Any]]:
     return out
 
 
-def save_checkpoint_sharded(
-    out_dir: str | os.PathLike,
-    *,
-    params: Any,
-    opt_state: Any = None,
-    iteration: int = 0,
-    extra: dict | None = None,
-) -> None:
-    """Stream a training state into a checkpoint DIRECTORY, shard by shard.
+def _leaf_snapshots(leaves, eager: bool):
+    """Per-leaf ``(record, [(filename, get_array)])`` write plan.
 
-    Every pytree leaf is written as one ``.npy`` per device shard (a leaf on
-    N devices under FSDP yields N files, each 1/N of the leaf); replicated
-    or host leaves yield a single file.  Peak host memory is therefore one
-    shard, never the assembled tree.  The pytree structure goes to
-    ``treedef.pkl`` (structure only, no array data) and shard geometry to
-    ``manifest.json``.  The directory is built under a temp name and renamed
-    into place, so a preempted save never leaves a partial checkpoint at
-    ``out_dir``.
+    ``eager=False`` defers every ``np.asarray`` to write time (the sync
+    path streams one shard at a time); ``eager=True`` materializes numpy
+    copies NOW so the caller may hand writing to a background thread while
+    the live device buffers get donated by the next train step.
     """
-    out_dir = Path(out_dir)
+    plan = []
+    for i, leaf in enumerate(leaves):
+        name = f"leaf_{i:05d}"
+        is_sharded = (
+            isinstance(leaf, jax.Array)
+            and hasattr(leaf, "addressable_shards")
+            and len(leaf.addressable_shards) > 1
+            and not leaf.is_fully_replicated
+        )
+        record = {
+            "name": name,
+            "shape": list(np.shape(leaf)),
+            "dtype": str(np.asarray(jax.device_get(leaf)).dtype)
+            if np.ndim(leaf) == 0
+            else str(leaf.dtype),
+        }
+        if is_sharded:
+            distinct = _distinct_shards(leaf)
+            record["shards"] = [{"index": index} for index, _ in distinct]
+            files = []
+            for j, (_, shard) in enumerate(distinct):
+                get = (lambda s: lambda: np.asarray(s.data))(shard)
+                if eager:
+                    arr = get()
+                    get = (lambda a: lambda: a)(arr)
+                files.append((f"{name}.{j:03d}.npy", get))
+        else:
+            get = (lambda l: lambda: np.asarray(jax.device_get(l)))(leaf)
+            if eager:
+                arr = get()
+                get = (lambda a: lambda: a)(arr)
+            files = [(f"{name}.npy", get)]
+        plan.append((record, files))
+    return plan
+
+
+def _write_sharded_dir(
+    out_dir: Path, treedef, plan, iteration: int, extra: dict | None
+) -> None:
+    """Write a snapshot plan into ``out_dir`` (tmp-dir build + rename)."""
     out_dir.parent.mkdir(parents=True, exist_ok=True)
     tmp_dir = Path(
         tempfile.mkdtemp(dir=out_dir.parent, prefix=out_dir.name + ".tmp")
     )
     try:
-        tree = {"params": params, "opt_state": opt_state}
-        leaves, treedef = jax.tree_util.tree_flatten(tree)
         with open(tmp_dir / "treedef.pkl", "wb") as f:
             pickle.dump(treedef, f)
-
-        leaf_records = []
-        for i, leaf in enumerate(leaves):
-            name = f"leaf_{i:05d}"
-            is_sharded = (
-                isinstance(leaf, jax.Array)
-                and hasattr(leaf, "addressable_shards")
-                and len(leaf.addressable_shards) > 1
-                and not leaf.is_fully_replicated
-            )
-            record = {
-                "name": name,
-                "shape": list(np.shape(leaf)),
-                "dtype": str(np.asarray(jax.device_get(leaf)).dtype)
-                if np.ndim(leaf) == 0
-                else str(leaf.dtype),
-            }
-            if is_sharded:
-                distinct = _distinct_shards(leaf)
-                record["shards"] = [{"index": index} for index, _ in distinct]
-                for j, (_, shard) in enumerate(distinct):
-                    np.save(tmp_dir / f"{name}.{j:03d}.npy", np.asarray(shard.data))
-            else:
-                np.save(tmp_dir / f"{name}.npy", np.asarray(jax.device_get(leaf)))
-            leaf_records.append(record)
-
+        for record, files in plan:
+            for fname, get_array in files:
+                np.save(tmp_dir / fname, get_array())
         manifest = {
             "format_version": _SHARDED_FORMAT_VERSION,
             "iteration": int(iteration),
             "extra": extra or {},
-            "leaves": leaf_records,
+            "leaves": [record for record, _ in plan],
         }
         with open(tmp_dir / _MANIFEST, "w") as f:
             json.dump(manifest, f)
@@ -199,6 +203,31 @@ def save_checkpoint_sharded(
         if tmp_dir.exists():
             shutil.rmtree(tmp_dir, ignore_errors=True)
         raise
+
+
+def save_checkpoint_sharded(
+    out_dir: str | os.PathLike,
+    *,
+    params: Any,
+    opt_state: Any = None,
+    iteration: int = 0,
+    extra: dict | None = None,
+) -> None:
+    """Stream a training state into a checkpoint DIRECTORY, shard by shard.
+
+    Every pytree leaf is written as one ``.npy`` per DISTINCT device shard
+    (a leaf on N devices under FSDP yields N files, each 1/N of the leaf);
+    replicated or host leaves yield a single file.  Peak host memory is
+    therefore one shard, never the assembled tree.  The pytree structure
+    goes to ``treedef.pkl`` (structure only, no array data) and shard
+    geometry to ``manifest.json``.  The directory is built under a temp
+    name and renamed into place, so a preempted save never leaves a partial
+    checkpoint at ``out_dir``.
+    """
+    tree = {"params": params, "opt_state": opt_state}
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    plan = _leaf_snapshots(leaves, eager=False)
+    _write_sharded_dir(Path(out_dir), treedef, plan, iteration, extra)
 
 
 def load_checkpoint_sharded(
@@ -257,3 +286,89 @@ def load_checkpoint_sharded(
         "iteration": manifest["iteration"],
         "extra": manifest["extra"],
     }
+
+
+# --------------------------------------------------------- async checkpoints
+
+
+class AsyncCheckpointer:
+    """Overlap checkpoint writing with training.
+
+    ``save()`` snapshots every leaf to host numpy SYNCHRONOUSLY (so the
+    live device buffers can be donated by the next train step) and hands
+    serialization + file IO to a background thread — the training loop
+    resumes after the device→host copy instead of waiting on disk.  At most
+    one write is in flight: the next ``save()`` (or ``close()``) joins the
+    previous one first and re-raises any error it hit.
+
+    Host-memory note: the eager snapshot stages one full copy of the state
+    in RAM for the duration of the write — the price of overlap.  Use the
+    plain ``save_checkpoint*`` functions where host memory is tighter than
+    step time.
+    """
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) finishes."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def save(
+        self,
+        out: str | os.PathLike,
+        *,
+        params: Any,
+        opt_state: Any = None,
+        iteration: int = 0,
+        extra: dict | None = None,
+        sharded: bool = False,
+        on_complete=None,
+    ) -> None:
+        """Snapshot now, write in the background (single- or sharded-format).
+
+        ``on_complete()`` runs in the worker thread after a SUCCESSFUL
+        write — e.g. to update a ``latest.ckpt`` pointer only once the
+        checkpoint actually exists on disk.
+        """
+        self.wait()
+        if sharded:
+            tree = {"params": params, "opt_state": opt_state}
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            plan = _leaf_snapshots(leaves, eager=True)
+
+            def write():
+                _write_sharded_dir(Path(out), treedef, plan, iteration, extra)
+
+        else:
+            host_params = _to_host(params)
+            host_opt = _to_host(opt_state) if opt_state is not None else None
+
+            def write():
+                save_checkpoint(
+                    out,
+                    params=host_params,
+                    opt_state=host_opt,
+                    iteration=iteration,
+                    extra=extra,
+                )
+
+        def work():
+            try:
+                write()
+                if on_complete is not None:
+                    on_complete()
+            except BaseException as exc:  # noqa: BLE001 - rethrown in wait()
+                self._error = exc
+
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self.wait()

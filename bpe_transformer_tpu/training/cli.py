@@ -113,6 +113,7 @@ def cmd_train(args) -> int:
         pp_microbatches=args.pp_microbatches,
         inner_steps=args.inner_steps,
         grad_accum_steps=args.grad_accum_steps,
+        async_checkpoint=args.async_checkpoint,
     )
     train_data = load_token_file(args.data, args.dtype)
     val_data = load_token_file(args.val_data, args.dtype) if args.val_data else None
@@ -238,6 +239,12 @@ def build_parser() -> argparse.ArgumentParser:
         type=int,
         default=1,
         help="optimizer updates per XLA dispatch (lax.scan; single device)",
+    )
+    p.add_argument(
+        "--async-checkpoint",
+        action="store_true",
+        help="write checkpoints in a background thread (overlaps IO with "
+        "training; costs one host-RAM copy of the state per save)",
     )
     p.add_argument(
         "--grad-accum-steps",

@@ -66,6 +66,10 @@ class LoopConfig:
     #: update math is identical.  Single-device only; must divide
     #: batch_size; mutually exclusive with inner_steps > 1.
     grad_accum_steps: int = 1
+    #: Overlap checkpoint serialization/IO with training: save() snapshots
+    #: to host synchronously and writes in a background thread (at most one
+    #: write in flight).  Costs one host-RAM copy of the state per save.
+    async_checkpoint: bool = False
 
 
 def train(
@@ -281,6 +285,11 @@ def train(
     # through the streaming directory format.  dp/sp keep replicated params
     # (single-file pickle is fine and keeps file-like compatibility).
     sharded_ckpt = mesh is not None and loop.parallel not in ("dp", "sp")
+    async_saver = None
+    if loop.async_checkpoint and loop.checkpoint_dir is not None:
+        from bpe_transformer_tpu.checkpointing.checkpoint import AsyncCheckpointer
+
+        async_saver = AsyncCheckpointer()
 
     eval_step = make_eval_step(model_config)
     n_chips = len(jax.devices()) if mesh is not None else 1
@@ -395,26 +404,46 @@ def train(
                     iteration=iteration,
                     extra={"val_loss": val_loss, "train_loss": last_loss},
                 )
-                if sharded_ckpt:
+
+                def update_latest(ckpt_path=ckpt_path, latest=latest):
+                    # A prior run of the other format may have left latest
+                    # as a symlink/dir; clear before re-pointing.
+                    if latest.is_symlink() or latest.exists():
+                        if latest.is_dir() and not latest.is_symlink():
+                            shutil.rmtree(latest)
+                        else:
+                            latest.unlink()
+                    if sharded_ckpt:
+                        latest.symlink_to(ckpt_path.name)
+                    else:
+                        # latest.ckpt is a byte copy — don't pay device_get
+                        # + pickle twice.
+                        shutil.copyfile(ckpt_path, latest)
+
+                if async_saver is not None:
+                    # Device→host snapshot happens now; serialization + IO
+                    # overlap with the next training steps.
+                    async_saver.save(
+                        ckpt_path,
+                        sharded=sharded_ckpt,
+                        on_complete=update_latest,
+                        **state_kwargs,
+                    )
+                elif sharded_ckpt:
                     # GSPMD-sharded states stream shard-by-shard into a
                     # checkpoint DIRECTORY — the full tree is never staged
                     # on host in one buffer (FSDP-scale requirement).
                     save_checkpoint_sharded(ckpt_path, **state_kwargs)
-                    if latest.is_symlink() or latest.exists():
-                        latest.unlink()
-                    latest.symlink_to(ckpt_path.name)
+                    update_latest()
                 else:
                     save_checkpoint(ckpt_path, **state_kwargs)
-                    # A prior sharded run may have left latest as a symlink
-                    # to a checkpoint DIRECTORY — copyfile would follow it
-                    # and raise; clear it first.
-                    if latest.is_symlink() or latest.is_dir():
-                        latest.unlink()
-                    # latest.ckpt is a byte copy — don't pay device_get +
-                    # pickle twice.
-                    shutil.copyfile(ckpt_path, latest)
+                    update_latest()
 
     finally:
+        if async_saver is not None:
+            # Join the in-flight write so a finished run always has its
+            # final checkpoint (and surface any background write error).
+            async_saver.close()
         sinks.close()
     summary = {
         "steps": loop.steps,

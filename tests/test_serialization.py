@@ -207,3 +207,74 @@ def test_loop_fsdp_uses_sharded_checkpoints_and_resumes(tmp_path):
     assert s_resumed["final_train_loss"] == pytest.approx(
         s_straight["final_train_loss"], rel=1e-5
     )
+
+
+def test_async_checkpointer_roundtrip(tmp_path):
+    """Background writes land the same bytes as sync saves, on_complete runs
+    after the checkpoint exists, and wait() surfaces write errors."""
+    from bpe_transformer_tpu.checkpointing import AsyncCheckpointer
+
+    params = init_params(jax.random.PRNGKey(0), TS_TEST_CONFIG)
+    state = adamw_init(params)
+    saver = AsyncCheckpointer()
+
+    seen = []
+    path = tmp_path / "a.ckpt"
+    saver.save(
+        path, params=params, opt_state=state, iteration=5,
+        on_complete=lambda: seen.append(path.exists()),
+    )
+    saver.wait()
+    assert seen == [True]
+    payload = load_checkpoint(path)
+    assert payload["iteration"] == 5
+    _assert_trees_equal(payload["params"], params)
+    _assert_trees_equal(payload["opt_state"], state)
+
+    # Sharded format through the same interface.
+    _, sparams, sstate = _fsdp_state()
+    sdir = tmp_path / "b.ckpt"
+    saver.save(sdir, params=sparams, opt_state=sstate, iteration=7, sharded=True)
+    saver.close()
+    payload = load_checkpoint(sdir)
+    assert payload["iteration"] == 7
+    _assert_trees_equal(payload["params"], sparams)
+
+    # A failing write is re-raised at the next wait(), not swallowed.
+    saver.save(tmp_path / "nope" / "\0bad", params=params, iteration=1)
+    with pytest.raises(BaseException):
+        saver.wait()
+
+
+def test_loop_async_checkpoint_resumable(tmp_path):
+    """async_checkpoint=True: the final checkpoint is joined at loop exit
+    and resumes bit-exact like the sync path."""
+    from bpe_transformer_tpu.models.config import ModelConfig
+    from bpe_transformer_tpu.training.loop import LoopConfig, train
+    from bpe_transformer_tpu.training.train_step import TrainHParams
+
+    cfg = ModelConfig(vocab_size=256, context_length=16, d_model=32,
+                      num_layers=2, num_heads=2, d_ff=64)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, cfg.vocab_size, size=10_000, dtype=np.int32)
+    hp = TrainHParams(warmup_iters=2, cosine_cycle_iters=20)
+    lk = dict(batch_size=8, log_every=2, eval_every=1000,
+              checkpoint_dir=str(tmp_path / "ck"), async_checkpoint=True)
+
+    train(cfg, hp, LoopConfig(steps=4, checkpoint_every=4, **lk),
+          train_data=data, log_fn=lambda *_: None)
+    latest = tmp_path / "ck" / "latest.ckpt"
+    assert latest.exists()
+
+    resumed = train(cfg, hp, LoopConfig(steps=8, checkpoint_every=4, **lk),
+                    train_data=data, resume_from=str(latest),
+                    log_fn=lambda *_: None)
+    straight = train(
+        cfg, hp,
+        LoopConfig(steps=8, checkpoint_every=8, batch_size=8, log_every=2,
+                   eval_every=1000, checkpoint_dir=str(tmp_path / "ck2")),
+        train_data=data, log_fn=lambda *_: None,
+    )
+    assert resumed["final_train_loss"] == pytest.approx(
+        straight["final_train_loss"], rel=1e-5
+    )
