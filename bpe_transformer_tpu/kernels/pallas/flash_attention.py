@@ -494,6 +494,50 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+# ---------------------------------------- ring / context-parallel interface
+
+
+def flash_attention_with_lse(
+    q, k, v, causal, block_q, block_k, interpret
+) -> tuple[jax.Array, jax.Array]:
+    """Forward + per-row logsumexp ``(..., seq)`` — the statistic a
+    ring/context-parallel caller needs to merge partial attention outputs
+    across visiting K/V shards (log-sum-exp combine).  Forward only; the
+    ring caller owns the custom VJP.
+    """
+    *batch, s, d = q.shape
+    out, lse = _flash_impl(
+        q, k, v, causal, block_q, block_k, interpret, return_lse=True
+    )
+    return out, lse[:, :s].reshape(*batch, s)
+
+
+def flash_attention_block_bwd(
+    q, k, v, out, lse, g, causal, block_q, block_k, interpret
+):
+    """Partial (dq, dk, dv) of ONE visiting K/V block, given the GLOBAL
+    forward output and logsumexp.
+
+    With ``lse``/``out`` computed over ALL keys, the recomputed block
+    probabilities ``exp(s_blk - lse)`` are the true global attention
+    weights of this block, so the returned grads are exactly this block's
+    additive contributions (the standard ring-flash backward).  ``q`` and
+    ``k``/``v`` must share the (square) shard length, divisible by the
+    block sizes.
+    """
+    *batch, s, d = q.shape
+    if s % math.lcm(min(block_q, s), min(block_k, s)):
+        raise ValueError(
+            f"block backward needs seq ({s}) divisible by the block sizes"
+        )
+    bh = 1
+    for dim in batch:
+        bh *= dim
+    return _flash_bwd_impl(
+        q, k, v, out, lse.reshape(bh, s), g, causal, block_q, block_k, interpret
+    )
+
+
 # ------------------------------------------------- fused RoPE + attention
 
 

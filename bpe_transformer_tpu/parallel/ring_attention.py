@@ -23,6 +23,12 @@ Two schedules:
   visiting block in a rematerialized scan (blockwise/flash math at shard
   granularity — set ``ModelConfig.ring_kv_chunk`` to enable in sp
   training).
+* :func:`ring_flash_attention` — the contiguous ring with the Pallas flash
+  kernel INSIDE each shard: per-step score memory rides VMEM tiles, partial
+  outputs merge by log-sum-exp, and the custom backward re-runs the
+  blockwise kernel per visiting shard against the GLOBAL lse/out, routing
+  each shard's dK/dV home around the ring (select with
+  ``attention_impl="flash"`` under sp training).
 * :func:`zigzag_ring_self_attention` — striped ("zig-zag") shards: the
   sequence is cut into ``2n`` chunks and device ``i`` holds chunks
   ``(i, 2n-1-i)``, giving every device exactly ``2n+1`` visible
@@ -161,6 +167,133 @@ def ring_self_attention(
             v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
 
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+# ------------------------------------------------- ring + Pallas flash
+
+
+def _merge_partials(out_acc, lse_acc, out_blk, lse_blk):
+    """Log-sum-exp combine of two partial attention results (f32)."""
+    lse_new = jnp.logaddexp(lse_acc, lse_blk)
+    w_acc = jnp.exp(lse_acc - lse_new)[..., None]
+    w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+    return out_acc * w_acc + out_blk * w_blk, lse_new
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
+    from bpe_transformer_tpu.kernels.pallas.flash_attention import (
+        flash_attention_with_lse,
+    )
+
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Step 0 — the diagonal block (own K/V) — is the only one needing a
+    # causal mask, and it is static: src == me exactly when step == 0.
+    out, lse = flash_attention_with_lse(q, k, v, True, block_q, block_k, interpret)
+    out = out.astype(jnp.float32)
+
+    k_cur, v_cur = k, v
+    for step in range(1, n):
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (me - step) % n
+        o_blk, l_blk = flash_attention_with_lse(
+            q, k_cur, v_cur, False, block_q, block_k, interpret
+        )
+        merged_out, merged_lse = _merge_partials(
+            out, lse, o_blk.astype(jnp.float32), l_blk
+        )
+        # Shards strictly after ours are fully masked under causality —
+        # fold as a no-op (same predicated-select pattern as the XLA ring).
+        visible = src < me
+        out = jnp.where(visible, merged_out, out)
+        lse = jnp.where(visible, merged_lse, lse)
+
+    return out.astype(q.dtype), lse
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, block_q, block_k, interpret):
+    out, lse = _ring_flash_fwd_impl(
+        q, k, v, axis_name, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, block_q, block_k, interpret, residuals, g):
+    from bpe_transformer_tpu.kernels.pallas.flash_attention import (
+        flash_attention_block_bwd,
+    )
+
+    q, k, v, out, lse = residuals
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Diagonal block: causal, own K/V.
+    dq, dk_acc, dv_acc = flash_attention_block_bwd(
+        q, k, v, out, lse, g, True, block_q, block_k, interpret
+    )
+    dq = dq.astype(jnp.float32)
+    dk_acc = dk_acc.astype(jnp.float32)
+    dv_acc = dv_acc.astype(jnp.float32)
+
+    k_cur, v_cur = k, v
+    for step in range(1, n):
+        # The grad accumulators travel WITH the K/V shard they belong to:
+        # after a full cycle (loop permutes + the final one below) each
+        # shard's dK/dV arrives back at its home device with every visiting
+        # device's contribution added.
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        src = (me - step) % n
+        dq_blk, dk_blk, dv_blk = flash_attention_block_bwd(
+            q, k_cur, v_cur, out, lse, g, False, block_q, block_k, interpret
+        )
+        visible = src < me
+        zero = jnp.zeros((), jnp.float32)
+        dq = dq + jnp.where(visible, dq_blk.astype(jnp.float32), zero)
+        dk_acc = dk_acc + jnp.where(visible, dk_blk.astype(jnp.float32), zero)
+        dv_acc = dv_acc + jnp.where(visible, dv_blk.astype(jnp.float32), zero)
+
+    dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    return (
+        dq.astype(q.dtype),
+        dk_acc.astype(k.dtype),
+        dv_acc.astype(v.dtype),
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal ring attention with the Pallas flash kernel INSIDE each shard.
+
+    Call inside shard_map over ``axis_name`` with per-device
+    ``(..., S_local, D)`` shards (contiguous layout, like
+    :func:`ring_self_attention`).  Per ring step the visiting K/V block runs
+    through the flash kernel (O(S_local * block) score memory on the VMEM
+    path) and partial outputs merge by log-sum-exp; the backward re-runs the
+    blockwise kernel per visiting shard with the GLOBAL lse/out — the
+    standard ring-flash decomposition — and routes each shard's dK/dV home
+    around the ring.  ``S_local`` must divide by the block sizes.
+    """
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret)
+    return out
+
+
+ring_flash_attention.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
 # ----------------------------------------------------- zig-zag schedule

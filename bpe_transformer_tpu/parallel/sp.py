@@ -29,6 +29,7 @@ from bpe_transformer_tpu.ops.grad import clip_by_global_norm
 from bpe_transformer_tpu.optim.adamw import AdamWState, adamw_update
 from bpe_transformer_tpu.optim.schedule import cosine_schedule_jax
 from bpe_transformer_tpu.parallel.ring_attention import (
+    ring_flash_attention,
     ring_self_attention,
     zigzag_indices,
     zigzag_positions,
@@ -53,14 +54,33 @@ def sp_forward(
     s_local = local_token_ids.shape[-1]
     offset = jax.lax.axis_index(seq_axis) * s_local
     positions = offset + jnp.arange(s_local)
-    attention_fn = partial(
+    attention_fn = _ring_attention_fn(config, seq_axis)
+    return forward(
+        params, local_token_ids, config, positions=positions, attention_fn=attention_fn
+    )
+
+
+def _ring_attention_fn(config: ModelConfig, seq_axis: str):
+    """Per-shard attention for the contiguous ring, per the config:
+    ``attention_impl="flash"`` runs the Pallas kernel inside every shard
+    (ring-flash), anything else the XLA online-softmax ring (optionally
+    kv-chunked)."""
+    if config.attention_impl == "flash":
+        from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
+
+        block = config.flash_block_size
+        return partial(
+            ring_flash_attention,
+            axis_name=seq_axis,
+            block_q=block,
+            block_k=block,
+            interpret=interpret_mode(),
+        )
+    return partial(
         ring_self_attention,
         axis_name=seq_axis,
         causal=True,
         kv_chunk=config.ring_kv_chunk,
-    )
-    return forward(
-        params, local_token_ids, config, positions=positions, attention_fn=attention_fn
     )
 
 
@@ -102,12 +122,7 @@ def make_sp_train_step(
             else:
                 offset = jax.lax.axis_index(seq_axis) * s_local
                 positions = offset + jnp.arange(s_local)
-                attention_fn = partial(
-                    ring_self_attention,
-                    axis_name=seq_axis,
-                    causal=True,
-                    kv_chunk=config.ring_kv_chunk,
-                )
+                attention_fn = _ring_attention_fn(config, seq_axis)
             hidden, aux = forward_hidden(
                 p, x, config, positions=positions, attention_fn=attention_fn
             )
