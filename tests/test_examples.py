@@ -61,3 +61,12 @@ def test_example_train_lm(tmp_path, sample):
     assert "4/4  sampling" in out
     assert (tmp_path / "lm_demo" / "checkpoints" / "latest.ckpt").exists()
     assert (tmp_path / "lm_demo" / "metrics.jsonl").exists()
+
+
+@pytest.mark.slow
+def test_example_long_context_sp(tmp_path, sample):
+    out = run_example(
+        tmp_path, sample, "5_long_context_sp.py",
+        "--steps", "6", "--context", "256", "--vocab-size", "300",
+    )
+    assert "long-context sp OK" in out
