@@ -357,3 +357,35 @@ def test_pp_moe_loop_trains():
         log_fn=lambda *_: None,
     )
     assert summary["history"][-1]["loss"] < summary["history"][0]["loss"]
+
+
+def test_fsdp_ep_step_matches_single_device():
+    """fsdp_ep: dense params sharded ZeRO-style over data while expert
+    stacks shard over the expert axis — the full CLI strategy matrix row."""
+    cfg = MOE_CFG
+    hp = TrainHParams(warmup_iters=2, cosine_cycle_iters=10)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    opt_state = adamw_init(params)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(16, cfg.context_length)))
+    y = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(16, cfg.context_length)))
+
+    single = make_train_step(cfg, hp)
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 2, "expert": 4})
+    params2 = init_params(jax.random.PRNGKey(0), cfg)
+    params2 = shard_params(params2, mesh, "fsdp_ep")
+    opt2 = adamw_init(params2)
+    step = make_gspmd_train_step(cfg, hp, mesh, "fsdp_ep", example_params=params2)
+    x2, y2 = shard_batch((x, y), mesh)
+    p2, s2, m2 = step(params2, opt2, x2, y2)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        ),
+        p1,
+        jax.device_get(p2),
+    )
