@@ -328,7 +328,12 @@ def main() -> int:
                 RESULT["vs_baseline"] = round(RESULT["value"] / baseline, 2)
             print(f"torch-cpu baseline: {baseline:,.0f} tok/s", file=sys.stderr)
         else:
-            RESULT["note"] = "torch baseline skipped (deadline headroom)"
+            skip = "torch baseline skipped (deadline headroom)"
+            # Don't clobber the accelerator-unreachable pointer — it is the
+            # note that matters when the number is a degraded CPU figure.
+            RESULT["note"] = (
+                f"{RESULT['note']}; {skip}" if RESULT.get("note") else skip
+            )
     except Exception as exc:  # noqa: BLE001 - the JSON line must still print
         print(f"benchmark failed: {exc!r}", file=sys.stderr)
         _emit(f"error: {exc!r}")

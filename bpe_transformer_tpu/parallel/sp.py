@@ -103,6 +103,12 @@ def make_sp_train_step(
     consistent (targets ride the same permutation as inputs).
     """
     n_seq = mesh.shape[seq_axis]
+    if zigzag and (config.attention_impl == "flash" or config.ring_kv_chunk):
+        raise ValueError(
+            "the zig-zag schedule runs its own striped XLA ring and does "
+            "not honor attention_impl='flash' or ring_kv_chunk; use the "
+            "contiguous ring (zigzag=False) for those, or clear them"
+        )
 
     def local_step(params, opt_state: AdamWState, x, y):
         def loss_fn(p):

@@ -111,6 +111,7 @@ def cmd_train(args) -> int:
         parallel=args.parallel,
         mesh_axes=mesh_axes,
         pp_microbatches=args.pp_microbatches,
+        sp_zigzag=args.sp_zigzag,
         inner_steps=args.inner_steps,
         grad_accum_steps=args.grad_accum_steps,
         async_checkpoint=args.async_checkpoint,
@@ -233,6 +234,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--mesh",
         default=None,
         help='mesh axes, e.g. "data=8", "data=4,model=2", "data=2,pp=4"',
+    )
+    p.add_argument(
+        "--sp-zigzag",
+        action="store_true",
+        help="balanced zig-zag ring schedule (with --parallel sp)",
     )
     p.add_argument(
         "--inner-steps",

@@ -56,6 +56,9 @@ class LoopConfig:
     parallel: str | None = None
     mesh_axes: dict | None = None  # e.g. {"data": 8} or {"data": 4, "model": 2}
     pp_microbatches: int = 4  # pipeline microbatches (parallel="pp")
+    #: With parallel="sp": run the balanced zig-zag (striped) ring schedule
+    #: (~2x less causal attention work at large seq meshes).
+    sp_zigzag: bool = False
     #: Optimizer updates per XLA dispatch (lax.scan over the update body).
     #: >1 amortizes host launch latency for small models — identical math.
     #: Single-device only; log/eval/checkpoint cadences must be multiples.
@@ -266,8 +269,10 @@ def train(
         step_fn = make_dp_train_step(model_config, hparams, mesh)
         place = lambda b: shard_batch(b, mesh)
     elif loop.parallel == "sp":
-        step_fn = make_sp_train_step(model_config, hparams, mesh)
-        place = lambda b: shard_sp_batch(b, mesh)
+        step_fn = make_sp_train_step(
+            model_config, hparams, mesh, zigzag=loop.sp_zigzag
+        )
+        place = lambda b: shard_sp_batch(b, mesh, zigzag=loop.sp_zigzag)
     elif loop.parallel == "pp":
         from bpe_transformer_tpu.parallel.pp import make_pp_train_step
 
@@ -315,7 +320,12 @@ def train(
                 val_data, loop.batch_size, model_config.context_length, eval_rng
             )
             ex, ey = (jax.numpy.asarray(ex), jax.numpy.asarray(ey))
-            if loop.parallel != "pp":
+            if loop.parallel == "sp":
+                # Eval runs the DENSE forward, which needs sequences in
+                # global order — place without the zig-zag permutation even
+                # when training uses it.
+                ex, ey = shard_sp_batch((ex, ey), mesh)
+            elif loop.parallel != "pp":
                 ex, ey = place((ex, ey))
             losses.append(float(eval_step(eval_params, ex, ey)))
         return float(np.mean(losses))
@@ -440,11 +450,15 @@ def train(
                     update_latest()
 
     finally:
-        if async_saver is not None:
-            # Join the in-flight write so a finished run always has its
-            # final checkpoint (and surface any background write error).
-            async_saver.close()
-        sinks.close()
+        try:
+            if async_saver is not None:
+                # Join the in-flight write so a finished run always has its
+                # final checkpoint (and surface any background write error).
+                async_saver.close()
+        finally:
+            # Even if the background write failed, flush the metric sinks —
+            # the recorded history matters most when the run just crashed.
+            sinks.close()
     summary = {
         "steps": loop.steps,
         "final_train_loss": last_loss,

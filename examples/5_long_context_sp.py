@@ -95,14 +95,14 @@ def main() -> int:
             checkpoint_dir=str(args.out / "checkpoints"),
             parallel="sp",
             mesh_axes=mesh_axes,
+            sp_zigzag=args.zigzag,
         ),
         train_data=tokens,
     )
     first, last = summary["history"][0]["loss"], summary["history"][-1]["loss"]
+    schedule = "zig-zag striped" if args.zigzag else "contiguous"
     print(f"     loss {first:.3f} -> {last:.3f} over {args.steps} steps "
-          f"(seq {args.context} sharded {n_dev}-way)")
-    if args.zigzag:
-        print("     (zig-zag schedule: see make_sp_train_step(zigzag=True))")
+          f"(seq {args.context} sharded {n_dev}-way, {schedule} ring)")
     print("long-context sp OK")
     return 0
 
