@@ -70,3 +70,12 @@ def test_example_long_context_sp(tmp_path, sample):
         "--steps", "6", "--context", "256", "--vocab-size", "300",
     )
     assert "long-context sp OK" in out
+
+
+@pytest.mark.slow
+def test_example_moe_expert_parallel(tmp_path, sample):
+    out = run_example(
+        tmp_path, sample, "6_moe_expert_parallel.py",
+        "--steps", "6", "--vocab-size", "300",
+    )
+    assert "moe expert-parallel OK" in out
