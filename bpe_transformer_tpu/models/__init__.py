@@ -5,6 +5,7 @@ from bpe_transformer_tpu.models.config import (
     GPT2_SMALL_32K,
     TINYSTORIES_4L,
     TINYSTORIES_12L,
+    TINYSTORIES_MOE,
     TS_TEST_CONFIG,
     ModelConfig,
 )
@@ -22,6 +23,7 @@ __all__ = [
     "ModelConfig",
     "TINYSTORIES_4L",
     "TINYSTORIES_12L",
+    "TINYSTORIES_MOE",
     "TS_TEST_CONFIG",
     "forward",
     "init_params",

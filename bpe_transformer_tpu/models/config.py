@@ -135,6 +135,23 @@ GPT2_SMALL_32K = ModelConfig(
     loss_chunk_size=256,
 )
 
+#: Sparse counterpart of TINYSTORIES_12L: 8-expert top-2 MoE FFNs with the
+#: same d_model/attention; train with an ep strategy (dp_ep/fsdp_ep) so the
+#: expert stacks shard over the expert mesh axis.
+TINYSTORIES_MOE = ModelConfig(
+    vocab_size=10_000,
+    context_length=512,
+    d_model=512,
+    num_layers=12,
+    num_heads=8,
+    d_ff=1365,
+    rope_theta=10000.0,
+    ffn_type="moe",
+    n_experts=8,
+    router_top_k=2,
+    capacity_factor=1.25,
+)
+
 #: BASELINE.json config 5: GPT-2-medium-class model (FSDP target).
 GPT2_MEDIUM = ModelConfig(
     vocab_size=32_000,

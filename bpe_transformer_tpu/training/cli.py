@@ -28,6 +28,7 @@ PRESETS = {
     "ts-test": model_configs.TS_TEST_CONFIG,
     "tinystories-4l": model_configs.TINYSTORIES_4L,
     "tinystories-12l": model_configs.TINYSTORIES_12L,
+    "tinystories-moe": model_configs.TINYSTORIES_MOE,
     "gpt2-small-32k": model_configs.GPT2_SMALL_32K,
     "gpt2-medium": model_configs.GPT2_MEDIUM,
 }
