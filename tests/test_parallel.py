@@ -350,14 +350,14 @@ def test_ring_attention_kv_chunked_matches_unchunked():
     from bpe_transformer_tpu.parallel.ring_attention import ring_self_attention
     from jax.sharding import PartitionSpec as P
 
-    mesh = make_mesh({"seq": 8})
+    mesh = make_mesh({"data": 2, "seq": 4})
     rng = np.random.default_rng(0)
-    B, H, S, D = 2, 2, 128, 16
+    B, H, S, D = 2, 2, 64, 16
     q, k, v = (
         jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
         for _ in range(3)
     )
-    spec = P(None, None, "seq", None)
+    spec = P("data", None, "seq", None)
 
     def run(kv_chunk):
         mapped = jax.shard_map(
@@ -427,14 +427,14 @@ def test_ring_flash_attention_matches_xla_ring():
     )
     from jax.sharding import PartitionSpec as P
 
-    mesh = make_mesh({"seq": 8})
+    mesh = make_mesh({"data": 2, "seq": 4})
     rng = np.random.default_rng(0)
-    B, H, S, D = 1, 2, 128, 16  # 8 shards of 16 tokens; 16-wide blocks
+    B, H, S, D = 2, 2, 64, 16  # 4 shards of 16 tokens; 16-wide blocks
     q, k, v = (
         jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
         for _ in range(3)
     )
-    spec = P(None, None, "seq", None)
+    spec = P("data", None, "seq", None)
 
     def run(fn):
         mapped = jax.shard_map(
