@@ -23,6 +23,10 @@ class ModelConfig:
     num_heads: int
     d_ff: int
     rope_theta: float = 10000.0
+    #: Grouped-query attention: K/V heads (None -> num_heads, i.e. MHA).
+    #: Must divide num_heads; shrinks KV projections and the decode cache
+    #: by num_heads // num_kv_heads.
+    num_kv_heads: int | None = None
     # Ablation flags (reference schema; defaults = the tested architecture).
     remove_rmsnorm: bool = False
     use_post_norm: bool = False
@@ -62,6 +66,13 @@ class ModelConfig:
         if self.d_model % self.num_heads:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by num_heads={self.num_heads}"
+            )
+        if self.num_kv_heads is not None and (
+            self.num_kv_heads < 1 or self.num_heads % self.num_kv_heads
+        ):
+            raise ValueError(
+                f"num_kv_heads={self.num_kv_heads} must divide "
+                f"num_heads={self.num_heads}"
             )
         if self.ffn_type == "moe" and self.n_experts < 1:
             raise ValueError(

@@ -39,7 +39,10 @@ KVCache = list  # [{"k": (B, H, ctx, dh), "v": (B, H, ctx, dh)} per layer]
 
 
 def init_kv_cache(config: ModelConfig, batch: int, dtype=jnp.float32) -> KVCache:
-    shape = (batch, config.num_heads, config.context_length, config.d_head)
+    # GQA stores only num_kv_heads — the cache (decode's HBM footprint)
+    # shrinks by the query-group factor.
+    kv_heads = config.num_kv_heads or config.num_heads
+    shape = (batch, kv_heads, config.context_length, config.d_head)
     return [
         {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(config.num_layers)
@@ -87,11 +90,20 @@ def _norm(x, w, config):
     return x if config.remove_rmsnorm else rmsnorm(x, w)
 
 
-def _project_qkv(h, attn, num_heads):
-    q = split_heads(linear(h, attn["q_proj"]), num_heads)
-    k = split_heads(linear(h, attn["k_proj"]), num_heads)
-    v = split_heads(linear(h, attn["v_proj"]), num_heads)
+def _project_qkv(h, attn, config):
+    kv_heads = config.num_kv_heads or config.num_heads
+    q = split_heads(linear(h, attn["q_proj"]), config.num_heads)
+    k = split_heads(linear(h, attn["k_proj"]), kv_heads)
+    v = split_heads(linear(h, attn["v_proj"]), kv_heads)
     return q, k, v
+
+
+def _expand_kv(x, config):
+    """Broadcast cached KV heads up to the query heads (GQA no-op for MHA)."""
+    kv_heads = config.num_kv_heads or config.num_heads
+    if kv_heads == config.num_heads:
+        return x
+    return jnp.repeat(x, config.num_heads // kv_heads, axis=1)
 
 
 def prefill(
@@ -112,7 +124,7 @@ def prefill(
     for block_params, layer_cache in zip(params["layers"], cache):
 
         def attend(h, block_params=block_params, layer_cache=layer_cache):
-            q, k, v = _project_qkv(h, block_params["attn"], config.num_heads)
+            q, k, v = _project_qkv(h, block_params["attn"], config)
             q, k = _rope_qk(q, k, positions, config)
             new_cache.append(
                 {
@@ -120,6 +132,7 @@ def prefill(
                     "v": lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, 0, 0)),
                 }
             )
+            k, v = _expand_kv(k, config), _expand_kv(v, config)
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
             scores = jnp.where(mask, scores, -jnp.inf)
             probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
@@ -158,17 +171,18 @@ def decode_step(
     for block_params, layer_cache in zip(params["layers"], cache):
 
         def attend(h, block_params=block_params, layer_cache=layer_cache):
-            q, k, v = _project_qkv(h, block_params["attn"], config.num_heads)
+            q, k, v = _project_qkv(h, block_params["attn"], config)
             q, k = _rope_qk(q, k, positions, config)
             k_cache = lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, pos, 0))
             v_cache = lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, pos, 0))
             new_cache.append({"k": k_cache, "v": v_cache})
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache) * scale  # (B,H,1,ctx)
+            k_full, v_full = _expand_kv(k_cache, config), _expand_kv(v_cache, config)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_full) * scale  # (B,H,1,ctx)
             scores = jnp.where(visible[None, None, None, :], scores, -jnp.inf)
             probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
                 h.dtype
             )
-            att = merge_heads(jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache))
+            att = merge_heads(jnp.einsum("bhqk,bhkd->bhqd", probs, v_full))
             return linear(att, block_params["attn"]["output_proj"])
 
         x = _block_apply(x, block_params, config, attend)

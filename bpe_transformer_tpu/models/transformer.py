@@ -49,6 +49,8 @@ def init_params(
         ).astype(dtype)
 
     d, ff, v = config.d_model, config.d_ff, config.vocab_size
+    # GQA: K/V project to num_kv_heads * d_head rows (== d for plain MHA).
+    d_kv = (config.num_kv_heads or config.num_heads) * config.d_head
     keys = jax.random.split(rng, 2 + config.num_layers)
     layers = []
     for i in range(config.num_layers):
@@ -67,8 +69,8 @@ def init_params(
             {
                 "attn": {
                     "q_proj": dense(k[0], d, d),
-                    "k_proj": dense(k[1], d, d),
-                    "v_proj": dense(k[2], d, d),
+                    "k_proj": dense(k[1], d_kv, d),
+                    "v_proj": dense(k[2], d_kv, d),
                     "output_proj": dense(k[3], d, d),
                 },
                 "ln1": jnp.ones((d,), dtype),
@@ -170,6 +172,7 @@ def _attention(
         attn_params["v_proj"],
         attn_params["output_proj"],
         config.num_heads,
+        num_kv_heads=config.num_kv_heads,
         positions=positions,
         rope_cos_sin=rope_cos_sin,
         causal=True,

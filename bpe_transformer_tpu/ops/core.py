@@ -114,6 +114,7 @@ def multihead_self_attention(
     o_w: Array,
     num_heads: int,
     *,
+    num_kv_heads: int | None = None,
     positions: Array | None = None,
     rope_theta: float | None = None,
     max_seq_len: int | None = None,
@@ -128,11 +129,19 @@ def multihead_self_attention(
     ``d_head = d_model // num_heads``.  ``attention_fn(q, k, v)`` swaps the
     materialized-scores attention for a fused kernel (e.g. Pallas flash
     attention); the callable owns its own (causal) masking.
+
+    ``num_kv_heads < num_heads`` is grouped-query attention: K/V project to
+    fewer heads (``k_w``/``v_w`` have ``num_kv_heads * d_head`` rows) and
+    each KV head serves ``num_heads // num_kv_heads`` query heads — the
+    projections and the KV cache shrink by that factor while scores/output
+    math is unchanged (KV heads broadcast up before the attention call, so
+    every ``attention_fn`` works untouched).
     """
     seq_len = x.shape[-2]
+    kv_heads = num_kv_heads or num_heads
     q = split_heads(linear(x, q_w), num_heads)
-    k = split_heads(linear(x, k_w), num_heads)
-    v = split_heads(linear(x, v_w), num_heads)
+    k = split_heads(linear(x, k_w), kv_heads)
+    v = split_heads(linear(x, v_w), kv_heads)
 
     if rope_cos_sin is not None or rope_theta is not None:
         if positions is None:
@@ -147,6 +156,11 @@ def multihead_self_attention(
         pos = jnp.expand_dims(positions, axis=-2)
         q = apply_rope(q, pos, cos, sin)
         k = apply_rope(k, pos, cos, sin)
+
+    if kv_heads != num_heads:
+        group = num_heads // kv_heads
+        k = jnp.repeat(k, group, axis=-3)
+        v = jnp.repeat(v, group, axis=-3)
 
     if attention_fn is not None:
         attended = attention_fn(q, k, v)

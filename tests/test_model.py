@@ -223,3 +223,79 @@ def test_config_json_roundtrip(tmp_path, reference_fixtures):
     assert cfg == TS_TEST_CONFIG
     cfg.to_json(tmp_path / "cfg.json")
     assert ModelConfig.from_json(tmp_path / "cfg.json") == cfg
+
+
+# ------------------------------------------------ grouped-query attention
+
+
+def test_gqa_equals_mha_with_repeated_kv_weights():
+    """A GQA forward == an MHA forward whose K/V weights repeat each KV
+    head's block once per query group (the defining GQA identity)."""
+    import dataclasses
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, forward, init_params
+
+    cfg_gqa = dataclasses.replace(
+        TS_TEST_CONFIG, vocab_size=256, num_kv_heads=2
+    )  # 4 query heads, 2 KV heads
+    cfg_mha = dataclasses.replace(TS_TEST_CONFIG, vocab_size=256)
+    params = init_params(jax.random.PRNGKey(0), cfg_gqa)
+
+    def repeat_kv(w):  # (kv*dh, d) -> (H*dh, d), each head block doubled
+        dh = cfg_gqa.d_head
+        blocks = [w[i * dh : (i + 1) * dh] for i in range(cfg_gqa.num_kv_heads)]
+        group = cfg_gqa.num_heads // cfg_gqa.num_kv_heads
+        return jnp.concatenate([b for blk in blocks for b in [blk] * group])
+
+    mha_params = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+    mha_params["layers"] = [
+        {
+            **layer,
+            "attn": {
+                **layer["attn"],
+                "k_proj": repeat_kv(layer["attn"]["k_proj"]),
+                "v_proj": repeat_kv(layer["attn"]["v_proj"]),
+            },
+        }
+        for layer in params["layers"]
+    ]
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, size=(2, 12)), jnp.int32
+    )
+    out_gqa = forward(params, ids, cfg_gqa)
+    out_mha = forward(mha_params, ids, cfg_mha)
+    np.testing.assert_allclose(
+        np.asarray(out_gqa), np.asarray(out_mha), atol=1e-5
+    )
+
+
+def test_gqa_cached_decode_parity_and_cache_shape():
+    """GQA: the KV cache holds only num_kv_heads, and cached greedy decode
+    matches the full-forward argmax loop."""
+    import dataclasses
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, forward, init_params
+    from bpe_transformer_tpu.models.decode import generate_cached, init_kv_cache
+
+    cfg = dataclasses.replace(
+        TS_TEST_CONFIG, vocab_size=256, context_length=32, num_kv_heads=1
+    )
+    cache = init_kv_cache(cfg, batch=2)
+    assert cache[0]["k"].shape == (2, 1, 32, cfg.d_head)
+
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    prompt = [3, 1, 4, 1, 5]
+    out = generate_cached(
+        params,
+        jnp.asarray([prompt], jnp.int32),
+        jax.random.PRNGKey(0),
+        config=cfg,
+        max_new_tokens=8,
+        temperature=0.0,
+    )
+    seq = list(prompt)
+    for _ in range(8):
+        logits = forward(params, jnp.asarray([seq], jnp.int32), cfg)
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    assert [int(t) for t in np.asarray(out[0])] == seq[len(prompt):]
