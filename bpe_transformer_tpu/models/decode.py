@@ -192,19 +192,30 @@ def decode_step(
     return logits, new_cache
 
 
-def _sample_from_logits(logits, key, temperature: float, top_k: int | None):
+def _sample_from_logits(
+    logits, key, temperature: float, top_k: int | None, top_p: float | None = None
+):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k is not None:
         kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        # Nucleus sampling: keep the smallest prob-descending prefix whose
+        # mass reaches top_p (the first token is always kept).
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p  # mass BEFORE each token
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1)
+        logits = jnp.where(logits < cutoff[..., None], -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1)
 
 
 @partial(
     jax.jit,
-    static_argnames=("config", "max_new_tokens", "temperature", "top_k"),
+    static_argnames=("config", "max_new_tokens", "temperature", "top_k", "top_p"),
 )
 def generate_cached(
     params: Params,
@@ -215,6 +226,7 @@ def generate_cached(
     max_new_tokens: int,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
 ) -> Array:
     """Sample ``(batch, max_new_tokens)`` continuations in one XLA program.
 
@@ -230,13 +242,13 @@ def generate_cached(
     cache = init_kv_cache(config, batch)
     logits, cache = prefill(params, prompt_ids, config, cache)
     key, sub = jax.random.split(key)
-    first = _sample_from_logits(logits, sub, temperature, top_k)
+    first = _sample_from_logits(logits, sub, temperature, top_k, top_p)
 
     def step(carry, _):
         token, pos, cache, key = carry
         logits, cache = decode_step(params, token, pos, cache, config)
         key, sub = jax.random.split(key)
-        nxt = _sample_from_logits(logits, sub, temperature, top_k)
+        nxt = _sample_from_logits(logits, sub, temperature, top_k, top_p)
         return (nxt, pos + 1, cache, key), nxt
 
     if max_new_tokens == 1:

@@ -166,6 +166,7 @@ def cmd_generate(args) -> int:
         max_new_tokens=args.max_new_tokens,
         temperature=args.temperature,
         top_k=args.top_k,
+        top_p=args.top_p,
         seed=args.seed,
     )
     print(text)
@@ -283,6 +284,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-tokens", type=int, default=128)
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None,
+                   help="nucleus sampling: keep the smallest prefix of "
+                   "probability mass >= p")
     p.add_argument("--special-token", action="append", default=None,
                    help='repeatable; default: ["<|endoftext|>"]')
     p.add_argument("--seed", type=int, default=0)

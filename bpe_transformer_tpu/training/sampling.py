@@ -23,16 +23,12 @@ from bpe_transformer_tpu.models.config import ModelConfig
 from bpe_transformer_tpu.models.transformer import forward
 
 
-@partial(jax.jit, static_argnames=("config", "temperature", "top_k"))
-def _sample_step(params, buf, length, key, *, config, temperature, top_k):
+@partial(jax.jit, static_argnames=("config", "temperature", "top_k", "top_p"))
+def _sample_step(params, buf, length, key, *, config, temperature, top_k, top_p):
+    from bpe_transformer_tpu.models.decode import _sample_from_logits
+
     logits = forward(params, buf[None, :], config)[0, length - 1]
-    if temperature == 0.0:
-        return jnp.argmax(logits)
-    logits = logits / temperature
-    if top_k is not None:
-        kth = jnp.sort(logits)[-top_k]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    return jax.random.categorical(key, logits)
+    return _sample_from_logits(logits, key, temperature, top_k, top_p)
 
 
 def generate_ids(
@@ -42,6 +38,7 @@ def generate_ids(
     max_new_tokens: int = 128,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     seed: int = 0,
     stop_id: int | None = None,
 ) -> list[int]:
@@ -67,6 +64,7 @@ def generate_ids(
             max_new_tokens=max_new_tokens,
             temperature=temperature,
             top_k=top_k,
+            top_p=top_p,
         )
         out = [int(t) for t in np.asarray(ids[0])]
         if stop_id is not None and stop_id in out:
@@ -93,6 +91,7 @@ def generate_ids(
                 config=config,
                 temperature=temperature,
                 top_k=top_k,
+                top_p=top_p,
             )
         )
         out.append(next_id)
@@ -114,6 +113,7 @@ def generate_text(
     max_new_tokens: int = 128,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     seed: int = 0,
 ) -> str:
     """Encode ``prompt``, sample a continuation, return prompt + decode."""
@@ -129,6 +129,7 @@ def generate_text(
         max_new_tokens=max_new_tokens,
         temperature=temperature,
         top_k=top_k,
+        top_p=top_p,
         seed=seed,
         stop_id=stop_id,
     )

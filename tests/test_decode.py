@@ -169,3 +169,45 @@ def test_sampler_long_generation_falls_back(setup):
         params, CFG, [1, 2, 3], max_new_tokens=40, temperature=0.0
     )
     assert len(out) == 40
+
+
+def test_top_p_sampling_masks_tail(setup):
+    """top_p keeps only the nucleus: with a peaked distribution and small p,
+    sampling must always return the argmax; samples stay in vocab range."""
+    import jax
+
+    from bpe_transformer_tpu.models.decode import _sample_from_logits
+
+    logits = jnp.log(
+        jnp.asarray([[0.6, 0.25, 0.1, 0.04, 0.01]], jnp.float32)
+    )
+    for seed in range(8):
+        tok = _sample_from_logits(
+            logits, jax.random.PRNGKey(seed), temperature=1.0,
+            top_k=None, top_p=0.5,
+        )
+        assert int(tok[0]) == 0  # only the 0.6 token is in the 0.5 nucleus
+
+    # p large enough to admit the top two: both appear, the tail never does.
+    seen = set()
+    for seed in range(40):
+        tok = _sample_from_logits(
+            logits, jax.random.PRNGKey(seed), temperature=1.0,
+            top_k=None, top_p=0.85,
+        )
+        seen.add(int(tok[0]))
+    assert seen == {0, 1}
+
+    # End-to-end through the cached sampler.
+    params, _ = setup
+    out = generate_cached(
+        params,
+        jnp.asarray([[1, 2, 3]], jnp.int32),
+        jax.random.PRNGKey(0),
+        config=CFG,
+        max_new_tokens=5,
+        temperature=1.0,
+        top_p=0.9,
+    )
+    assert out.shape == (1, 5)
+    assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < CFG.vocab_size))
