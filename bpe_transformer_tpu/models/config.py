@@ -27,6 +27,9 @@ class ModelConfig:
     #: Must divide num_heads; shrinks KV projections and the decode cache
     #: by num_heads // num_kv_heads.
     num_kv_heads: int | None = None
+    #: Tie the LM head to the token embedding matrix (no separate lm_head
+    #: parameter; the reference contract's untied schema stays the default).
+    tie_embeddings: bool = False
     # Ablation flags (reference schema; defaults = the tested architecture).
     remove_rmsnorm: bool = False
     use_post_norm: bool = False

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax import Array, lax
 
 from bpe_transformer_tpu.models.config import ModelConfig
-from bpe_transformer_tpu.models.transformer import Params
+from bpe_transformer_tpu.models.transformer import Params, lm_head_weight
 from bpe_transformer_tpu.ops.core import (
     embedding,
     linear,
@@ -144,7 +144,10 @@ def prefill(
         x = _block_apply(x, block_params, config, attend)
 
     x = _norm(x, params["ln_final"], config)
-    logits = linear(x[:, -1].astype(jnp.float32), params["lm_head"].astype(jnp.float32))
+    logits = linear(
+        x[:, -1].astype(jnp.float32),
+        lm_head_weight(params, config).astype(jnp.float32),
+    )
     return logits, new_cache
 
 
@@ -188,7 +191,10 @@ def decode_step(
         x = _block_apply(x, block_params, config, attend)
 
     x = _norm(x, params["ln_final"], config)
-    logits = linear(x[:, 0].astype(jnp.float32), params["lm_head"].astype(jnp.float32))
+    logits = linear(
+        x[:, 0].astype(jnp.float32),
+        lm_head_weight(params, config).astype(jnp.float32),
+    )
     return logits, new_cache
 
 

@@ -78,12 +78,21 @@ def init_params(
                 "ffn": ffn_params,
             }
         )
-    return {
+    params = {
         "token_embeddings": dense(keys[0], v, d),
         "layers": layers,
         "ln_final": jnp.ones((d,), dtype),
-        "lm_head": dense(keys[1], v, d),
     }
+    if not config.tie_embeddings:
+        params["lm_head"] = dense(keys[1], v, d)
+    return params
+
+
+def lm_head_weight(params: Params, config: ModelConfig) -> Array:
+    """The vocab-projection matrix: the embedding itself when tied."""
+    if config.tie_embeddings:
+        return params["token_embeddings"]
+    return params["lm_head"]
 
 
 # ------------------------------------------------------------------ forward
@@ -303,7 +312,9 @@ def forward(
     """
     x, aux_total = forward_hidden(params, token_ids, config, positions, attention_fn)
     # LM head always runs in float32 for stable logits/loss.
-    logits = linear(x.astype(jnp.float32), params["lm_head"].astype(jnp.float32))
+    logits = linear(
+        x.astype(jnp.float32), lm_head_weight(params, config).astype(jnp.float32)
+    )
     if return_aux:
         return logits, aux_total
     return logits
@@ -356,8 +367,9 @@ def state_dict_from_params(params: Params) -> dict:
     out = {
         "token_embeddings.weight": params["token_embeddings"],
         "ln_final.weight": params["ln_final"],
-        "lm_head.weight": params["lm_head"],
     }
+    if "lm_head" in params:  # absent under tie_embeddings
+        out["lm_head.weight"] = params["lm_head"]
     for i, layer in enumerate(params["layers"]):
         p = f"layers.{i}."
         out[p + "attn.q_proj.weight"] = layer["attn"]["q_proj"]

@@ -74,8 +74,9 @@ def stack_pipeline_params(params: Params, pp: int) -> dict:
     shared = {
         "token_embeddings": params["token_embeddings"],
         "ln_final": params["ln_final"],
-        "lm_head": params["lm_head"],
     }
+    if "lm_head" in params:  # absent under tie_embeddings
+        shared["lm_head"] = params["lm_head"]
     return {"stages": stages, "shared": shared}
 
 
@@ -89,12 +90,14 @@ def unstack_pipeline_params(pp_params: dict) -> Params:
         for s in range(pp)
         for i in range(per_stage)
     ]
-    return {
+    out = {
         "token_embeddings": pp_params["shared"]["token_embeddings"],
         "layers": layers,
         "ln_final": pp_params["shared"]["ln_final"],
-        "lm_head": pp_params["shared"]["lm_head"],
     }
+    if "lm_head" in pp_params["shared"]:
+        out["lm_head"] = pp_params["shared"]["lm_head"]
+    return out
 
 
 # ------------------------------------------------------------- loss (local)
@@ -159,7 +162,8 @@ def _pp_loss_fn(
                 act = rmsnorm(act, shared["ln_final"].astype(act_dtype))
             from bpe_transformer_tpu.ops.losses import lm_loss
 
-            return lm_loss(act, shared["lm_head"], targets, config.loss_chunk_size)
+            head_w = shared.get("lm_head", shared["token_embeddings"])
+            return lm_loss(act, head_w, targets, config.loss_chunk_size)
 
         fwd_perm = [(i, (i + 1) % pp_size) for i in range(pp_size)]
         ticks = num_micro + pp_size - 1

@@ -114,7 +114,10 @@ def make_sp_train_step(
         def loss_fn(p):
             # Memory-lean loss on the LOCAL sequence shard (already seq/N
             # long); lm_loss applies the shared clamp/divisibility guard.
-            from bpe_transformer_tpu.models.transformer import forward_hidden
+            from bpe_transformer_tpu.models.transformer import (
+                forward_hidden,
+                lm_head_weight,
+            )
             from bpe_transformer_tpu.ops.losses import lm_loss
 
             s_local = x.shape[-1]
@@ -132,7 +135,9 @@ def make_sp_train_step(
             hidden, aux = forward_hidden(
                 p, x, config, positions=positions, attention_fn=attention_fn
             )
-            loss = lm_loss(hidden, p["lm_head"], y, config.loss_chunk_size)
+            loss = lm_loss(
+                hidden, lm_head_weight(p, config), y, config.loss_chunk_size
+            )
             if config.ffn_type == "moe":
                 # Load-balance aux per dispatch group (the Switch
                 # convention): each shard routes its local tokens and

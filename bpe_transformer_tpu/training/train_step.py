@@ -40,12 +40,17 @@ def make_loss_fn(config: ModelConfig) -> Callable:
     is_moe = config.ffn_type == "moe"
 
     if config.loss_chunk_size:
-        from bpe_transformer_tpu.models.transformer import forward_hidden
+        from bpe_transformer_tpu.models.transformer import (
+            forward_hidden,
+            lm_head_weight,
+        )
         from bpe_transformer_tpu.ops.losses import lm_loss
 
         def loss_fn(params, x, y):
             hidden, aux = forward_hidden(params, x, config)
-            loss = lm_loss(hidden, params["lm_head"], y, config.loss_chunk_size)
+            loss = lm_loss(
+                hidden, lm_head_weight(params, config), y, config.loss_chunk_size
+            )
             if is_moe:
                 loss = loss + config.router_aux_weight * aux
             return loss
@@ -224,12 +229,17 @@ def make_eval_step(config: ModelConfig) -> Callable:
     the train step."""
 
     if config.loss_chunk_size:
-        from bpe_transformer_tpu.models.transformer import forward_hidden
+        from bpe_transformer_tpu.models.transformer import (
+            forward_hidden,
+            lm_head_weight,
+        )
         from bpe_transformer_tpu.ops.losses import lm_loss
 
         def eval_loss(params, x, y):
             hidden, _ = forward_hidden(params, x, config)
-            return lm_loss(hidden, params["lm_head"], y, config.loss_chunk_size)
+            return lm_loss(
+                hidden, lm_head_weight(params, config), y, config.loss_chunk_size
+            )
 
     else:
 

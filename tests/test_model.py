@@ -299,3 +299,49 @@ def test_gqa_cached_decode_parity_and_cache_shape():
         logits = forward(params, jnp.asarray([seq], jnp.int32), cfg)
         seq.append(int(jnp.argmax(logits[0, -1])))
     assert [int(t) for t in np.asarray(out[0])] == seq[len(prompt):]
+
+
+def test_tied_embeddings_share_head():
+    """tie_embeddings: no lm_head parameter; logits use the embedding
+    matrix; training moves the tied matrix; cached decode matches the full
+    forward."""
+    import dataclasses
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, forward, init_params
+    from bpe_transformer_tpu.models.decode import generate_cached
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.training.train_step import TrainHParams, make_train_step
+
+    cfg = dataclasses.replace(
+        TS_TEST_CONFIG, vocab_size=256, context_length=32, tie_embeddings=True
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert "lm_head" not in params
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, size=(2, 8)), jnp.int32
+    )
+    logits = forward(params, ids, cfg)
+    assert logits.shape == (2, 8, 256)
+
+    # Cached decode greedy parity (before training: the train step donates
+    # and deletes the param buffers).
+    prompt = [1, 2, 3, 4]
+    out = generate_cached(
+        params, jnp.asarray([prompt], jnp.int32), jax.random.PRNGKey(0),
+        config=cfg, max_new_tokens=6, temperature=0.0,
+    )
+    seq = list(prompt)
+    for _ in range(6):
+        lg = forward(params, jnp.asarray([seq], jnp.int32), cfg)
+        seq.append(int(jnp.argmax(lg[0, -1])))
+    assert [int(t) for t in np.asarray(out[0])] == seq[len(prompt):]
+
+    # Chunked-loss path exercises lm_head_weight too.
+    cfg_chunk = dataclasses.replace(cfg, loss_chunk_size=8)
+    step = make_train_step(cfg_chunk, TrainHParams(warmup_iters=1, cosine_cycle_iters=20))
+    opt = adamw_init(params)
+    p, s, m0 = step(params, opt, ids, jnp.roll(ids, -1, axis=1))
+    for _ in range(5):
+        p, s, m = step(p, s, ids, jnp.roll(ids, -1, axis=1))
+    assert float(m["loss"]) < float(m0["loss"])
