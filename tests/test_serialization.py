@@ -278,3 +278,39 @@ def test_loop_async_checkpoint_resumable(tmp_path):
     assert resumed["final_train_loss"] == pytest.approx(
         straight["final_train_loss"], rel=1e-5
     )
+
+
+def test_sharded_checkpoint_reshard_to_different_mesh(tmp_path):
+    """A checkpoint saved under one sharding layout loads onto ANOTHER
+    (fsdp 8-way -> fsdp_tp 4x2): leaves reassemble from shard files and
+    re-place onto the new mesh — elastic resharding."""
+    from bpe_transformer_tpu.checkpointing import (
+        load_checkpoint_sharded,
+        save_checkpoint_sharded,
+    )
+    from bpe_transformer_tpu.parallel import make_mesh, shard_params
+    from bpe_transformer_tpu.parallel.sharding import param_shardings
+
+    _, params, state = _fsdp_state()  # fsdp over {"data": 8}
+    ckpt = tmp_path / "reshard.ckpt"
+    save_checkpoint_sharded(ckpt, params=params, opt_state=state, iteration=3)
+
+    mesh2 = make_mesh({"data": 4, "model": 2})
+    target = param_shardings(params, mesh2, "fsdp_tp")
+    payload = load_checkpoint_sharded(
+        ckpt,
+        shardings={
+            "params": target,
+            "opt_state": type(state)(
+                step=jax.sharding.NamedSharding(
+                    mesh2, jax.sharding.PartitionSpec()
+                ),
+                m=target,
+                v=target,
+            ),
+        },
+    )
+    leaf = payload["params"]["layers"][0]["attn"]["q_proj"]
+    assert leaf.sharding == target["layers"][0]["attn"]["q_proj"]
+    _assert_trees_equal(payload["params"], params)
+    _assert_trees_equal(payload["opt_state"], state)
