@@ -179,13 +179,20 @@ def decode_step(
             k_cache = lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, pos, 0))
             v_cache = lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, pos, 0))
             new_cache.append({"k": k_cache, "v": v_cache})
-            k_full, v_full = _expand_kv(k_cache, config), _expand_kv(v_cache, config)
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_full) * scale  # (B,H,1,ctx)
-            scores = jnp.where(visible[None, None, None, :], scores, -jnp.inf)
+            # Grouped contraction straight against the compact cache: the
+            # per-token hot path must READ only num_kv_heads * ctx bytes —
+            # repeating the cache up to num_heads here would forfeit GQA's
+            # decode-bandwidth win.
+            b, n_h, _, dh = q.shape
+            kv_heads = k_cache.shape[1]
+            qg = q.reshape(b, kv_heads, n_h // kv_heads, 1, dh)
+            scores = jnp.einsum("bkgqd,bkcd->bkgqc", qg, k_cache) * scale
+            scores = jnp.where(visible[None, None, None, None, :], scores, -jnp.inf)
             probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
                 h.dtype
             )
-            att = merge_heads(jnp.einsum("bhqk,bhkd->bhqd", probs, v_full))
+            att = jnp.einsum("bkgqc,bkcd->bkgqd", probs, v_cache)
+            att = merge_heads(att.reshape(b, n_h, 1, dh))
             return linear(att, block_params["attn"]["output_proj"])
 
         x = _block_apply(x, block_params, config, attend)
@@ -214,6 +221,9 @@ def _sample_from_logits(
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         keep = cum - probs < top_p  # mass BEFORE each token
+        # The most likely token is always kept (also guards top_p <= 0,
+        # which would otherwise mask EVERY logit).
+        keep = keep.at[..., 0].set(True)
         cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1)
         logits = jnp.where(logits < cutoff[..., None], -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1)

@@ -334,6 +334,10 @@ def params_from_state_dict(state_dict: dict, num_layers: int) -> Params:
     def get(key):
         return jnp.asarray(state_dict[key])
 
+    head = {}
+    if "lm_head.weight" in state_dict:  # absent for tie_embeddings exports
+        head["lm_head"] = get("lm_head.weight")
+
     layers = []
     for i in range(num_layers):
         p = f"layers.{i}."
@@ -358,7 +362,7 @@ def params_from_state_dict(state_dict: dict, num_layers: int) -> Params:
         "token_embeddings": get("token_embeddings.weight"),
         "layers": layers,
         "ln_final": get("ln_final.weight"),
-        "lm_head": get("lm_head.weight"),
+        **head,
     }
 
 

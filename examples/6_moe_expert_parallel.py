@@ -55,6 +55,10 @@ def main() -> int:
     import jax
 
     n_dev = len(jax.devices())
+    if n_dev % args.experts:
+        parser.error(
+            f"--experts {args.experts} must divide the device count ({n_dev})"
+        )
     mesh_axes = {"data": n_dev // args.experts, "expert": args.experts}
     print(f"1/3  mesh {mesh_axes}: experts shard over the expert axis, "
           f"dispatch einsums lower to all-to-alls")

@@ -198,6 +198,14 @@ def test_top_p_sampling_masks_tail(setup):
         seen.add(int(tok[0]))
     assert seen == {0, 1}
 
+    # Degenerate p never masks everything: p=0 reduces to greedy.
+    for seed in range(4):
+        tok = _sample_from_logits(
+            logits, jax.random.PRNGKey(seed), temperature=1.0,
+            top_k=None, top_p=0.0,
+        )
+        assert int(tok[0]) == 0
+
     # End-to-end through the cached sampler.
     params, _ = setup
     out = generate_cached(

@@ -318,6 +318,17 @@ def test_tied_embeddings_share_head():
     params = init_params(jax.random.PRNGKey(0), cfg)
     assert "lm_head" not in params
 
+    # State-dict export/import stays an inverse pair without the head key.
+    from bpe_transformer_tpu.models.transformer import (
+        params_from_state_dict,
+        state_dict_from_params,
+    )
+
+    sd = state_dict_from_params(params)
+    assert "lm_head.weight" not in sd
+    back = params_from_state_dict(sd, cfg.num_layers)
+    assert "lm_head" not in back
+
     ids = jnp.asarray(
         np.random.default_rng(0).integers(0, 256, size=(2, 8)), jnp.int32
     )
