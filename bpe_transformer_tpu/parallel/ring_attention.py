@@ -29,6 +29,10 @@ Two schedules:
   blockwise kernel per visiting shard against the GLOBAL lse/out, routing
   each shard's dK/dV home around the ring (select with
   ``attention_impl="flash"`` under sp training).
+* :func:`zigzag_ring_flash_attention` — the striped schedule AND the
+  Pallas kernel per sub-block: both long-context optimizations at once
+  (balanced causal load + VMEM-tiled scores), with the lse-merge forward
+  and ring-routed blockwise backward of the flash ring.
 * :func:`zigzag_ring_self_attention` — striped ("zig-zag") shards: the
   sequence is cut into ``2n`` chunks and device ``i`` holds chunks
   ``(i, 2n-1-i)``, giving every device exactly ``2n+1`` visible
@@ -294,6 +298,180 @@ def ring_flash_attention(
 
 
 ring_flash_attention.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+# ------------------------------------------- zig-zag ring + Pallas flash
+
+
+def _zz_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
+    from bpe_transformer_tpu.kernels.pallas.flash_attention import (
+        flash_attention_with_lse,
+    )
+
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    c = q.shape[-2] // 2
+    split = lambda x: (x[..., :c, :], x[..., c:, :])
+    qa, qb = split(q)
+
+    def call(qq, kk, vv, causal):
+        o, lse = flash_attention_with_lse(
+            qq, kk, vv, causal, block_q, block_k, interpret
+        )
+        return o.astype(jnp.float32), lse
+
+    # Step 0 — own K/V; the only step with causal masking (both diagonal
+    # sub-blocks), and statically so.
+    ka, kb = split(k)
+    va, vb = split(v)
+    out_a, lse_a = call(qa, ka, va, True)
+    o2, l2 = call(qb, ka, va, False)
+    o3, l3 = call(qb, kb, vb, True)
+    out_b, lse_b = _merge_partials(o2, l2, o3, l3)
+
+    k_cur, v_cur = k, v
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(1, n):
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (me - step) % n
+        early = src < me  # visiting shard's low chunk precedes ours
+        ka, kb = split(k_cur)
+        va, vb = split(v_cur)
+
+        # Product 1: (early ? qa : qb) @ ka — one kernel call, operand
+        # selects route the state in/out (same trick as the XLA zig-zag).
+        q_sel = jnp.where(early, qa, qb)
+        o1, l1 = call(q_sel, ka, va, False)
+        in_out = jnp.where(early, out_a, out_b)
+        in_lse = jnp.where(early, lse_a, lse_b)
+        m_out, m_lse = _merge_partials(in_out, in_lse, o1, l1)
+        out_a = jnp.where(early, m_out, out_a)
+        lse_a = jnp.where(early, m_lse, lse_a)
+        out_b = jnp.where(early, out_b, m_out)
+        lse_b = jnp.where(early, lse_b, m_lse)
+
+        # Product 2: qb @ (early ? ka : kb).
+        k_sel = jnp.where(early, ka, kb)
+        v_sel = jnp.where(early, va, vb)
+        o2, l2 = call(qb, k_sel, v_sel, False)
+        out_b, lse_b = _merge_partials(out_b, lse_b, o2, l2)
+
+    out = jnp.concatenate([out_a, out_b], axis=-2).astype(q.dtype)
+    lse = jnp.concatenate([lse_a, lse_b], axis=-1)
+    return out, lse
+
+
+def _zz_flash_vjp_fwd(q, k, v, axis_name, block_q, block_k, interpret):
+    out, lse = _zz_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _zz_flash_vjp_bwd(axis_name, block_q, block_k, interpret, residuals, g):
+    from bpe_transformer_tpu.kernels.pallas.flash_attention import (
+        flash_attention_block_bwd,
+    )
+
+    q, k, v, out, lse = residuals
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    c = q.shape[-2] // 2
+    split = lambda x: (x[..., :c, :], x[..., c:, :])
+    splitl = lambda x: (x[..., :c], x[..., c:])
+    qa, qb = split(q)
+    ga, gb = split(g)
+    out_a, out_b = split(out)
+    lse_a, lse_b = splitl(lse)
+
+    def bwd(qq, kk, vv, oo, ll, gg, causal):
+        dq, dk, dv = flash_attention_block_bwd(
+            qq, kk, vv, oo, ll, gg, causal, block_q, block_k, interpret
+        )
+        return (
+            dq.astype(jnp.float32),
+            dk.astype(jnp.float32),
+            dv.astype(jnp.float32),
+        )
+
+    # Step 0: same three sub-blocks as the forward.
+    ka, kb = split(k)
+    va, vb = split(v)
+    dq1, dka1, dva1 = bwd(qa, ka, va, out_a, lse_a, ga, True)
+    dq2, dka2, dva2 = bwd(qb, ka, va, out_b, lse_b, gb, False)
+    dq3, dkb3, dvb3 = bwd(qb, kb, vb, out_b, lse_b, gb, True)
+    dq_a = dq1
+    dq_b = dq2 + dq3
+    # dK/dV accumulators travel with the visiting K/V shard (see
+    # ring_flash_attention) — one final permute delivers them home.
+    dk_acc = jnp.concatenate([dka1 + dka2, dkb3], axis=-2)
+    dv_acc = jnp.concatenate([dva1 + dva2, dvb3], axis=-2)
+
+    k_cur, v_cur = k, v
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(1, n):
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        src = (me - step) % n
+        early = src < me
+        ka, kb = split(k_cur)
+        va, vb = split(v_cur)
+
+        q_sel = jnp.where(early, qa, qb)
+        o_sel = jnp.where(early, out_a, out_b)
+        l_sel = jnp.where(early, lse_a, lse_b)
+        g_sel = jnp.where(early, ga, gb)
+        dq1, dk1, dv1 = bwd(q_sel, ka, va, o_sel, l_sel, g_sel, False)
+        dq_a = dq_a + jnp.where(early, dq1, 0.0)
+        dq_b = dq_b + jnp.where(early, 0.0, dq1)
+
+        k_sel = jnp.where(early, ka, kb)
+        v_sel = jnp.where(early, va, vb)
+        dq2, dk2, dv2 = bwd(qb, k_sel, v_sel, out_b, lse_b, gb, False)
+        dq_b = dq_b + dq2
+
+        dk_acc = dk_acc + jnp.concatenate(
+            [dk1 + jnp.where(early, dk2, 0.0), jnp.where(early, 0.0, dk2)],
+            axis=-2,
+        )
+        dv_acc = dv_acc + jnp.concatenate(
+            [dv1 + jnp.where(early, dv2, 0.0), jnp.where(early, 0.0, dv2)],
+            axis=-2,
+        )
+
+    dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    dq = jnp.concatenate([dq_a, dq_b], axis=-2)
+    return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def zigzag_ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """The balanced zig-zag ring WITH the Pallas flash kernel per sub-block.
+
+    Combines both long-context optimizations: the striped schedule's ~2x
+    causal load balance AND flash's VMEM-tiled score memory.  Per ring step
+    each device runs two half-size kernel calls (three on the diagonal
+    step) and merges partials by log-sum-exp; the custom backward re-runs
+    the blockwise kernel per sub-block against the GLOBAL per-chunk
+    out/lse, routing dK/dV home around the ring.  Use the zig-zag data
+    layout (:func:`zigzag_indices` / :func:`zigzag_positions`); the local
+    chunk length ``S_local/2`` must divide by the block sizes.
+    """
+    out, _ = _zz_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret)
+    return out
+
+
+zigzag_ring_flash_attention.defvjp(_zz_flash_vjp_fwd, _zz_flash_vjp_bwd)
 
 
 # ----------------------------------------------------- zig-zag schedule

@@ -33,6 +33,7 @@ from bpe_transformer_tpu.parallel.ring_attention import (
     ring_self_attention,
     zigzag_indices,
     zigzag_positions,
+    zigzag_ring_flash_attention,
     zigzag_ring_self_attention,
 )
 from bpe_transformer_tpu.training.train_step import TrainHParams
@@ -103,11 +104,11 @@ def make_sp_train_step(
     consistent (targets ride the same permutation as inputs).
     """
     n_seq = mesh.shape[seq_axis]
-    if zigzag and (config.attention_impl == "flash" or config.ring_kv_chunk):
+    if zigzag and config.ring_kv_chunk:
         raise ValueError(
-            "the zig-zag schedule runs its own striped XLA ring and does "
-            "not honor attention_impl='flash' or ring_kv_chunk; use the "
-            "contiguous ring (zigzag=False) for those, or clear them"
+            "the zig-zag schedule does not honor ring_kv_chunk (its "
+            "sub-blocks are already half-size); use the contiguous ring, "
+            'or attention_impl="flash" for VMEM-tiled zig-zag'
         )
 
     def local_step(params, opt_state: AdamWState, x, y):
@@ -125,9 +126,23 @@ def make_sp_train_step(
                 positions = zigzag_positions(
                     jax.lax.axis_index(seq_axis), s_local, n_seq
                 )
-                attention_fn = partial(
-                    zigzag_ring_self_attention, axis_name=seq_axis
-                )
+                if config.attention_impl == "flash":
+                    from bpe_transformer_tpu.kernels.pallas.runtime import (
+                        interpret_mode,
+                    )
+
+                    block = config.flash_block_size
+                    attention_fn = partial(
+                        zigzag_ring_flash_attention,
+                        axis_name=seq_axis,
+                        block_q=block,
+                        block_k=block,
+                        interpret=interpret_mode(),
+                    )
+                else:
+                    attention_fn = partial(
+                        zigzag_ring_self_attention, axis_name=seq_axis
+                    )
             else:
                 offset = jax.lax.axis_index(seq_axis) * s_local
                 positions = offset + jnp.arange(s_local)
