@@ -1,0 +1,243 @@
+"""Flash/chunked ring attention parity (split from test_parallel.py: these
+compile grad-of-shard_map programs with interpret-mode Pallas calls and
+dominate the file's runtime)."""
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bpe_transformer_tpu.models import TS_TEST_CONFIG, init_params
+from bpe_transformer_tpu.optim import adamw_init
+from bpe_transformer_tpu.parallel import make_mesh
+from bpe_transformer_tpu.training.train_step import TrainHParams, make_train_step
+
+CFG = dataclasses.replace(TS_TEST_CONFIG, vocab_size=512)
+HP = TrainHParams(warmup_iters=2, cosine_cycle_iters=10)
+
+
+def _setup(seed=0):
+    params = init_params(jax.random.PRNGKey(seed), CFG)
+    opt_state = adamw_init(params)
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, CFG.vocab_size, size=(16, CFG.context_length))
+    y = rng.integers(0, CFG.vocab_size, size=(16, CFG.context_length))
+    return params, opt_state, jnp.asarray(x), jnp.asarray(y)
+
+
+def test_ring_attention_kv_chunked_matches_unchunked():
+    """Blockwise per-shard ring (kv_chunk) == full-block ring, values AND
+    gradients (the chunk scan is rematerialized but numerically identical)."""
+    from functools import partial
+
+    from bpe_transformer_tpu.parallel.ring_attention import ring_self_attention
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    rng = np.random.default_rng(0)
+    B, H, S, D = 2, 2, 64, 16
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+        for _ in range(3)
+    )
+    spec = P("data", None, "seq", None)
+
+    def run(kv_chunk):
+        mapped = jax.shard_map(
+            partial(
+                ring_self_attention,
+                axis_name="seq",
+                causal=True,
+                kv_chunk=kv_chunk,
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+
+        def scalar(q, k, v):
+            return (mapped(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        val = scalar(q, k, v)
+        grads = jax.grad(scalar, argnums=(0, 1, 2))(q, k, v)
+        return val, grads
+
+    v_full, g_full = run(None)
+    v_chunk, g_chunk = run(4)  # 4 chunks per 16-long shard
+
+    np.testing.assert_allclose(float(v_full), float(v_chunk), rtol=1e-6)
+    for a, b in zip(g_full, g_chunk):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_sp_step_with_ring_kv_chunk_matches_single_device():
+    """The sp train step under ring_kv_chunk reproduces the single-device
+    update, like the unchunked sp test."""
+    import dataclasses
+
+    from bpe_transformer_tpu.parallel import make_sp_train_step, shard_sp_batch
+
+    cfg = dataclasses.replace(CFG, ring_kv_chunk=4)
+    params, opt_state, x, y = _setup()
+    single = make_train_step(cfg, HP)
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    params2, opt_state2, x2, y2 = _setup()
+    step = make_sp_train_step(cfg, HP, mesh)
+    x2, y2 = shard_sp_batch((x2, y2), mesh)
+    p2, s2, m2 = step(params2, opt_state2, x2, y2)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        p1,
+        p2,
+    )
+
+
+def test_ring_flash_attention_matches_xla_ring():
+    """Ring + Pallas flash inside each shard (interpret mode on CPU):
+    values and grads match the XLA online-softmax ring."""
+    from functools import partial
+
+    from bpe_transformer_tpu.parallel.ring_attention import (
+        ring_flash_attention,
+        ring_self_attention,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    rng = np.random.default_rng(0)
+    B, H, S, D = 2, 2, 64, 16  # 4 shards of 16 tokens; 16-wide blocks
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+        for _ in range(3)
+    )
+    spec = P("data", None, "seq", None)
+
+    def run(fn):
+        mapped = jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+
+        def scalar(q, k, v):
+            return (mapped(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        return scalar(q, k, v), jax.grad(scalar, argnums=(0, 1, 2))(q, k, v)
+
+    v_ref, g_ref = run(partial(ring_self_attention, axis_name="seq", causal=True))
+    v_fl, g_fl = run(
+        partial(
+            ring_flash_attention, axis_name="seq", block_q=16, block_k=16,
+            interpret=True,
+        )
+    )
+    np.testing.assert_allclose(float(v_ref), float(v_fl), rtol=1e-5)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-3)
+
+
+def test_sp_step_with_ring_flash_matches_single_device():
+    """sp training with attention_impl='flash' (ring-flash per shard)
+    reproduces the single-device update within kernel tolerance."""
+    import dataclasses
+
+    from bpe_transformer_tpu.parallel import make_sp_train_step, shard_sp_batch
+
+    cfg = dataclasses.replace(CFG, attention_impl="flash", flash_block_size=4)
+    params, opt_state, x, y = _setup()
+    single = make_train_step(dataclasses.replace(CFG), HP)  # XLA single-device
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    params2, opt_state2, x2, y2 = _setup()
+    step = make_sp_train_step(cfg, HP, mesh)
+    x2, y2 = shard_sp_batch((x2, y2), mesh)
+    p2, s2, m2 = step(params2, opt_state2, x2, y2)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4
+        ),
+        p1,
+        p2,
+    )
+
+
+def test_zigzag_ring_flash_matches_xla_zigzag():
+    """Zig-zag ring with the Pallas kernel per sub-block (interpret mode):
+    values and grads match the XLA zig-zag ring."""
+    from functools import partial
+
+    from bpe_transformer_tpu.parallel.ring_attention import (
+        zigzag_ring_flash_attention,
+        zigzag_ring_self_attention,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    rng = np.random.default_rng(0)
+    B, H, S, D = 2, 2, 128, 16  # 4 shards x 32 local (two 16-chunks)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+        for _ in range(3)
+    )
+    spec = P("data", None, "seq", None)
+
+    def run(fn):
+        mapped = jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+
+        def scalar(q, k, v):
+            return (mapped(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        return scalar(q, k, v), jax.grad(scalar, argnums=(0, 1, 2))(q, k, v)
+
+    v_ref, g_ref = run(partial(zigzag_ring_self_attention, axis_name="seq"))
+    v_fl, g_fl = run(
+        partial(
+            zigzag_ring_flash_attention, axis_name="seq", block_q=16,
+            block_k=16, interpret=True,
+        )
+    )
+    np.testing.assert_allclose(float(v_ref), float(v_fl), rtol=1e-5)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4, rtol=1e-3)
+
+
+def test_sp_zigzag_flash_step_matches_single_device():
+    """sp training with zigzag=True AND attention_impl='flash' (both
+    long-context optimizations together) == the single-device update."""
+    import dataclasses
+
+    from bpe_transformer_tpu.parallel import make_sp_train_step, shard_sp_batch
+
+    cfg = dataclasses.replace(CFG, attention_impl="flash", flash_block_size=4)
+    params, opt_state, x, y = _setup()
+    single = make_train_step(CFG, HP)  # XLA single-device oracle
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    params2, opt_state2, x2, y2 = _setup()
+    step = make_sp_train_step(cfg, HP, mesh, zigzag=True)
+    x2, y2 = shard_sp_batch((x2, y2), mesh, zigzag=True)
+    p2, s2, m2 = step(params2, opt_state2, x2, y2)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4
+        ),
+        p1,
+        p2,
+    )
