@@ -323,20 +323,24 @@ def forward(
 # ------------------------------------------------- torch state-dict interop
 
 
-def params_from_state_dict(state_dict: dict, num_layers: int) -> Params:
+def params_from_state_dict(
+    state_dict: dict, num_layers: int, tied: bool = False
+) -> Params:
     """Build the param pytree from flat torch-style keys (numpy/jnp values).
 
     Key schema: `adapters.py:307-353` (``token_embeddings.weight``,
     ``layers.{i}.attn.{q,k,v,output}_proj.weight``, ``layers.{i}.ln{1,2}.weight``,
     ``layers.{i}.ffn.w{1,2,3}.weight``, ``ln_final.weight``, ``lm_head.weight``).
+
+    ``tied=True`` loads a ``tie_embeddings`` export (no ``lm_head.weight``);
+    by default a missing head key fails fast here rather than as a distant
+    KeyError at the first forward.
     """
 
     def get(key):
         return jnp.asarray(state_dict[key])
 
-    head = {}
-    if "lm_head.weight" in state_dict:  # absent for tie_embeddings exports
-        head["lm_head"] = get("lm_head.weight")
+    head = {} if tied else {"lm_head": get("lm_head.weight")}
 
     layers = []
     for i in range(num_layers):

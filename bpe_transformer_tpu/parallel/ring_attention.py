@@ -308,6 +308,10 @@ def _zz_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
         flash_attention_with_lse,
     )
 
+    if q.shape[-2] % 2:
+        raise ValueError(
+            f"zig-zag local length must be even, got {q.shape[-2]}"
+        )
     n = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     c = q.shape[-2] // 2

@@ -61,22 +61,26 @@ def sp_forward(
     )
 
 
-def _ring_attention_fn(config: ModelConfig, seq_axis: str):
-    """Per-shard attention for the contiguous ring, per the config:
+def _ring_attention_fn(config: ModelConfig, seq_axis: str, zigzag: bool = False):
+    """Per-shard attention for the ring, per the config:
     ``attention_impl="flash"`` runs the Pallas kernel inside every shard
-    (ring-flash), anything else the XLA online-softmax ring (optionally
-    kv-chunked)."""
+    (ring-flash / zig-zag ring-flash), anything else the XLA online-softmax
+    ring (optionally kv-chunked; zig-zag has no chunk knob — its sub-blocks
+    are already half-size)."""
     if config.attention_impl == "flash":
         from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
 
         block = config.flash_block_size
+        fn = zigzag_ring_flash_attention if zigzag else ring_flash_attention
         return partial(
-            ring_flash_attention,
+            fn,
             axis_name=seq_axis,
             block_q=block,
             block_k=block,
             interpret=interpret_mode(),
         )
+    if zigzag:
+        return partial(zigzag_ring_self_attention, axis_name=seq_axis)
     return partial(
         ring_self_attention,
         axis_name=seq_axis,
@@ -126,27 +130,11 @@ def make_sp_train_step(
                 positions = zigzag_positions(
                     jax.lax.axis_index(seq_axis), s_local, n_seq
                 )
-                if config.attention_impl == "flash":
-                    from bpe_transformer_tpu.kernels.pallas.runtime import (
-                        interpret_mode,
-                    )
-
-                    block = config.flash_block_size
-                    attention_fn = partial(
-                        zigzag_ring_flash_attention,
-                        axis_name=seq_axis,
-                        block_q=block,
-                        block_k=block,
-                        interpret=interpret_mode(),
-                    )
-                else:
-                    attention_fn = partial(
-                        zigzag_ring_self_attention, axis_name=seq_axis
-                    )
+                attention_fn = _ring_attention_fn(config, seq_axis, zigzag=True)
             else:
                 offset = jax.lax.axis_index(seq_axis) * s_local
                 positions = offset + jnp.arange(s_local)
-                attention_fn = _ring_attention_fn(config, seq_axis)
+                attention_fn = _ring_attention_fn(config, seq_axis, zigzag=False)
             hidden, aux = forward_hidden(
                 p, x, config, positions=positions, attention_fn=attention_fn
             )

@@ -326,8 +326,11 @@ def test_tied_embeddings_share_head():
 
     sd = state_dict_from_params(params)
     assert "lm_head.weight" not in sd
-    back = params_from_state_dict(sd, cfg.num_layers)
+    back = params_from_state_dict(sd, cfg.num_layers, tied=True)
     assert "lm_head" not in back
+    # Untied load of a tied export fails FAST at the missing key.
+    with pytest.raises(KeyError):
+        params_from_state_dict(sd, cfg.num_layers)
 
     ids = jnp.asarray(
         np.random.default_rng(0).integers(0, 256, size=(2, 8)), jnp.int32
