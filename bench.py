@@ -265,7 +265,20 @@ def make_torch_lm(C):
     cos, sin = torch.cos(ang), torch.sin(ang)
     mask = torch.tril(torch.ones(s, s, dtype=torch.bool))
 
+    from bpe_transformer_tpu.optim.schedule import cosine_schedule
+
+    step_count = [0]
+
     def train_step(ids, labels):
+        # The SAME warmup+cosine schedule as the JAX side's TrainHParams
+        # defaults — val_parity.py compares the two steps under identical
+        # hyperparameters (an unscheduled torch baseline learns faster over
+        # the first 100 warmup steps and the comparison stops being
+        # apples-to-apples).
+        lr = cosine_schedule(step_count[0], 3e-4, 3e-5, 100, 10_000)
+        for group in opt.param_groups:
+            group["lr"] = lr
+        step_count[0] += 1
         opt.zero_grad()
         logits = model(ids, cos, sin, mask)
         loss = F.cross_entropy(logits.view(-1, C.vocab_size), labels.view(-1))
