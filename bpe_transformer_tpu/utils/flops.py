@@ -37,12 +37,13 @@ _PEAK_FLOPS_BY_KIND: tuple[tuple[str, float], ...] = (
 def matmul_param_count(config: ModelConfig) -> int:
     """Parameters participating in dense matmuls (excludes embedding gather)."""
     d, ff, L = config.d_model, config.d_ff, config.num_layers
-    attn = 4 * d * d  # q, k, v, output projections
+    # q + output are (d, d); GQA shrinks k/v to (num_kv_heads * d_head, d).
+    d_kv = (config.num_kv_heads or config.num_heads) * config.d_head
+    attn = 2 * d * d + 2 * d * d_kv
     if config.ffn_type == "moe":
-        # Per-token compute is one expert (top-1 Switch routing): the dense
-        # FLOPs seen by a token are a single expert's SwiGLU FFN (w1/w2/w3,
+        # Per-token compute is router_top_k experts' SwiGLU FFNs (w1/w2/w3,
         # models/moe.py init_moe_params) + the router projection.
-        ffn = 3 * d * ff + d * config.n_experts
+        ffn = config.router_top_k * 3 * d * ff + d * config.n_experts
     elif config.ffn_type in ("silu", "gelu"):
         ffn = 2 * d * ff
     else:  # SwiGLU: w1, w3 (d->ff) and w2 (ff->d)
