@@ -95,7 +95,11 @@ def _init_result() -> None:
 
 
 def _capture_path() -> Path:
-    return CAPTURE_DIR / f"tpu_capture_{ARGS.config}.json"
+    # Non-default batches get their own file so an exploratory --batch run
+    # can never clobber the default-shape capture the driver replays.
+    default_batch = BENCH_CONFIGS[ARGS.config][1]
+    suffix = "" if ARGS.batch in (None, default_batch) else f"_b{ARGS.batch}"
+    return CAPTURE_DIR / f"tpu_capture_{ARGS.config}{suffix}.json"
 
 
 def _save_capture() -> None:
@@ -105,14 +109,14 @@ def _save_capture() -> None:
     if RESULT.get("replayed_capture"):  # never re-stamp a replay as fresh
         return
     try:
-        _prior_full = json.loads(_capture_path().read_text())
+        prior = json.loads(_capture_path().read_text())
     except (OSError, json.JSONDecodeError):
-        _prior_full = {}
+        prior = {}
     # A short partial measurement (tunnel dropped mid-run) must not replace
     # a complete same-shape capture as the replay source.
     if (
-        _prior_full.get("batch") == RESULT.get("batch")
-        and (_prior_full.get("measure_steps") or 0) > (RESULT.get("measure_steps") or 0)
+        prior.get("batch") == RESULT.get("batch")
+        and (prior.get("measure_steps") or 0) > (RESULT.get("measure_steps") or 0)
     ):
         print(
             "keeping prior capture (more measure_steps than this run)",
@@ -129,10 +133,6 @@ def _save_capture() -> None:
     # capture: the torch-CPU baseline is stable across runs (same host,
     # same step), so carry it forward and recompute the ratio — marked.
     if payload.get("vs_baseline") is None:
-        try:
-            prior = json.loads(_capture_path().read_text())
-        except (OSError, json.JSONDecodeError):
-            prior = {}
         prior_torch = prior.get("torch_cpu_tokens_per_sec")
         # Only a baseline measured at the SAME shape is comparable.
         if prior.get("batch") != payload.get("batch"):
@@ -274,7 +274,7 @@ def resolve_config(on_accel: bool):
 
     import bpe_transformer_tpu.models as models
 
-    attr, _, _, _ = BENCH_CONFIGS[ARGS.config]
+    attr = BENCH_CONFIGS[ARGS.config][0]
     config = getattr(models, attr)
     # bf16 activations only where there is an MXU; host CPU emulates bf16.
     overrides = {"activation_dtype": "bfloat16" if on_accel else "float32"}
@@ -316,7 +316,7 @@ def bench_jax(platform: str) -> None:
 
     on_accel = jax.devices()[0].platform != "cpu"
     config = resolve_config(on_accel)
-    _, _, inner_default, measure_default = BENCH_CONFIGS[ARGS.config]
+    _, _, inner_default, measure_default, _ = BENCH_CONFIGS[ARGS.config]
     batch = ARGS.batch
     warmup_steps = max(2 * inner_default, 2) if on_accel else 1
     measure_steps = measure_default if on_accel else 4

@@ -153,6 +153,15 @@ def main() -> int:
             q, k, v,
             label=f"flash_bwd@{seq}", iters=iters,
         )
+        t_fused_bwd = _bench(
+            grad_of(
+                lambda q, k, v: flash_attention_with_rope(
+                    q, k, v, cos_s, sin_s, True, 512, 512, not on_tpu
+                )
+            ),
+            q, k, v,
+            label=f"fused_bwd@{seq}", iters=iters,
+        )
         print(
             json.dumps(
                 {
@@ -164,7 +173,9 @@ def main() -> int:
                     "speedup_fused": _ratio(t_xla, t_fused),
                     "xla_bwd_ms": _ms(t_xla_bwd),
                     "pallas_bwd_ms": _ms(t_flash_bwd),
+                    "pallas_fused_rope_bwd_ms": _ms(t_fused_bwd),
                     "speedup_bwd": _ratio(t_xla_bwd, t_flash_bwd),
+                    "speedup_bwd_fused": _ratio(t_xla_bwd, t_fused_bwd),
                     "device": str(jax.devices()[0]),
                 }
             ),

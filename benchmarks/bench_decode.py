@@ -1,0 +1,163 @@
+"""Decode-path throughput: KV-cached vs uncached autoregressive sampling.
+
+The reference's contract stops at logits (it ships no sampler at all); this
+framework's decode stack is `models/decode.py` (prefill + lax.scan'd
+per-token steps over a KV cache, one XLA program per generation) with the
+uncached full-forward path of `training/sampling.py` as the baseline.
+
+Run on a TPU host:  python benchmarks/bench_decode.py
+Prints one JSON line per (config, batch) with both tokens/sec figures.
+
+`--config tinystories-4l|gpt2-small-32k` and `--batch N` restrict the grid
+so long runs can be split across invocations (tunnel-outage hygiene).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+CONFIGS = {
+    "tinystories-4l": "TINYSTORIES_4L",
+    "gpt2-small-32k": "GPT2_SMALL_32K",
+}
+PROMPT_LEN = 64
+
+
+def make_uncached_step(params, config):
+    """One jitted full-forward sample step, built ONCE per config so timed
+    iterations hit jax's jit cache (a fresh closure per call would recompile
+    every dispatch and the 'uncached' baseline would measure compilation)."""
+    from bpe_transformer_tpu.models.decode import _sample_from_logits
+    from bpe_transformer_tpu.models.transformer import forward
+
+    @jax.jit
+    def step(buf, length, key):
+        logits = forward(params, buf, config)[:, length - 1]
+        key, sub = jax.random.split(key)
+        nxt = _sample_from_logits(logits, sub, 1.0, None, None)
+        buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, length))
+        return buf, nxt, key
+
+    return step
+
+
+def _uncached_generate(step, config, prompt, key, max_new_tokens):
+    """Full forward over the whole context buffer per emitted token — the
+    sliding-window fallback of training/sampling.py, batched, timed as the
+    baseline the KV cache is supposed to beat."""
+    batch, plen = prompt.shape
+    ctx = config.context_length
+    buf = jnp.zeros((batch, ctx), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+    length = plen
+    last = None
+    for _ in range(max_new_tokens):
+        buf, last, key = step(buf, jnp.asarray(length), key)
+        length += 1
+    return last
+
+
+def _time(fn, *args, iters: int, label: str):
+    try:
+        out = fn(*args)  # compile + first run
+        jax.block_until_ready(out)
+        float(jax.device_get(jnp.asarray(out).reshape(-1)[0]))  # hard barrier
+        start = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        float(jax.device_get(jnp.asarray(out).reshape(-1)[0]))
+        return (time.perf_counter() - start) / iters
+    except Exception as exc:  # noqa: BLE001 - report the case as absent
+        print(f"{label} failed: {exc!r}"[:300], file=sys.stderr)
+        return None
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", choices=sorted(CONFIGS), default=None)
+    parser.add_argument("--batch", type=int, default=None)
+    args = parser.parse_args()
+
+    import dataclasses
+
+    import bpe_transformer_tpu.models as models
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.models.decode import generate_cached
+
+    on_accel = jax.default_backend() != "cpu"
+    new_tokens = 128 if on_accel else 16
+    iters = 3 if on_accel else 1
+
+    names = [args.config] if args.config else sorted(CONFIGS)
+    batches = [args.batch] if args.batch else [1, 8]
+    for name in names:
+        # decode.py runs in f32 (the KV cache default); keep both paths f32
+        # so cached-vs-uncached is an algorithmic comparison, not a dtype one.
+        config = dataclasses.replace(
+            getattr(models, CONFIGS[name]),
+            activation_dtype="float32",
+            attention_impl="xla",
+        )
+        params = init_params(jax.random.PRNGKey(0), config)
+        rng = np.random.default_rng(0)
+        for batch in batches:
+            prompt = jnp.asarray(
+                rng.integers(0, config.vocab_size, size=(batch, PROMPT_LEN)),
+                dtype=jnp.int32,
+            )
+            key = jax.random.PRNGKey(1)
+
+            t_cached = _time(
+                lambda: generate_cached(
+                    params, prompt, key, config=config,
+                    max_new_tokens=new_tokens,
+                ),
+                iters=iters,
+                label=f"cached {name} B={batch}",
+            )
+            uncached_step = make_uncached_step(params, config)
+            t_uncached = _time(
+                lambda: _uncached_generate(
+                    uncached_step, config, prompt, key, new_tokens
+                ),
+                iters=iters,
+                label=f"uncached {name} B={batch}",
+            )
+
+            def tps(t):
+                return round(batch * new_tokens / t, 1) if t else None
+
+            print(
+                json.dumps(
+                    {
+                        "metric": f"decode_tokens_per_sec ({name}, B={batch}, "
+                        f"prompt={PROMPT_LEN}, new={new_tokens})",
+                        "kv_cached_tok_per_s": tps(t_cached),
+                        "uncached_tok_per_s": tps(t_uncached),
+                        "speedup": (
+                            round(t_uncached / t_cached, 2)
+                            if t_cached and t_uncached
+                            else None
+                        ),
+                        "device": str(jax.devices()[0]),
+                        "platform": jax.devices()[0].platform,
+                    }
+                ),
+                flush=True,
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
