@@ -39,6 +39,29 @@ import numpy as np
 _FORMAT_VERSION = 1
 _SHARDED_FORMAT_VERSION = 2
 _MANIFEST = "manifest.json"
+#: Marker file dropped in displaced-checkpoint temp dirs so recovery/reclaim
+#: only ever touches directories THIS code created (a user's manual
+#: ``cp -r x.ckpt x.ckpt.old`` backup carries no marker and is left alone).
+_DISPLACED_MARKER = ".bt_displaced"
+
+
+def _stranded_orphans(path: Path) -> list[Path]:
+    """Displaced-checkpoint dirs a crashed save stranded next to ``path``,
+    oldest first.  Matched by name prefix via listdir (no glob — checkpoint
+    names may contain glob metacharacters) and required to carry both the
+    ownership marker and a complete manifest."""
+    parent = path.parent
+    if not parent.is_dir():
+        return []
+    prefix = path.name + ".old"
+    orphans = [
+        parent / entry
+        for entry in os.listdir(parent)
+        if entry.startswith(prefix)
+        and (parent / entry / _DISPLACED_MARKER).exists()
+        and (parent / entry / "d" / _MANIFEST).exists()
+    ]
+    return sorted(orphans, key=lambda p: (p / "d").stat().st_mtime)
 
 
 def _to_host(tree):
@@ -77,11 +100,23 @@ def save_checkpoint(
         raise
 
 
+def sharded_checkpoint_exists(path: str | os.PathLike) -> bool:
+    """True when ``path`` is a loadable sharded checkpoint: it has a
+    manifest, or a crash-stranded ``<name>.old*/d`` sibling does (the
+    recovery case :func:`load_checkpoint_sharded` handles)."""
+    p = Path(path)
+    return (p / _MANIFEST).exists() or bool(_stranded_orphans(p))
+
+
 def load_checkpoint(src: str | os.PathLike | BinaryIO) -> dict:
     """Load a snapshot; returns the payload dict (params, opt_state,
     iteration, extra).  Accepts a single-file checkpoint, a file-like
-    object, or a sharded checkpoint directory (auto-detected)."""
-    if not hasattr(src, "read") and Path(src).is_dir():
+    object, or a sharded checkpoint directory (auto-detected, including
+    the crash-stranded-orphan recovery case)."""
+    if not hasattr(src, "read") and (
+        Path(src).is_dir()
+        or (not Path(src).exists() and sharded_checkpoint_exists(src))
+    ):
         return load_checkpoint_sharded(src)
     if hasattr(src, "read"):
         payload = pickle.load(src)
@@ -130,6 +165,22 @@ def _leaf_snapshots(leaves, eager: bool):
     plan = []
     for i, leaf in enumerate(leaves):
         name = f"leaf_{i:05d}"
+        # Multi-host guard: on a multi-process mesh each process addresses
+        # only its local shards, so this single-writer format would record a
+        # fraction of the leaf and a later load would silently restore
+        # uninitialized memory in the gaps.  Refuse rather than corrupt —
+        # multi-host saves need a per-process manifest (or orbax).
+        if (
+            isinstance(leaf, jax.Array)
+            and hasattr(leaf, "is_fully_addressable")
+            and not leaf.is_fully_addressable
+        ):
+            raise ValueError(
+                f"leaf {i} is not fully addressable from this process "
+                "(multi-process mesh); the sharded single-writer checkpoint "
+                "format cannot record it completely. Gather to host or use a "
+                "per-process checkpoint scheme."
+            )
         is_sharded = (
             isinstance(leaf, jax.Array)
             and hasattr(leaf, "addressable_shards")
@@ -186,19 +237,43 @@ def _write_sharded_dir(
         with open(tmp_dir / _MANIFEST, "w") as f:
             json.dump(manifest, f)
         # os.replace cannot atomically swap non-empty directories; displace
-        # any existing checkpoint with a RENAME (cheap, near-atomic window)
-        # and only rmtree the displaced copy AFTER the new one is in place —
-        # a preemption mid-save leaves either the old or the new checkpoint
-        # at out_dir, never neither.
+        # any existing checkpoint with a RENAME (cheap), put the new one in
+        # place, and only then rmtree the displaced copy.  An EXCEPTION in
+        # the displace->replace window renames the old checkpoint back.  A
+        # hard crash (SIGKILL/power) in that window can still strand the old
+        # copy in a ``<name>.old*`` sibling — load_checkpoint_sharded probes
+        # for exactly that and recovers it, so a resume always finds either
+        # the old or the new checkpoint.
         displaced = None
-        if out_dir.exists():
-            displaced = Path(
-                tempfile.mkdtemp(dir=out_dir.parent, prefix=out_dir.name + ".old")
-            )
-            os.rename(out_dir, displaced / "d")
-        os.replace(tmp_dir, out_dir)
+        try:
+            if out_dir.exists():
+                displaced = Path(
+                    tempfile.mkdtemp(
+                        dir=out_dir.parent, prefix=out_dir.name + ".old"
+                    )
+                )
+                (displaced / _DISPLACED_MARKER).touch()
+                os.rename(out_dir, displaced / "d")
+            os.replace(tmp_dir, out_dir)
+        except BaseException:
+            if (
+                displaced is not None
+                and not out_dir.exists()
+                and (displaced / "d").exists()
+            ):
+                os.rename(displaced / "d", out_dir)
+                shutil.rmtree(displaced, ignore_errors=True)
+            raise
         if displaced is not None:
             shutil.rmtree(displaced, ignore_errors=True)
+        # Reclaim marked orphans stranded by EARLIER crashed saves of this
+        # checkpoint (each would otherwise leak a full checkpoint copy).
+        # Only marker-carrying dirs are touched — see _DISPLACED_MARKER.
+        prefix = out_dir.name + ".old"
+        for entry in os.listdir(out_dir.parent):
+            stale = out_dir.parent / entry
+            if entry.startswith(prefix) and (stale / _DISPLACED_MARKER).exists():
+                shutil.rmtree(stale, ignore_errors=True)
     except BaseException:
         if tmp_dir.exists():
             shutil.rmtree(tmp_dir, ignore_errors=True)
@@ -230,6 +305,45 @@ def save_checkpoint_sharded(
     _write_sharded_dir(Path(out_dir), treedef, plan, iteration, extra)
 
 
+def _check_shards_tile(record: dict) -> None:
+    """Verify a manifest leaf's shard index boxes exactly tile its shape.
+
+    The reassembly below fills an ``np.empty`` buffer from the manifest's
+    index ranges; a manifest that covers only part of the leaf (e.g. written
+    by one process of a multi-process mesh before the save-side guard
+    existed) would otherwise restore uninitialized memory silently.
+    Axis-aligned boxes tile a volume iff they are pairwise disjoint and
+    their volumes sum to the total.
+    """
+    shape = record["shape"]
+    total = int(np.prod(shape)) if shape else 1
+    boxes = [s["index"] for s in record["shards"]]
+    covered = 0
+    for box in boxes:
+        vol = 1
+        for (start, stop), dim in zip(box, shape):
+            if not (0 <= start <= stop <= dim):
+                raise ValueError(
+                    f"checkpoint leaf {record['name']}: shard index {box} "
+                    f"out of bounds for shape {shape}"
+                )
+            vol *= stop - start
+        covered += vol
+    disjoint = all(
+        any(a1 >= b2 or b1 >= a2 for (a1, a2), (b1, b2) in zip(pa, pb))
+        for i, pa in enumerate(boxes)
+        for pb in boxes[i + 1 :]
+    )
+    if covered != total or not disjoint:
+        raise ValueError(
+            f"checkpoint leaf {record['name']}: shard files cover "
+            f"{covered}/{total} elements"
+            + ("" if disjoint else " with overlapping ranges")
+            + f" of shape {shape} — incomplete or corrupt manifest "
+            "(possibly written from one process of a multi-process mesh)"
+        )
+
+
 def load_checkpoint_sharded(
     src_dir: str | os.PathLike, shardings: Any | None = None
 ) -> dict:
@@ -243,6 +357,30 @@ def load_checkpoint_sharded(
     stages the whole tree on host.
     """
     src_dir = Path(src_dir)
+    if not (src_dir / _MANIFEST).exists():
+        # A hard crash inside _write_sharded_dir's displace->replace window
+        # leaves the previous (complete) checkpoint stranded in a marked
+        # ``<name>.old*/d`` sibling; PROMOTE the newest such copy back to
+        # ``src_dir`` (so the recovery is visible on disk and the orphan
+        # doesn't leak or resurrect after an intentional delete) and load it.
+        orphans = _stranded_orphans(src_dir)
+        if orphans:
+            import sys
+
+            print(
+                f"checkpoint {src_dir} missing; recovering the copy a "
+                f"crashed save stranded in {orphans[-1]}",
+                file=sys.stderr,
+            )
+            try:
+                os.rename(orphans[-1] / "d", src_dir)
+            except OSError:
+                # Concurrent loader won the promotion race; fine as long as
+                # the checkpoint is now in place.
+                if not (src_dir / _MANIFEST).exists():
+                    raise
+            for leftover in orphans:
+                shutil.rmtree(leftover, ignore_errors=True)
     with open(src_dir / _MANIFEST) as f:
         manifest = json.load(f)
     if manifest.get("format_version") != _SHARDED_FORMAT_VERSION:
@@ -266,6 +404,7 @@ def load_checkpoint_sharded(
     for i, record in enumerate(manifest["leaves"]):
         name = record["name"]
         if "shards" in record:
+            _check_shards_tile(record)
             value = np.empty(record["shape"], dtype=np.dtype(record["dtype"]))
             for j, shard in enumerate(record["shards"]):
                 idx = tuple(slice(start, stop) for start, stop in shard["index"])

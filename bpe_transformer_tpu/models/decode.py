@@ -18,6 +18,7 @@ Weights use the same param pytree as training — no export/conversion step.
 
 from __future__ import annotations
 
+import math
 from functools import partial
 
 import jax
@@ -60,14 +61,32 @@ def _rope_qk(q, k, positions, config):
 def _ffn_decode(x, ffn, config):
     """The training forward's FFN dispatch with the aux loss discarded.
 
-    MoE note: routing capacity is computed over the tokens of THIS call —
-    the whole prompt at prefill, ``batch`` tokens per decode step — so
-    cached decoding matches the uncached forward exactly only when capacity
-    is not binding (standard inference practice: generous capacity_factor).
+    MoE note: a per-call default capacity (``batch`` tokens at a decode
+    step, the prompt at prefill) would drop tokens the full forward keeps.
+    Instead the capacity is derived from ``context_length`` — what the full
+    uncached forward at max length would use — clamped to this call's token
+    count (a token fills at most one slot per expert, so ``n`` slots is
+    already drop-free).  Decode steps therefore never drop; residual
+    divergence vs the uncached path exists only when the uncached forward
+    itself would drop (see training/sampling.generate_ids).
     """
     from bpe_transformer_tpu.models.transformer import _ffn
 
-    return _ffn(x, ffn, config)[0]
+    moe_capacity = None
+    if config.ffn_type == "moe":
+        from bpe_transformer_tpu.models.moe import expert_capacity
+
+        n_tokens = math.prod(x.shape[:-1])
+        full_forward_cap = expert_capacity(
+            x.shape[0] * config.context_length,
+            config.n_experts,
+            config.capacity_factor,
+        )
+        # Floor at the batch size so single-token decode steps stay
+        # drop-free even for degenerate configs where the full-length
+        # capacity is below the batch (many experts, tiny context).
+        moe_capacity = min(n_tokens, max(full_forward_cap, x.shape[0]))
+    return _ffn(x, ffn, config, moe_capacity=moe_capacity)[0]
 
 
 def _block_apply(x, block_params, config, attend):

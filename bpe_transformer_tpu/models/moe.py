@@ -57,7 +57,7 @@ def expert_capacity(n_tokens: int, n_experts: int, capacity_factor: float) -> in
 
 
 def switch_ffn(
-    x: Array, moe_params: dict, config: ModelConfig
+    x: Array, moe_params: dict, config: ModelConfig, capacity: int | None = None
 ) -> tuple[Array, Array]:
     """Top-k routed SwiGLU experts.  Returns ``(output, aux_loss)``.
 
@@ -66,6 +66,10 @@ def switch_ffn(
     the chosen experts).  Capacity fills rank-major — every token's first
     choice is queued before any token's second choice — so a congested
     expert sheds low-priority assignments first.
+
+    ``capacity`` overrides the default per-call ``expert_capacity`` (the
+    KV-cached decode path derives a generous one from ``context_length`` so
+    a few-token call can't drop tokens the full forward would have kept).
 
     ``x``: (..., d_model); routing flattens all leading dims into one token
     axis (static shape under jit).
@@ -76,7 +80,11 @@ def switch_ffn(
     tokens = x.reshape(n, d)
     e = config.n_experts
     top_k = config.router_top_k
-    cap = expert_capacity(n, e, config.capacity_factor)
+    cap = (
+        capacity
+        if capacity is not None
+        else expert_capacity(n, e, config.capacity_factor)
+    )
 
     # Router in float32 for stable softmax/argmax.
     logits = jnp.einsum(

@@ -98,8 +98,16 @@ def lm_head_weight(params: Params, config: ModelConfig) -> Array:
 # ------------------------------------------------------------------ forward
 
 
-def _ffn(x: Array, ffn_params: dict, config: ModelConfig) -> tuple[Array, Array]:
-    """FFN dispatch; returns ``(output, aux_loss)`` (aux is 0 except MoE)."""
+def _ffn(
+    x: Array,
+    ffn_params: dict,
+    config: ModelConfig,
+    moe_capacity: int | None = None,
+) -> tuple[Array, Array]:
+    """FFN dispatch; returns ``(output, aux_loss)`` (aux is 0 except MoE).
+
+    ``moe_capacity`` is threaded to :func:`switch_ffn` (decode-path
+    override); ignored by the dense FFN kinds."""
     zero = jnp.zeros((), jnp.float32)
     if config.ffn_type in (None, "swiglu"):
         if config.ffn_impl == "pallas":
@@ -121,7 +129,7 @@ def _ffn(x: Array, ffn_params: dict, config: ModelConfig) -> tuple[Array, Array]
     if config.ffn_type == "moe":
         from bpe_transformer_tpu.models.moe import switch_ffn
 
-        return switch_ffn(x, ffn_params, config)
+        return switch_ffn(x, ffn_params, config, capacity=moe_capacity)
     raise ValueError(f"unknown ffn_type: {config.ffn_type!r}")
 
 

@@ -40,6 +40,12 @@ from bpe_transformer_tpu.training.train_step import TrainHParams
 
 P = PartitionSpec
 
+_FLASH_RING_KV_CHUNK_ERROR = (
+    'attention_impl="flash" does not honor ring_kv_chunk inside the ring '
+    "(the Pallas kernel tiles each visiting shard by flash_block_size "
+    'instead); unset ring_kv_chunk or use the XLA ring (attention_impl="xla")'
+)
+
 
 def sp_forward(
     params,
@@ -70,6 +76,8 @@ def _ring_attention_fn(config: ModelConfig, seq_axis: str, zigzag: bool = False)
     if config.attention_impl == "flash":
         from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
 
+        if config.ring_kv_chunk:
+            raise ValueError(_FLASH_RING_KV_CHUNK_ERROR)
         block = config.flash_block_size
         fn = zigzag_ring_flash_attention if zigzag else ring_flash_attention
         return partial(
@@ -111,9 +119,14 @@ def make_sp_train_step(
     if zigzag and config.ring_kv_chunk:
         raise ValueError(
             "the zig-zag schedule does not honor ring_kv_chunk (its "
-            "sub-blocks are already half-size); use the contiguous ring, "
-            'or attention_impl="flash" for VMEM-tiled zig-zag'
+            "sub-blocks are already half-size); use the contiguous ring, or "
+            'unset ring_kv_chunk and set attention_impl="flash" for '
+            "VMEM-tiled zig-zag"
         )
+    if config.attention_impl == "flash" and config.ring_kv_chunk:
+        # Same guard lives in _ring_attention_fn (covers sp_forward too);
+        # raising here surfaces it at step-construction time.
+        raise ValueError(_FLASH_RING_KV_CHUNK_ERROR)
 
     def local_step(params, opt_state: AdamWState, x, y):
         def loss_fn(p):

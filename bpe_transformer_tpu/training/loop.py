@@ -138,13 +138,18 @@ def train(
 
     start_iteration = 0
     if resume_from is not None:
+        from bpe_transformer_tpu.checkpointing.checkpoint import (
+            sharded_checkpoint_exists,
+        )
+
         resume_from = Path(resume_from)
         # A directory may be a checkpoints PARENT (resume from its latest
-        # snapshot) or a sharded checkpoint itself (has a manifest).
-        if resume_from.is_dir() and not (resume_from / "manifest.json").exists():
+        # snapshot) or a sharded checkpoint itself (has a manifest — or a
+        # crash-stranded orphan sibling the loader recovers from).
+        if resume_from.is_dir() and not sharded_checkpoint_exists(resume_from):
             resume_from = resume_from / "latest.ckpt"
         gspmd = mesh is not None and loop.parallel not in ("dp", "sp", "pp")
-        if gspmd and (Path(resume_from) / "manifest.json").exists():
+        if gspmd and sharded_checkpoint_exists(resume_from):
             # Streaming re-placement: build the target shardings from the
             # ABSTRACT param tree (no init compute) so each leaf lands on
             # its mesh devices as it is read — the full FSDP state is never

@@ -53,7 +53,11 @@ def generate_ids(
         and config.activation_dtype == "float32"  # decode.py runs in f32
     ):
         # KV-cached fast path: O(1) work per token, one XLA program for the
-        # whole generation (models/decode.py).
+        # whole generation (models/decode.py).  Safe for MoE configs too:
+        # decode derives expert capacity from context_length (see
+        # decode._ffn_decode), so its few-token calls never drop tokens —
+        # cached and uncached sampling can differ only in the case where the
+        # uncached full forward would itself drop tokens at max length.
         from bpe_transformer_tpu.models.decode import generate_cached
 
         ids = generate_cached(

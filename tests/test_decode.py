@@ -219,3 +219,59 @@ def test_top_p_sampling_masks_tail(setup):
     )
     assert out.shape == (1, 5)
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < CFG.vocab_size))
+
+
+def test_moe_decode_default_capacity_no_drops():
+    """At the DEFAULT capacity_factor the cached path must not drop tokens
+    its full forward keeps: decode derives capacity from context_length
+    (decode._ffn_decode), so the per-step few-token calls are drop-free and
+    the whole cached chain reproduces a drop-free full forward exactly."""
+    cfg = dataclasses.replace(
+        CFG, ffn_type="moe", n_experts=4, capacity_factor=1.25
+    )
+    nodrop = dataclasses.replace(cfg, capacity_factor=100.0)
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 12)), jnp.int32)
+
+    ref = forward(params, ids, nodrop)  # drop-free oracle
+
+    cache = init_kv_cache(cfg, ids.shape[0])
+    logits, cache = prefill(params, ids[:, :4], cfg, cache)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref[:, 3]), atol=1e-4
+    )
+    for p in range(4, ids.shape[1]):
+        logits, cache = decode_step(params, ids[:, p], jnp.asarray(p), cache, cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref[:, p]), atol=1e-4,
+            err_msg=f"position {p}",
+        )
+
+
+def test_moe_decode_step_dropfree_with_degenerate_capacity():
+    """Even when the full-length expert capacity is below the batch size
+    (many experts, tiny context), single-token decode steps must stay
+    drop-free: the derived capacity floors at the batch."""
+    cfg = dataclasses.replace(
+        CFG,
+        context_length=16,
+        ffn_type="moe",
+        n_experts=64,
+        capacity_factor=1.0,  # full-length cap = ceil(8*16/64) = 2 < B=8
+    )
+    nodrop = dataclasses.replace(cfg, capacity_factor=100.0)
+    params = init_params(jax.random.PRNGKey(5), cfg)
+    rng = np.random.default_rng(5)
+    B = 8
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(B, 10)), jnp.int32)
+
+    ref = forward(params, ids, nodrop)
+    cache = init_kv_cache(cfg, B)
+    logits, cache = prefill(params, ids[:, :2], cfg, cache)
+    for p in range(2, ids.shape[1]):
+        logits, cache = decode_step(params, ids[:, p], jnp.asarray(p), cache, cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref[:, p]), atol=1e-4,
+            err_msg=f"position {p}",
+        )

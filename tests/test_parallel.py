@@ -342,3 +342,15 @@ def test_sp_zigzag_step_matches_single_device():
     )
 
 
+
+
+def test_sp_flash_with_ring_kv_chunk_raises():
+    """attention_impl="flash" ignores ring_kv_chunk inside the ring (the
+    Pallas kernel tiles by flash_block_size); the combination must fail
+    loudly instead of silently dropping the knob."""
+    from bpe_transformer_tpu.parallel import make_sp_train_step
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    cfg = dataclasses.replace(CFG, attention_impl="flash", ring_kv_chunk=4)
+    with pytest.raises(ValueError, match="ring_kv_chunk"):
+        make_sp_train_step(cfg, HP, mesh)

@@ -314,3 +314,113 @@ def test_sharded_checkpoint_reshard_to_different_mesh(tmp_path):
     assert leaf.sharding == target["layers"][0]["attn"]["q_proj"]
     _assert_trees_equal(payload["params"], params)
     _assert_trees_equal(payload["opt_state"], state)
+
+
+def test_sharded_checkpoint_incomplete_manifest_rejected(tmp_path):
+    """A manifest whose shard boxes don't tile a leaf (e.g. written by one
+    process of a multi-process mesh) must refuse to load rather than return
+    uninitialized memory in the uncovered ranges."""
+    import json
+
+    from bpe_transformer_tpu.checkpointing import (
+        load_checkpoint_sharded,
+        save_checkpoint_sharded,
+    )
+
+    _, params, state = _fsdp_state()
+    ckpt = tmp_path / "gap.ckpt"
+    save_checkpoint_sharded(ckpt, params=params, opt_state=state, iteration=1)
+
+    manifest = json.loads((ckpt / "manifest.json").read_text())
+    victim = next(r for r in manifest["leaves"] if "shards" in r)
+    victim["shards"] = victim["shards"][:-1]  # coverage gap
+    (ckpt / "manifest.json").write_text(json.dumps(manifest))
+
+    with pytest.raises(ValueError, match="cover|incomplete"):
+        load_checkpoint_sharded(ckpt)
+
+
+def test_sharded_checkpoint_orphan_recovery(tmp_path):
+    """A hard crash inside the displace->replace window strands the old
+    checkpoint in a `<name>.old*/d` sibling; loading the original path (via
+    the public auto-detecting entry) must recover it, PROMOTE it back to the
+    original path, and clean up the orphan."""
+    from bpe_transformer_tpu.checkpointing import (
+        load_checkpoint,
+        save_checkpoint_sharded,
+    )
+
+    _, params, state = _fsdp_state()
+    ckpt = tmp_path / "crashy.ckpt"
+    save_checkpoint_sharded(ckpt, params=params, opt_state=state, iteration=7)
+
+    displaced = tmp_path / "crashy.ckpt.old123xyz"
+    displaced.mkdir()
+    (displaced / ".bt_displaced").touch()  # the save machinery's marker
+    (ckpt).rename(displaced / "d")  # simulate the crash window
+
+    payload = load_checkpoint(ckpt)
+    assert payload["iteration"] == 7
+    _assert_trees_equal(payload["params"], params)
+    assert (ckpt / "manifest.json").exists()  # promoted back into place
+    assert not list(tmp_path.glob("crashy.ckpt.old*"))  # orphan reclaimed
+
+
+def test_sharded_checkpoint_unmarked_old_sibling_untouched(tmp_path):
+    """A user's manual `cp -r x.ckpt x.ckpt.old` backup (no ownership
+    marker) must be neither deleted by a later save nor loaded as an
+    orphan."""
+    import shutil
+
+    from bpe_transformer_tpu.checkpointing import save_checkpoint_sharded
+    from bpe_transformer_tpu.checkpointing.checkpoint import (
+        sharded_checkpoint_exists,
+    )
+
+    _, params, state = _fsdp_state()
+    ckpt = tmp_path / "backed.ckpt"
+    save_checkpoint_sharded(ckpt, params=params, opt_state=state, iteration=1)
+
+    backup = tmp_path / "backed.ckpt.old"
+    shutil.copytree(ckpt, backup / "d")  # user-made, no marker
+
+    save_checkpoint_sharded(ckpt, params=params, opt_state=state, iteration=2)
+    assert (backup / "d" / "manifest.json").exists()  # backup survives
+
+    shutil.rmtree(ckpt)  # intentional delete: backup must NOT resurrect
+    assert not sharded_checkpoint_exists(ckpt)
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(ckpt)
+
+
+def test_sharded_checkpoint_failed_swap_restores_old(tmp_path, monkeypatch):
+    """If the final directory swap raises, the previous checkpoint must be
+    renamed back into place (not stranded in a temp sibling)."""
+    import os as os_mod
+
+    from bpe_transformer_tpu.checkpointing import (
+        load_checkpoint_sharded,
+        save_checkpoint_sharded,
+    )
+
+    _, params, state = _fsdp_state()
+    ckpt = tmp_path / "swap.ckpt"
+    save_checkpoint_sharded(ckpt, params=params, opt_state=state, iteration=1)
+
+    real_replace = os_mod.replace
+
+    def failing_replace(src, dst):
+        if str(dst) == str(ckpt):
+            raise OSError("simulated swap failure")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os_mod, "replace", failing_replace)
+    with pytest.raises(OSError, match="simulated swap failure"):
+        save_checkpoint_sharded(ckpt, params=params, opt_state=state, iteration=2)
+    monkeypatch.undo()
+
+    payload = load_checkpoint_sharded(ckpt)  # the OLD checkpoint survives
+    assert payload["iteration"] == 1
+    _assert_trees_equal(payload["params"], params)
+    # No stranded displaced copies remain.
+    assert not list(tmp_path.glob("swap.ckpt.old*"))
