@@ -28,9 +28,33 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from bpe_transformer_tpu.models.config import ModelConfig
 from bpe_transformer_tpu.optim.adamw import AdamWState
 from bpe_transformer_tpu.parallel.sharding import param_shardings
-from bpe_transformer_tpu.training.train_step import TrainHParams, train_step_fn
+from bpe_transformer_tpu.training.train_step import (
+    TrainHParams,
+    grad_accum_step_fn,
+    scanned_step_fn,
+    train_step_fn,
+)
 
 P = PartitionSpec
+
+
+def _multi_step_body(
+    config: ModelConfig,
+    hparams: TrainHParams,
+    accum_steps: int,
+    inner_steps: int,
+    reduce_axis: str | None,
+) -> tuple[Callable, bool]:
+    """(body, stacked): the per-shard update body for the requested
+    accumulation/scan mode, and whether batches carry a leading stacked dim
+    (``(accum|inner, micro_batch, seq)`` instead of ``(batch, seq)``)."""
+    if accum_steps > 1 and inner_steps > 1:
+        raise ValueError("grad_accum_steps and inner_steps cannot both exceed 1")
+    if accum_steps > 1:
+        return grad_accum_step_fn(config, hparams, accum_steps, reduce_axis), True
+    if inner_steps > 1:
+        return scanned_step_fn(config, hparams, inner_steps, reduce_axis), True
+    return train_step_fn(config, hparams, reduce_axis), False
 
 
 def make_dp_train_step(
@@ -38,17 +62,29 @@ def make_dp_train_step(
     hparams: TrainHParams,
     mesh: Mesh,
     axis: str = "data",
+    accum_steps: int = 1,
+    inner_steps: int = 1,
 ) -> Callable:
     """Data-parallel step with an explicit gradient all-reduce over ``axis``.
 
     Batch arrays must be sharded (or shardable) along their leading dim;
     params/opt-state are replicated.  The global batch size must divide the
     mesh axis size.
+
+    ``accum_steps > 1``: each chip scans its local microbatches and the
+    all-reduce runs ONCE per update (after local accumulation); batches are
+    ``(accum_steps, micro_batch, seq)`` with the micro batch split on
+    ``axis``.  ``inner_steps > 1``: several full updates per dispatch, each
+    with its own all-reduce; batches are ``(inner_steps, batch, seq)``.
     """
+    body, stacked = _multi_step_body(
+        config, hparams, accum_steps, inner_steps, reduce_axis=axis
+    )
+    batch_spec = P(None, axis) if stacked else P(axis)
     mapped = jax.shard_map(
-        train_step_fn(config, hparams, reduce_axis=axis),
+        body,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis), P(axis)),
+        in_specs=(P(), P(), batch_spec, batch_spec),
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
@@ -61,32 +97,52 @@ def make_gspmd_train_step(
     mesh: Mesh,
     strategy: str = "fsdp",
     example_params=None,
+    accum_steps: int = 1,
+    inner_steps: int = 1,
 ) -> Callable:
     """Sharding-annotated jit step; XLA derives the collective schedule.
 
     ``example_params`` (an abstract or concrete params pytree) is needed to
     build per-leaf shardings.  Returns a step with donated params/opt-state.
+
+    ``accum_steps``/``inner_steps`` compile the accumulation/multi-update
+    ``lax.scan`` INSIDE the sharded program (batches gain a leading stacked
+    dim, split on ``data`` along their second axis); XLA still derives all
+    collectives from the annotations, so FSDP's gather/scatter schedule
+    composes with accumulation without any manual communication.
     """
     if example_params is None:
         raise ValueError("example_params is required to derive shardings")
+    body, stacked = _multi_step_body(
+        config, hparams, accum_steps, inner_steps, reduce_axis=None
+    )
     p_sh = param_shardings(example_params, mesh, strategy)
     replicated = NamedSharding(mesh, P())
     opt_sh = AdamWState(step=replicated, m=p_sh, v=p_sh)
-    batch_sh = NamedSharding(mesh, P("data")) if "data" in mesh.shape else replicated
+    data_spec = (P(None, "data") if stacked else P("data"))
+    batch_sh = (
+        NamedSharding(mesh, data_spec) if "data" in mesh.shape else replicated
+    )
     metrics_sh = {"loss": replicated, "lr": replicated, "grad_norm": replicated}
 
     return jax.jit(
-        train_step_fn(config, hparams),
+        body,
         in_shardings=(p_sh, opt_sh, batch_sh, batch_sh),
         out_shardings=(p_sh, opt_sh, metrics_sh),
         donate_argnums=(0, 1),
     )
 
 
-def shard_batch(batch, mesh: Mesh, axis: str = "data"):
+def shard_batch(batch, mesh: Mesh, axis: str = "data", stacked: bool = False):
     """Place a host batch on the mesh, split along the data axis.
 
-    On meshes without that axis (e.g. pure tensor parallelism) the batch is
-    replicated instead, matching make_gspmd_train_step's fallback."""
-    spec = P(axis) if axis in mesh.shape else P()
+    ``stacked=True`` places ``(accum|inner, batch, seq)`` arrays with the
+    LEADING dim unsharded and the batch dim split on ``axis`` (the
+    grad-accum / scanned-step layouts).  On meshes without that axis (e.g.
+    pure tensor parallelism) the batch is replicated instead, matching
+    make_gspmd_train_step's fallback."""
+    if axis in mesh.shape:
+        spec = P(None, axis) if stacked else P(axis)
+    else:
+        spec = P()
     return jax.device_put(batch, NamedSharding(mesh, spec))

@@ -61,13 +61,17 @@ class LoopConfig:
     sp_zigzag: bool = False
     #: Optimizer updates per XLA dispatch (lax.scan over the update body).
     #: >1 amortizes host launch latency for small models — identical math.
-    #: Single-device only; log/eval/checkpoint cadences must be multiples.
+    #: Works single-device and under dp/GSPMD meshes (the scan compiles
+    #: inside the sharded program); not with sp/pp.  log/eval/checkpoint
+    #: cadences must be multiples.
     inner_steps: int = 1
     #: Microbatches per optimizer update (gradient accumulation): each
     #: batch of ``batch_size`` is split into this many sequential
     #: microbatches, capping activation memory at one microbatch while the
-    #: update math is identical.  Single-device only; must divide
-    #: batch_size; mutually exclusive with inner_steps > 1.
+    #: update math is identical.  Works single-device and under dp/GSPMD
+    #: meshes (one collective per update, after local accumulation); not
+    #: with sp/pp.  Must divide batch_size (and the microbatch must divide
+    #: the data mesh axis); mutually exclusive with inner_steps > 1.
     grad_accum_steps: int = 1
     #: Overlap checkpoint serialization/IO with training: save() snapshots
     #: to host synchronously and writes in a background thread (at most one
@@ -222,10 +226,10 @@ def train(
 
     stride = loop.inner_steps
     if stride > 1:
-        if loop.parallel is not None:
+        if loop.parallel in ("sp", "pp"):
             raise NotImplementedError(
-                "inner_steps > 1 is single-device only (the scan would have "
-                "to live inside the sharded program); set parallel=None"
+                "inner_steps > 1 is not supported with the sp/pp schedules; "
+                "use parallel=None/'dp' or a GSPMD strategy"
             )
         for name, every in (
             ("log_every", loop.log_every),
@@ -239,10 +243,10 @@ def train(
 
     accum = loop.grad_accum_steps
     if accum > 1:
-        if loop.parallel is not None:
+        if loop.parallel in ("sp", "pp"):
             raise NotImplementedError(
-                "grad_accum_steps > 1 is single-device only; shard the batch "
-                "over a mesh instead (parallel='dp'/'fsdp')"
+                "grad_accum_steps > 1 is not supported with the sp/pp "
+                "schedules (pp already microbatches; sp shards the sequence)"
             )
         if stride > 1:
             raise ValueError(
@@ -253,43 +257,74 @@ def train(
                 f"batch_size={loop.batch_size} must divide by "
                 f"grad_accum_steps={accum}"
             )
+    if mesh is not None and "data" in mesh.shape and (accum > 1 or stride > 1):
+        # The sharded step splits the (micro)batch dim over the data axis.
+        micro = loop.batch_size // accum if accum > 1 else loop.batch_size
+        if micro % mesh.shape["data"]:
+            raise ValueError(
+                f"microbatch size {micro} must divide by the data mesh axis "
+                f"({mesh.shape['data']})"
+            )
 
+    # build_step(n) rebuilds the step for a TAIL shorter than inner_steps
+    # (the last scan of a run whose total isn't a stride multiple).
+    stacked_batches = stride > 1 or accum > 1
     if mesh is None:
-        if stride > 1:
-            from bpe_transformer_tpu.training.train_step import (
-                make_scanned_train_step,
-            )
+        def build_step(n=stride):
+            if n > 1:
+                from bpe_transformer_tpu.training.train_step import (
+                    make_scanned_train_step,
+                )
 
-            step_fn = make_scanned_train_step(model_config, hparams, stride)
-        elif accum > 1:
-            from bpe_transformer_tpu.training.train_step import (
-                make_grad_accum_train_step,
-            )
+                return make_scanned_train_step(model_config, hparams, n)
+            if accum > 1:
+                from bpe_transformer_tpu.training.train_step import (
+                    make_grad_accum_train_step,
+                )
 
-            step_fn = make_grad_accum_train_step(model_config, hparams, accum)
-        else:
-            step_fn = make_train_step(model_config, hparams)
-        place = lambda b: b
+                return make_grad_accum_train_step(model_config, hparams, accum)
+            return make_train_step(model_config, hparams)
+
+        step_fn = build_step()
+        place = place_plain = lambda b: b
     elif loop.parallel == "dp":
-        step_fn = make_dp_train_step(model_config, hparams, mesh)
-        place = lambda b: shard_batch(b, mesh)
+        def build_step(n=stride):
+            return make_dp_train_step(
+                model_config, hparams, mesh, accum_steps=accum, inner_steps=n
+            )
+
+        step_fn = build_step()
+        place = lambda b: shard_batch(b, mesh, stacked=stacked_batches)
+        place_plain = lambda b: shard_batch(b, mesh)
     elif loop.parallel == "sp":
         step_fn = make_sp_train_step(
             model_config, hparams, mesh, zigzag=loop.sp_zigzag
         )
-        place = lambda b: shard_sp_batch(b, mesh, zigzag=loop.sp_zigzag)
+        place = place_plain = lambda b: shard_sp_batch(
+            b, mesh, zigzag=loop.sp_zigzag
+        )
     elif loop.parallel == "pp":
         from bpe_transformer_tpu.parallel.pp import make_pp_train_step
 
         step_fn = make_pp_train_step(
             model_config, hparams, mesh, num_microbatches=loop.pp_microbatches
         )
-        place = lambda b: shard_batch(b, mesh)
+        place = place_plain = lambda b: shard_batch(b, mesh)
     else:
-        step_fn = make_gspmd_train_step(
-            model_config, hparams, mesh, loop.parallel, example_params=params
-        )
-        place = lambda b: shard_batch(b, mesh)
+        def build_step(n=stride):
+            return make_gspmd_train_step(
+                model_config,
+                hparams,
+                mesh,
+                loop.parallel,
+                example_params=params,
+                accum_steps=accum,
+                inner_steps=n,
+            )
+
+        step_fn = build_step()
+        place = lambda b: shard_batch(b, mesh, stacked=stacked_batches)
+        place_plain = lambda b: shard_batch(b, mesh)
 
     # GSPMD/pipeline strategies hold device-sharded params; checkpoint those
     # through the streaming directory format.  dp/sp keep replicated params
@@ -331,7 +366,9 @@ def train(
                 # when training uses it.
                 ex, ey = shard_sp_batch((ex, ey), mesh)
             elif loop.parallel != "pp":
-                ex, ey = place((ex, ey))
+                # Eval batches are plain (B, S) — never the stacked
+                # grad-accum/inner-steps layout the train `place` expects.
+                ex, ey = place_plain((ex, ey))
             losses.append(float(eval_step(eval_params, ex, ey)))
         return float(np.mean(losses))
 
@@ -362,10 +399,18 @@ def train(
                     )
                     for j in range(n)
                 ]
-                x = jax.numpy.asarray(np.stack([b[0] for b in batches]))
-                y = jax.numpy.asarray(np.stack([b[1] for b in batches]))
                 if n != stride:  # tail shorter than the compiled scan length
-                    step_fn = make_scanned_train_step(model_config, hparams, n)
+                    step_fn = build_step(n)
+                if n == 1:
+                    # A 1-step tail is a plain step (build_step(1)): feed the
+                    # unstacked (B, S) layout it expects.
+                    x = jax.numpy.asarray(batches[0][0])
+                    y = jax.numpy.asarray(batches[0][1])
+                    x, y = place_plain((x, y))
+                else:
+                    x = jax.numpy.asarray(np.stack([b[0] for b in batches]))
+                    y = jax.numpy.asarray(np.stack([b[1] for b in batches]))
+                    x, y = place((x, y))
             else:
                 n = 1
                 step_rng = np.random.default_rng((loop.seed, iteration))

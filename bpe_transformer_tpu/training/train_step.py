@@ -120,10 +120,14 @@ def make_train_step(config: ModelConfig, hparams: TrainHParams) -> Callable:
     return jax.jit(train_step_fn(config, hparams), donate_argnums=(0, 1))
 
 
-def make_grad_accum_train_step(
-    config: ModelConfig, hparams: TrainHParams, accum_steps: int
+def grad_accum_step_fn(
+    config: ModelConfig,
+    hparams: TrainHParams,
+    accum_steps: int,
+    reduce_axis: str | None = None,
 ) -> Callable:
-    """One optimizer update from ``accum_steps`` microbatch gradients.
+    """Un-jitted accumulation body: one optimizer update from
+    ``accum_steps`` microbatch gradients.
 
     The microbatch loop is a ``lax.scan`` over a leading ``(accum_steps,)``
     batch dim, so peak activation memory is ONE microbatch's forward/backward
@@ -131,6 +135,10 @@ def make_grad_accum_train_step(
     to train batch sizes that don't fit HBM on one chip.  Gradients and the
     loss are averaged (identical to a single step on the concatenated batch,
     since the loss is a mean over examples and microbatches are equal-size).
+
+    ``reduce_axis`` pmean-reduces the accumulated grads/loss over a mapped
+    mesh axis (the shard_map dp path) — ONE collective per update, after
+    the local accumulation, not one per microbatch.
 
     Signature: ``(params, opt_state, xs, ys) -> (params, opt_state,
     metrics)`` with ``xs/ys: (accum_steps, micro_batch, seq)``.
@@ -159,6 +167,9 @@ def make_grad_accum_train_step(
         inv = 1.0 / accum_steps
         loss = loss_sum * inv
         grads = jax.tree_util.tree_map(lambda g: g * inv, grad_sum)
+        if reduce_axis is not None:
+            grads = jax.lax.pmean(grads, reduce_axis)
+            loss = jax.lax.pmean(loss, reduce_axis)
 
         grads, grad_norm = clip_by_global_norm(grads, hparams.grad_clip_norm)
         lr = cosine_schedule_jax(
@@ -184,18 +195,33 @@ def make_grad_accum_train_step(
         }
         return params, opt_state, metrics
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    return step
 
 
-def make_scanned_train_step(
-    config: ModelConfig, hparams: TrainHParams, inner_steps: int
+def make_grad_accum_train_step(
+    config: ModelConfig, hparams: TrainHParams, accum_steps: int
 ) -> Callable:
-    """``inner_steps`` optimizer updates in ONE XLA program via ``lax.scan``.
+    """Single-device jitted wrapper of :func:`grad_accum_step_fn`."""
+    return jax.jit(
+        grad_accum_step_fn(config, hparams, accum_steps), donate_argnums=(0, 1)
+    )
+
+
+def scanned_step_fn(
+    config: ModelConfig,
+    hparams: TrainHParams,
+    inner_steps: int,
+    reduce_axis: str | None = None,
+) -> Callable:
+    """Un-jitted body: ``inner_steps`` optimizer updates via ``lax.scan``.
 
     For small models a single update is microseconds of device work, so
     throughput is bounded by per-dispatch host latency (severe on relayed/
     tunneled backends); scanning the update body amortizes that launch cost
     over ``inner_steps`` real updates — identical math, one dispatch.
+
+    ``reduce_axis`` threads through to each inner update's gradient pmean
+    (the shard_map dp path).
 
     Signature: ``(params, opt_state, xs, ys) -> (params, opt_state,
     metrics)`` where ``xs``/``ys`` carry a leading ``(inner_steps,)`` batch
@@ -204,7 +230,7 @@ def make_scanned_train_step(
     """
     if inner_steps < 1:
         raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
-    body = train_step_fn(config, hparams)
+    body = train_step_fn(config, hparams, reduce_axis)
 
     def multi(params, opt_state: AdamWState, xs, ys):
         def scan_body(carry, batch):
@@ -218,7 +244,16 @@ def make_scanned_train_step(
         last = jax.tree_util.tree_map(lambda a: a[-1], metrics)
         return params, opt_state, last
 
-    return jax.jit(multi, donate_argnums=(0, 1))
+    return multi
+
+
+def make_scanned_train_step(
+    config: ModelConfig, hparams: TrainHParams, inner_steps: int
+) -> Callable:
+    """Single-device jitted wrapper of :func:`scanned_step_fn`."""
+    return jax.jit(
+        scanned_step_fn(config, hparams, inner_steps), donate_argnums=(0, 1)
+    )
 
 
 def make_eval_step(config: ModelConfig) -> Callable:

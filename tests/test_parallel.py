@@ -354,3 +354,91 @@ def test_sp_flash_with_ring_kv_chunk_raises():
     cfg = dataclasses.replace(CFG, attention_impl="flash", ring_kv_chunk=4)
     with pytest.raises(ValueError, match="ring_kv_chunk"):
         make_sp_train_step(cfg, HP, mesh)
+
+
+def test_dp_grad_accum_matches_full_batch_step():
+    """Gradient accumulation under the explicit-collective dp mesh: scanning
+    2 microbatches per chip then one all-reduced update equals the
+    single-device full-batch step (VERDICT r2 #5)."""
+    params, opt_state, x, y = _setup()
+    single = make_train_step(CFG, HP)
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 8})
+    params2, opt_state2, x2, y2 = _setup()
+    accum = 2
+    micro = x2.shape[0] // accum  # 8, divides the data axis
+    x2 = x2.reshape(accum, micro, -1)
+    y2 = y2.reshape(accum, micro, -1)
+    step = make_dp_train_step(CFG, HP, mesh, accum_steps=accum)
+    x2, y2 = shard_batch((x2, y2), mesh, stacked=True)
+    p2, s2, m2 = step(params2, opt_state2, x2, y2)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        p1,
+        p2,
+    )
+
+
+@pytest.mark.parametrize("strategy,axes,accum", [
+    ("fsdp", {"data": 8}, 2),  # micro=8 divides data=8
+    ("fsdp_tp", {"data": 4, "model": 2}, 4),  # micro=4 divides data=4
+])
+def test_gspmd_grad_accum_matches_full_batch_step(strategy, axes, accum):
+    """Gradient accumulation compiled INSIDE the GSPMD program: the
+    accumulation scan composes with XLA-derived FSDP collectives and equals
+    the single-device full-batch update."""
+    params, opt_state, x, y = _setup()
+    single = make_train_step(CFG, HP)
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh(axes)
+    params2, opt_state2, x2, y2 = _setup()
+    params2 = shard_params(params2, mesh, strategy)
+    opt_state2 = adamw_init(params2)
+    micro = x2.shape[0] // accum
+    x2 = x2.reshape(accum, micro, -1)
+    y2 = y2.reshape(accum, micro, -1)
+    step = make_gspmd_train_step(
+        CFG, HP, mesh, strategy, example_params=params2, accum_steps=accum
+    )
+    x2, y2 = shard_batch((x2, y2), mesh, stacked=True)
+    p2, s2, m2 = step(params2, opt_state2, x2, y2)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(p1["lm_head"]), np.asarray(jax.device_get(p2["lm_head"])),
+        atol=1e-5,
+    )
+
+
+def test_dp_inner_steps_match_sequential_dp_steps():
+    """inner_steps under the dp mesh: one scanned dispatch of 3 updates
+    equals 3 sequential dp steps (VERDICT r2 #5)."""
+    mesh = make_mesh({"data": 8})
+    params, opt_state, x, y = _setup()
+    seq_step = make_dp_train_step(CFG, HP, mesh)
+    xp, yp = shard_batch((x, y), mesh)
+    p1, s1 = params, opt_state
+    for _ in range(3):
+        p1, s1, m1 = seq_step(p1, s1, xp, yp)
+
+    params2, opt_state2, x2, y2 = _setup()
+    scan_step = make_dp_train_step(CFG, HP, mesh, inner_steps=3)
+    xs = jnp.broadcast_to(x2, (3, *x2.shape))
+    ys = jnp.broadcast_to(y2, (3, *y2.shape))
+    xs, ys = shard_batch((xs, ys), mesh, stacked=True)
+    p2, s2, m2 = scan_step(params2, opt_state2, xs, ys)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        p1,
+        p2,
+    )
