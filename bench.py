@@ -292,6 +292,11 @@ def resolve_config(on_accel: bool):
         )
         attention = "xla"
     overrides["attention_impl"] = attention
+    if attention == "flash_fused":
+        # An explicit flash_fused request means "measure the fused kernel":
+        # disable the short-seq auto-fallback so the result isn't silently
+        # plain flash.
+        overrides["flash_fused_min_seq"] = 0
     return dataclasses.replace(config, **overrides)
 
 

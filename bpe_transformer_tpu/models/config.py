@@ -51,6 +51,13 @@ class ModelConfig:
     # "xla" | "pallas" (fused SwiGLU kernel; swiglu FFNs only)
     ffn_impl: str = "xla"
     flash_block_size: int = 256  # q/k tile size for the flash kernel
+    #: attention_impl="flash_fused" auto-falls-back to the plain flash
+    #: kernel (RoPE outside) below this sequence length: the in-kernel RoPE
+    #: rematerialization only pays off once the sequence is long enough
+    #: (round-2 v5e measurements: plain wins at 1k — 2.168 vs 2.330 ms —
+    #: fused wins at 4k — 2.468 vs 5.256 ms; benchmarks/RESULTS.md).
+    #: Set to 0 to force the fused kernel at every length.
+    flash_fused_min_seq: int = 2048
     # Sequence-chunked LM loss: cap peak logits memory at
     # O(batch * chunk * vocab) instead of O(batch * seq * vocab).
     # None -> materialize full logits.  Must divide context_length.

@@ -159,6 +159,7 @@ def _attention(
         )
     elif attention_fn is None and config.attention_impl == "flash_fused":
         from bpe_transformer_tpu.kernels.pallas.flash_attention import (
+            flash_attention,
             flash_attention_with_rope,
         )
         from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
@@ -166,20 +167,30 @@ def _attention(
         if rope_cos_sin is None:
             raise ValueError("attention_impl='flash_fused' requires RoPE enabled")
         if positions.ndim != 1:
+            # Validate BEFORE the crossover branch so the contract doesn't
+            # silently depend on sequence length.
             raise ValueError(
                 "attention_impl='flash_fused' shares one cos/sin tile across "
                 f"the batch, so positions must be 1-D, got {positions.shape}; "
                 "use attention_impl='flash' for per-example positions"
             )
-        # RoPE moves inside the kernel: gather the tables at the true token
-        # positions here, hand MHA a rope-free path.
-        cos, sin = rope_cos_sin
-        cos_p, sin_p = cos[positions], sin[positions]
-        rope_cos_sin = None
         block = config.flash_block_size
-        attention_fn = lambda q, k, v: flash_attention_with_rope(
-            q, k, v, cos_p, sin_p, True, block, block, interpret_mode()
-        )
+        if x.shape[-2] < config.flash_fused_min_seq:
+            # Below the measured crossover the in-kernel RoPE recompute
+            # costs more than it saves: dispatch the plain flash kernel
+            # with RoPE applied outside (identical numerics).
+            attention_fn = lambda q, k, v: flash_attention(
+                q, k, v, True, block, block, interpret_mode()
+            )
+        else:
+            # RoPE moves inside the kernel: gather the tables at the true
+            # token positions here, hand MHA a rope-free path.
+            cos, sin = rope_cos_sin
+            cos_p, sin_p = cos[positions], sin[positions]
+            rope_cos_sin = None
+            attention_fn = lambda q, k, v: flash_attention_with_rope(
+                q, k, v, cos_p, sin_p, True, block, block, interpret_mode()
+            )
     elif attention_fn is None and config.attention_impl != "xla":
         raise ValueError(f"unknown attention_impl: {config.attention_impl!r}")
     return multihead_self_attention(

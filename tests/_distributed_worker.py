@@ -41,7 +41,6 @@ def main() -> int:
     import dataclasses
 
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     assert jax.process_count() == num_procs, jax.process_count()

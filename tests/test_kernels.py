@@ -233,11 +233,52 @@ def test_model_fused_flash_attention_matches_xla_impl():
     params = init_params(jax.random.PRNGKey(0), cfg)
     ids = jnp.asarray(np.random.default_rng(8).integers(0, 512, size=(2, 16)))
     base = forward(params, ids, cfg)
-    fused_cfg = dataclasses.replace(cfg, attention_impl="flash_fused")
+    # min_seq=0 forces the FUSED kernel even at this tiny seq (the default
+    # crossover would auto-fall-back to plain flash below 2048).
+    fused_cfg = dataclasses.replace(
+        cfg, attention_impl="flash_fused", flash_fused_min_seq=0
+    )
     fused = forward(params, ids, fused_cfg)
     np.testing.assert_allclose(
         np.asarray(base), np.asarray(fused), atol=2e-4, rtol=1e-3
     )
+
+
+def test_flash_fused_crossover_dispatch(monkeypatch):
+    """Below flash_fused_min_seq the model must run the PLAIN flash kernel
+    (RoPE outside) — the fused kernel loses at short seq on-chip (r2 bench:
+    2.330 vs 2.168 ms at 1k) — and must call the fused kernel at/above the
+    threshold."""
+    import dataclasses
+    import importlib
+
+    # `pallas/__init__` re-exports a FUNCTION named flash_attention that
+    # shadows the submodule on `import ... as` attribute resolution; go
+    # through importlib to get the actual module.
+    fa = importlib.import_module(
+        "bpe_transformer_tpu.kernels.pallas.flash_attention"
+    )
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, forward, init_params
+
+    cfg = dataclasses.replace(
+        TS_TEST_CONFIG, vocab_size=512, attention_impl="flash_fused"
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ids = jnp.asarray(np.random.default_rng(9).integers(0, 512, size=(2, 16)))
+
+    calls = []
+    real = fa.flash_attention_with_rope
+    monkeypatch.setattr(
+        fa,
+        "flash_attention_with_rope",
+        lambda *a, **k: calls.append(1) or real(*a, **k),
+    )
+    forward(params, ids, cfg)  # seq 16 < 2048: plain-flash fallback
+    assert not calls, "fused kernel invoked below the crossover"
+
+    forced = dataclasses.replace(cfg, flash_fused_min_seq=0)
+    forward(params, ids, forced)
+    assert calls, "fused kernel not invoked when forced"
 
 
 # ---------------------------------------------------------- ring attention
