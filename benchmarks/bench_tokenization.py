@@ -49,6 +49,8 @@ def main() -> int:
     parser.add_argument("--vocab", type=int, default=10_000)
     args = parser.parse_args()
 
+    from multiprocessing import cpu_count
+
     from bpe_transformer_tpu.native import is_available
     from bpe_transformer_tpu.tokenization import BPETokenizer, BPETrainer
     from bpe_transformer_tpu.tokenization.pretokenization import count_pretokens
@@ -59,12 +61,12 @@ def main() -> int:
     specials = ["<|endoftext|>"]
     results = []
 
-    def report(stage: str, seconds: float, python_seconds: float | None = None):
+    def report(stage: str, seconds: float, python_seconds: float | None = None, **extra):
         rec = {
             "stage": stage,
             "seconds": round(seconds, 3),
             "mb_per_s": round(size_mb / seconds, 2),
-            "native": is_available(),
+            **extra,
         }
         if python_seconds is not None:
             rec["python_seconds"] = round(python_seconds, 3)
@@ -72,10 +74,31 @@ def main() -> int:
         results.append(rec)
         print(json.dumps(rec))
 
-    # 1. Pre-tokenization counting (python multiprocessing path — the
-    #    reference's parallel_pretokenization equivalent).
-    t_count, _ = timed(lambda: count_pretokens(corpus, specials, training=True))
-    report("pretokenize_count_python", t_count)
+    # 1. Pre-tokenization counting: engine x workers grid (the reference's
+    #    parallel_pretokenization anchor is 9.8-13.1 M pretokens/s with all
+    #    cores on an M3 Pro, BASELINE.md).  ``pretokens/s`` counts the
+    #    OCCURRENCES scanned (sum of counts), the anchor's unit.
+    n_pretokens = None
+    # count_pretokens clamps workers to the host CPU count; bench the
+    # EFFECTIVE counts so no row is mislabeled (this container may expose
+    # a single core, collapsing the grid).
+    count_grid = sorted({min(w, cpu_count()) for w in (1, 4, cpu_count())})
+    for engine in (["python", "native"] if is_available() else ["python"]):
+        for workers in count_grid:
+            t_count, counts = timed(
+                lambda e=engine, w=workers: count_pretokens(
+                    corpus, specials, training=True, n_workers=w,
+                    parallel=w > 1, engine=e,
+                )
+            )
+            n_pretokens = sum(counts.values())
+            report(
+                "pretokenize_count",
+                t_count,
+                engine=engine,
+                n_workers=workers,
+                pretokens_per_s=round(n_pretokens / t_count),
+            )
 
     # 2. BPE training, full pipeline (native streams + C++ merge loop).
     trainer = BPETrainer(vocab_size=args.vocab, special_tokens=specials)
@@ -89,29 +112,46 @@ def main() -> int:
         )
     finally:
         os.environ.pop("BT_NATIVE", None)
-    report("bpe_train_full", t_native, python_seconds=t_py)
+    report(
+        "bpe_train_full",
+        t_native,
+        python_seconds=t_py,
+        engine="native" if is_available() else "python",
+    )
 
-    # 3. Streaming encode of the corpus with the trained tokenizer.
+    # 3. Streaming encode: native engine at 1/4/all workers (the C++
+    #    encoder runs inside every pool worker), python-path serial anchor.
+    #    The reference's anchor: 108.69 s for ~21 MB serial (BASELINE.md).
     tok = BPETokenizer(trainer.vocab, trainer.merges, specials)
     tok_py = BPETokenizer(dict(trainer.vocab), list(trainer.merges), specials)
     tok_py._native_tried = True
 
-    def encode_stream(t):
+    def encode_stream(t, workers=None):
         with open(corpus, encoding="utf-8") as f:
             n = 0
-            for _ in t.encode_iterable(f):
+            for _ in t.encode_iterable(f, n_workers=workers):
                 n += 1
         return n
 
-    t_enc, n_tokens = timed(lambda: encode_stream(tok))
     t_enc_py, _ = timed(lambda: encode_stream(tok_py))
-    report("encode_stream", t_enc, python_seconds=t_enc_py)
+    n_tokens = None
+    for workers in sorted({1, 4, cpu_count()}):
+        t_enc, n_tokens = timed(lambda w=workers: encode_stream(tok, workers=w))
+        report(
+            "encode_stream",
+            t_enc,
+            python_seconds=t_enc_py if workers == 1 else None,
+            engine="native" if is_available() else "python",
+            n_workers=workers,
+            tokens_per_s=round(n_tokens / t_enc),
+        )
     print(
         json.dumps(
             {
                 "corpus_mb": round(size_mb, 1),
                 "tokens": n_tokens,
-                "encode_tokens_per_s": round(n_tokens / t_enc),
+                "pretokens": n_pretokens,
+                "cpu_count": cpu_count(),
             }
         )
     )

@@ -8,6 +8,7 @@ toolchain is available.
 
 from bpe_transformer_tpu.native.engine import (
     NativeBPEEncoder,
+    NativePretokenCounter,
     is_available,
     pretokenize_offsets,
     unavailable_reason,
@@ -15,6 +16,7 @@ from bpe_transformer_tpu.native.engine import (
 
 __all__ = [
     "NativeBPEEncoder",
+    "NativePretokenCounter",
     "is_available",
     "pretokenize_offsets",
     "unavailable_reason",

@@ -312,3 +312,32 @@ def test_trainer_native_matches_python_crlf(tmp_path):
     tn = _native_trainer(300, ["<|endoftext|>"], corpus)
     tp = _python_trainer(300, ["<|endoftext|>"], corpus)
     assert tn.merges == tp.merges
+
+
+@pytest.mark.parametrize("training", [True, False])
+@pytest.mark.parametrize("n_workers", [1, 2])
+def test_count_pretokens_native_matches_python(tmp_path, training, n_workers):
+    """The C++-scanner counting path (count_pretokens engine='native')
+    produces byte-identical Counter contents to the Python regex path,
+    serial and fanned out over processes, with and without special-token
+    retention."""
+    from bpe_transformer_tpu.native import is_available
+    from bpe_transformer_tpu.tokenization.pretokenization import count_pretokens
+
+    if not is_available():
+        pytest.skip("native engine unavailable")
+
+    corpus = tmp_path / "c.txt"
+    corpus.write_text(
+        ("hello world, it's 2026!\n  indented\ttabs\n<|endoftext|>"
+         "héllo wörld \U0001f600 123\n") * 50,
+        encoding="utf-8",
+    )
+    specials = ["<|endoftext|>"]
+    py = count_pretokens(
+        corpus, specials, training=training, n_workers=n_workers, engine="python"
+    )
+    nat = count_pretokens(
+        corpus, specials, training=training, n_workers=n_workers, engine="native"
+    )
+    assert py == nat
