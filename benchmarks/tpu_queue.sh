@@ -44,9 +44,9 @@ run_job - 300 "$OUT/bench_headline.jsonl" python bench.py
 
 # 2. Compute-bound MFU on the real model sizes (VERDICT #2).
 run_job gpt2s 1200 "$OUT/bench_gpt2s.jsonl" \
-  env BENCH_DEADLINE_S=900 python bench.py --config gpt2-small-32k
+  env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 python bench.py --config gpt2-small-32k
 run_job ts12l 600 "$OUT/bench_12l.jsonl" \
-  env BENCH_DEADLINE_S=420 python bench.py --config tinystories-12l
+  env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 python bench.py --config tinystories-12l
 
 # 3. Attention kernel table, one length per invocation (VERDICT #3).
 for seq in 16384 4096 1024; do
@@ -64,6 +64,15 @@ done
 
 # 5. GPT-2-medium MFU (largest single-chip shape; remat on).
 run_job gpt2m 1500 "$OUT/bench_gpt2m.jsonl" \
-  env BENCH_DEADLINE_S=1200 python bench.py --config gpt2-medium
+  env BENCH_DEADLINE_S=1200 BENCH_NO_CPU_FALLBACK=1 python bench.py --config gpt2-medium
+
+# 6. Tuning variants: deeper dispatch amortization for the small model and
+# a bigger batch for gpt2-small (own capture file; may OOM -> discarded).
+# _save_capture keeps the fastest same-shape capture, so these can only
+# improve the replayed headline.
+run_job inner40 300 "$OUT/bench_inner40.jsonl" \
+  env BENCH_INNER_STEPS=40 BENCH_NO_CPU_FALLBACK=1 python bench.py
+run_job gpt2s64 1200 "$OUT/bench_gpt2s64.jsonl" \
+  env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 python bench.py --config gpt2-small-32k --batch 64
 
 log "queue pass complete"
