@@ -72,7 +72,13 @@ def _default_accel_attention(config_name: str) -> str:
     seq = BENCH_CONFIGS[config_name][4]
     return "flash" if seq >= 1024 else "xla"
 
-ARGS = argparse.Namespace(config="tinystories-4l", batch=None, attention=None)
+ARGS = argparse.Namespace(
+    config="tinystories-4l", batch=None, attention=None, flash_block=None
+)
+
+#: ModelConfig's flash_block_size default — used for capture shape checks
+#: without importing the package (replay must not initialize jax).
+DEFAULT_FLASH_BLOCK = 256
 
 RESULT: dict = {}
 _emitted = threading.Event()
@@ -95,10 +101,13 @@ def _init_result() -> None:
 
 
 def _capture_path() -> Path:
-    # Non-default batches get their own file so an exploratory --batch run
-    # can never clobber the default-shape capture the driver replays.
+    # Non-default shapes (--batch / BENCH_FLASH_BLOCK) get their own file so
+    # an exploratory run can never clobber the default-shape capture the
+    # driver replays (which would then refuse to replay on shape mismatch).
     default_batch = BENCH_CONFIGS[ARGS.config][1]
     suffix = "" if ARGS.batch in (None, default_batch) else f"_b{ARGS.batch}"
+    if ARGS.flash_block not in (None, DEFAULT_FLASH_BLOCK):
+        suffix += f"_blk{ARGS.flash_block}"
     return CAPTURE_DIR / f"tpu_capture_{ARGS.config}{suffix}.json"
 
 
@@ -193,6 +202,14 @@ def _try_replay_capture() -> bool:
     if cap_att != want_att:
         print(
             f"capture attention_impl={cap_att}, run wants {want_att}; not replaying",
+            file=sys.stderr,
+        )
+        return False
+    want_block = ARGS.flash_block or DEFAULT_FLASH_BLOCK
+    if captured.get("flash_block_size", DEFAULT_FLASH_BLOCK) != want_block:
+        print(
+            f"capture flash_block_size differs from requested {want_block}; "
+            "not replaying",
             file=sys.stderr,
         )
         return False
@@ -301,6 +318,8 @@ def resolve_config(on_accel: bool):
         )
         attention = "xla"
     overrides["attention_impl"] = attention
+    if ARGS.flash_block is not None:
+        overrides["flash_block_size"] = ARGS.flash_block
     if attention == "flash_fused":
         # An explicit flash_fused request means "measure the fused kernel":
         # disable the short-seq auto-fallback so the result isn't silently
@@ -392,6 +411,7 @@ def bench_jax(platform: str) -> None:
             batch=batch,
             seq=config.context_length,
             attention_impl=config.attention_impl,
+            flash_block_size=config.flash_block_size,
             flops_per_step=train_step_flops(config, batch),
         )
         # Leave room for the torch baseline (GPT-2-scale CPU steps take
@@ -549,6 +569,16 @@ def main() -> int:
     parser.parse_args(namespace=ARGS)
     if ARGS.batch is None:
         ARGS.batch = BENCH_CONFIGS[ARGS.config][1]
+    raw_block = os.environ.get("BENCH_FLASH_BLOCK")
+    if raw_block:
+        try:
+            ARGS.flash_block = int(raw_block)
+        except ValueError:
+            print(f"invalid BENCH_FLASH_BLOCK={raw_block!r}", file=sys.stderr)
+            return 2
+        if ARGS.flash_block <= 0:
+            print(f"BENCH_FLASH_BLOCK must be positive, got {raw_block}", file=sys.stderr)
+            return 2
     if "BENCH_DEADLINE_S" not in os.environ and not ARGS.config.startswith(
         "tinystories"
     ):
@@ -559,8 +589,15 @@ def main() -> int:
     threading.Thread(target=_watchdog, daemon=True).start()
     try:
         platform = probe_accelerator()
-        if platform == "cpu" and _try_replay_capture():
-            return 0
+        if platform == "cpu":
+            if _try_replay_capture():
+                return 0
+            if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
+                _emit(
+                    "accelerator unreachable, no matching capture, and CPU "
+                    "fallback disabled"
+                )
+                return 0
         try:
             bench_jax(platform)
         except Exception as exc:  # probe passed but real init/run failed

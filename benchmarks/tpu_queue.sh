@@ -74,5 +74,10 @@ run_job inner40 300 "$OUT/bench_inner40.jsonl" \
   env BENCH_INNER_STEPS=40 BENCH_NO_CPU_FALLBACK=1 python bench.py
 run_job gpt2s64 1200 "$OUT/bench_gpt2s64.jsonl" \
   env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 python bench.py --config gpt2-small-32k --batch 64
+# Larger flash tile for the seq-1024 shape (own capture file keyed _blk512;
+# cite in RESULTS.md if it wins).
+run_job gpt2s_blk512 1200 "$OUT/bench_gpt2s_blk512.jsonl" \
+  env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 BENCH_FLASH_BLOCK=512 \
+  python bench.py --config gpt2-small-32k
 
 log "queue pass complete"

@@ -79,3 +79,9 @@ def test_example_moe_expert_parallel(tmp_path, sample):
         "--steps", "6", "--vocab-size", "300",
     )
     assert "moe expert-parallel OK" in out
+
+
+@pytest.mark.slow
+def test_example_grad_accum_fsdp(tmp_path, sample):
+    out = run_example(tmp_path, sample, "7_grad_accum_fsdp.py")
+    assert "matches the single-device full-batch update" in out
