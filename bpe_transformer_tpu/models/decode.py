@@ -54,6 +54,8 @@ def _rope_qk(q, k, positions, config):
     if config.remove_rope:
         return q, k
     cos, sin = rope_tables(config.d_head, config.context_length, config.rope_theta)
+    # Keep the compute dtype (bf16 decode must not promote to f32 here).
+    cos, sin = cos.astype(q.dtype), sin.astype(q.dtype)
     pos = jnp.expand_dims(positions, axis=-2)  # broadcast over heads
     return apply_rope(q, pos, cos, sin), apply_rope(k, pos, cos, sin)
 
@@ -126,12 +128,19 @@ def _expand_kv(x, config):
 
 
 def prefill(
-    params: Params, token_ids: Array, config: ModelConfig, cache: KVCache
+    params: Params,
+    token_ids: Array,
+    config: ModelConfig,
+    cache: KVCache,
+    lm_head: Array | None = None,
 ) -> tuple[Array, KVCache]:
     """Run the prompt through the model, filling the cache.
 
     ``token_ids``: (batch, prompt_len).  Returns logits of the LAST prompt
-    position ``(batch, vocab)`` and the filled cache.
+    position ``(batch, vocab)`` and the filled cache.  ``lm_head`` overrides
+    the head weight — generate_cached passes the UNCAST master weight so the
+    head matmul stays float32 even when ``params`` were cast to bf16
+    (forward()'s logits policy, transformer.py).
     """
     batch, plen = token_ids.shape
     positions = jnp.arange(plen)
@@ -163,9 +172,9 @@ def prefill(
         x = _block_apply(x, block_params, config, attend)
 
     x = _norm(x, params["ln_final"], config)
+    head = lm_head_weight(params, config) if lm_head is None else lm_head
     logits = linear(
-        x[:, -1].astype(jnp.float32),
-        lm_head_weight(params, config).astype(jnp.float32),
+        x[:, -1].astype(jnp.float32), head.astype(jnp.float32)
     )
     return logits, new_cache
 
@@ -176,12 +185,13 @@ def decode_step(
     pos: Array,
     cache: KVCache,
     config: ModelConfig,
+    lm_head: Array | None = None,
 ) -> tuple[Array, KVCache]:
     """One cached decode step.
 
     ``token``: (batch,) ids of the token AT position ``pos`` (scalar);
     returns logits ``(batch, vocab)`` for position ``pos`` and the updated
-    cache.
+    cache.  ``lm_head`` as in :func:`prefill`.
     """
     x = embedding(params["token_embeddings"], token[:, None])  # (B, 1, d)
     positions = pos[None]  # (1,)
@@ -217,9 +227,9 @@ def decode_step(
         x = _block_apply(x, block_params, config, attend)
 
     x = _norm(x, params["ln_final"], config)
+    head = lm_head_weight(params, config) if lm_head is None else lm_head
     logits = linear(
-        x[:, 0].astype(jnp.float32),
-        lm_head_weight(params, config).astype(jnp.float32),
+        x[:, 0].astype(jnp.float32), head.astype(jnp.float32)
     )
     return logits, new_cache
 
@@ -274,14 +284,24 @@ def generate_cached(
             f"prompt ({plen}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"context_length ({config.context_length})"
         )
-    cache = init_kv_cache(config, batch)
-    logits, cache = prefill(params, prompt_ids, config, cache)
+    # Honor the config's compute dtype (mirrors forward(): params cast once,
+    # activations and the KV cache follow, but the LM head keeps the UNCAST
+    # master weight so logits stay float32-clean).  bf16 halves the cache's
+    # HBM footprint and the per-token bandwidth — the decode bottleneck.
+    act_dtype = jnp.dtype(config.activation_dtype)
+    lm_head = lm_head_weight(params, config).astype(jnp.float32)
+    if act_dtype != jnp.float32:
+        params = jax.tree_util.tree_map(lambda p: p.astype(act_dtype), params)
+    cache = init_kv_cache(config, batch, dtype=act_dtype)
+    logits, cache = prefill(params, prompt_ids, config, cache, lm_head=lm_head)
     key, sub = jax.random.split(key)
     first = _sample_from_logits(logits, sub, temperature, top_k, top_p)
 
     def step(carry, _):
         token, pos, cache, key = carry
-        logits, cache = decode_step(params, token, pos, cache, config)
+        logits, cache = decode_step(
+            params, token, pos, cache, config, lm_head=lm_head
+        )
         key, sub = jax.random.split(key)
         nxt = _sample_from_logits(logits, sub, temperature, top_k, top_p)
         return (nxt, pos + 1, cache, key), nxt

@@ -4,11 +4,13 @@ A capability the reference never implements (its contract stops at logits);
 included so the framework is usable end-to-end: tokenize a prompt, decode
 with temperature/top-k sampling, detokenize.
 
-Implementation: fixed-shape decode — the prompt lives in a ``context_length``
-buffer and every step re-runs the jitted forward on the full buffer, reading
-the logit row at the current position (causal masking makes the padding
-beyond it irrelevant).  One compile, static shapes, no KV-cache state to
-shard; a cached-KV decode path is a later optimization.
+Implementation: generations that fit the context window run the KV-cached
+one-XLA-program path (``models/decode.generate_cached``, honoring the
+config's activation dtype); longer generations fall back to fixed-shape
+sliding-window decode — the prompt lives in a ``context_length`` buffer and
+every step re-runs the jitted forward on the full buffer, reading the logit
+row at the current position (causal masking makes the padding beyond it
+irrelevant).
 """
 
 from __future__ import annotations
@@ -48,12 +50,10 @@ def generate_ids(
     if not prompt:
         raise ValueError("prompt must contain at least one token")
 
-    if (
-        len(prompt) + max_new_tokens <= ctx
-        and config.activation_dtype == "float32"  # decode.py runs in f32
-    ):
+    if len(prompt) + max_new_tokens <= ctx:
         # KV-cached fast path: O(1) work per token, one XLA program for the
-        # whole generation (models/decode.py).  Safe for MoE configs too:
+        # whole generation (models/decode.py); honors activation_dtype (bf16
+        # cache/compute for the bf16 presets).  Safe for MoE configs too:
         # decode derives expert capacity from context_length (see
         # decode._ffn_decode), so its few-token calls never drop tokens —
         # cached and uncached sampling can differ only in the case where the
@@ -75,8 +75,8 @@ def generate_ids(
             out = out[: out.index(stop_id) + 1]
         return out
 
-    # Sliding-window fallback (prompt + continuation exceed the context, or
-    # bf16 activations): full forward per token.
+    # Sliding-window fallback (prompt + continuation exceed the context
+    # window): full forward per token.
     buf = np.zeros(ctx, dtype=np.int32)
     buf[: len(prompt)] = prompt
     length = len(prompt)

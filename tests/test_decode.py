@@ -275,3 +275,57 @@ def test_moe_decode_step_dropfree_with_degenerate_capacity():
             np.asarray(logits), np.asarray(ref[:, p]), atol=1e-4,
             err_msg=f"position {p}",
         )
+
+
+def test_bf16_cached_decode_close_to_bf16_forward():
+    """The cached path honors activation_dtype: under bf16 the whole chain
+    (params cast once, bf16 KV cache, bf16 einsums, f32 softmax/logits)
+    tracks the bf16 full forward closely — the gpt2 presets are bf16, so
+    they must get the O(1)-per-token path, not the sliding-window fallback."""
+    cfg = dataclasses.replace(CFG, activation_dtype="bfloat16")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(7)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 12)), jnp.int32)
+
+    ref = forward(params, ids, cfg)  # bf16 compute, f32 logits
+
+    from bpe_transformer_tpu.models.transformer import lm_head_weight
+
+    act = jnp.bfloat16
+    head = lm_head_weight(params, cfg).astype(jnp.float32)  # master, f32
+    cast = jax.tree_util.tree_map(lambda p: p.astype(act), params)
+    cache = init_kv_cache(cfg, ids.shape[0], dtype=act)
+    logits, cache = prefill(cast, ids[:, :4], cfg, cache, lm_head=head)
+    assert logits.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref[:, 3]), atol=0.1
+    )
+    for p in range(4, ids.shape[1]):
+        logits, cache = decode_step(
+            cast, ids[:, p], jnp.asarray(p), cache, cfg, lm_head=head
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref[:, p]), atol=0.1,
+            err_msg=f"position {p}",
+        )
+    assert cache[0]["k"].dtype == act
+
+
+def test_generate_ids_bf16_uses_cached_fast_path(monkeypatch):
+    """generate_ids routes bf16 configs through generate_cached now."""
+    from bpe_transformer_tpu.models import decode as decode_mod
+    from bpe_transformer_tpu.training.sampling import generate_ids
+
+    cfg = dataclasses.replace(CFG, activation_dtype="bfloat16")
+    params = init_params(jax.random.PRNGKey(1), cfg)
+
+    calls = []
+    real = decode_mod.generate_cached
+    monkeypatch.setattr(
+        decode_mod,
+        "generate_cached",
+        lambda *a, **k: calls.append(1) or real(*a, **k),
+    )
+    out = generate_ids(params, cfg, [1, 2, 3], max_new_tokens=6, temperature=0.5)
+    assert calls, "bf16 config took the slow sliding-window path"
+    assert len(out) == 6 and all(0 <= t < cfg.vocab_size for t in out)
