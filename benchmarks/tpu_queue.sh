@@ -13,6 +13,11 @@ cd "$(dirname "$0")/.."
 OUT=/tmp/tpu_results
 CAP=benchmarks/captures
 mkdir -p "$OUT" "$CAP"
+# Persistent XLA compilation cache: tunnel windows are short and first
+# compiles cost 20-40 s each — re-runs across queue passes should not
+# re-pay them.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_ccache}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/log"; }
 
 run_job() {  # run_job <marker> <timeout_s> <outfile> <cmd...>
