@@ -62,6 +62,9 @@ CAPTURE_DIR = Path(__file__).resolve().parent / "benchmarks" / "captures"
 BENCH_CONFIGS = {
     "tinystories-4l": ("TINYSTORIES_4L", 32, 10, 100, 256),
     "tinystories-12l": ("TINYSTORIES_12L", 32, 5, 50, 512),
+    # MoE: no torch baseline exists (make_torch_lm is dense-only), so its
+    # row reports absolute tok/s + MFU without a vs_baseline ratio.
+    "tinystories-moe": ("TINYSTORIES_MOE", 16, 2, 30, 512),
     "gpt2-small-32k": ("GPT2_SMALL_32K", 32, 1, 20, 1024),
     "gpt2-medium": ("GPT2_MEDIUM", 16, 1, 10, 1024),
 }
@@ -634,7 +637,15 @@ def main() -> int:
         # killed mid-baseline (the _PHASE marker keeps the watchdog's note
         # honest, and _save_capture carries a same-shape baseline forward).
         torch_steps = 3 if ARGS.config.startswith("tinystories") else 1
-        if _remaining() > (60 if torch_steps == 3 else 300):
+        if ARGS.config == "tinystories-moe":
+            moe_note = (
+                "no torch-CPU baseline for MoE (the reference has no MoE "
+                "at all); absolute tokens/sec + MFU only"
+            )
+            RESULT["note"] = (
+                f"{RESULT['note']}; {moe_note}" if RESULT.get("note") else moe_note
+            )
+        elif _remaining() > (60 if torch_steps == 3 else 300):
             global _PHASE
             _PHASE = "torch_baseline"
             baseline = bench_torch_cpu(measure_steps=torch_steps)

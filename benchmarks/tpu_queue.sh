@@ -52,6 +52,8 @@ run_job gpt2s 1200 "$OUT/bench_gpt2s.jsonl" \
   env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 python bench.py --config gpt2-small-32k
 run_job ts12l 600 "$OUT/bench_12l.jsonl" \
   env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 python bench.py --config tinystories-12l
+run_job tsmoe 600 "$OUT/bench_moe.jsonl" \
+  env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 python bench.py --config tinystories-moe
 
 # 3. Attention kernel table, one length per invocation (VERDICT #3).
 for seq in 16384 4096 1024; do
