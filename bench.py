@@ -650,6 +650,14 @@ def main() -> int:
             else None
         )
         if prior_torch:
+            # Only skip the live measurement when it would be EXPENSIVE
+            # (>30 s/step): cheap baselines (tinystories-4l ~5 s/step) are
+            # re-measured fresh so the ratio always pairs contemporaneous
+            # numbers on the current host.
+            step_cost = ARGS.batch * BENCH_CONFIGS[ARGS.config][4] / prior_torch
+            if step_cost <= 30:
+                prior_torch = None
+        if prior_torch:
             # A same-shape baseline already exists (pre-seeded by
             # benchmarks/seed_torch_baselines.py or measured by an earlier
             # run): reuse it instead of burning minutes of the accelerator
