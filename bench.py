@@ -595,30 +595,36 @@ def main() -> int:
     try:
         platform = probe_accelerator()
         if platform == "cpu":
-            if _try_replay_capture():
-                return 0
             if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
+                # Queue semantics: this job exists to take a FRESH
+                # measurement — replaying the stored capture here would let
+                # the queue mark the job done without ever measuring.
+                # Nonzero so queue runners never mark a no-measurement
+                # attempt as complete (a null result is a retry, not a done).
                 _emit(
-                    "accelerator unreachable, no matching capture, and CPU "
-                    "fallback disabled"
+                    "accelerator unreachable and CPU fallback disabled; "
+                    "replay skipped (queue wants a fresh measurement)"
                 )
+                return 3
+            if _try_replay_capture():
                 return 0
         try:
             bench_jax(platform)
         except Exception as exc:  # probe passed but real init/run failed
+            if RESULT.get("value") and RESULT.get("platform") not in (None, "cpu"):
+                # bench_jax got real accelerator blocks in before the tunnel
+                # dropped: a fresh partial live measurement is genuine TPU
+                # evidence under every mode — salvage it before any
+                # NO_CPU_FALLBACK exit (and _save_capture persists it,
+                # unless a prior complete capture is better).
+                _emit(f"accelerator dropped mid-run ({exc!r}); partial live measurement")
+                return 0
             if os.environ.get("BENCH_NO_CPU_FALLBACK") == "1":
                 # Queue runs discard CPU output anyway; a GPT-2-sized CPU
                 # retry would just burn the recovery window.
                 _emit(f"accelerator failed ({exc!r}); CPU fallback disabled")
-                return 0
+                return 3
             print(f"accelerator failed mid-run ({exc!r}); retrying on CPU", file=sys.stderr)
-            if RESULT.get("value") and RESULT.get("platform") not in (None, "cpu"):
-                # bench_jax got real accelerator blocks in before the tunnel
-                # dropped: a fresh partial live measurement beats replaying
-                # an older capture (and _save_capture persists it, unless a
-                # prior complete capture is better).
-                _emit(f"accelerator dropped mid-run ({exc!r}); partial live measurement")
-                return 0
             if platform != "cpu":
                 if _try_replay_capture():
                     return 0

@@ -1,0 +1,175 @@
+"""Per-component timing breakdown of the training step on the real chip.
+
+The headline bench (bench.py) reports one number for the whole update; this
+script decomposes it so an MFU gap can be attributed to a specific stage
+(forward, backward, optimizer, attention impl, CE chunking) instead of
+guessed at.  Each variant is timed in its own jit with a value-fetch
+barrier, warm steps first.
+
+Rows (one JSON line each, stdout):
+    {"stage": "full_step" | "forward" | "value_and_grad" | ..., "ms": N,
+     "config": ..., "platform": "tpu", ...}
+
+Refuses to record CPU-fallback numbers: if the accelerator probe fails the
+script exits(3) without output (the TPU queue treats that as a retry).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def probe() -> bool:
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            timeout=60,
+        )
+        return out.returncode == 0 and out.stdout.decode().strip().splitlines()[-1] not in (
+            "",
+            "cpu",
+        )
+    except Exception:
+        return False
+
+
+def time_call(fn, *args, iters: int = 10, warmup: int = 2) -> float:
+    """Mean wall ms per call; a scalar fetch from the result is the barrier
+    (block_until_ready has proven unreliable on the relayed backend)."""
+    import jax
+
+    def sync(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        jax.device_get(jax.numpy.ravel(leaf)[0])
+
+    for _ in range(warmup):
+        out = fn(*args)
+    sync(out)
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - start) / iters * 1e3
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", default="gpt2-small-32k")
+    parser.add_argument("--batch", type=int, default=32)
+    parser.add_argument("--iters", type=int, default=10)
+    args = parser.parse_args()
+
+    # BREAKDOWN_ALLOW_CPU=1 is a functional smoke for the script itself
+    # (CI/dev); rows it emits carry platform "cpu" and the queue's run_job
+    # discards them, so they can never pollute TPU evidence.
+    if os.environ.get("BREAKDOWN_ALLOW_CPU") != "1" and not probe():
+        print("accelerator unreachable; refusing to record CPU numbers", file=sys.stderr)
+        return 3
+
+    import jax
+    import jax.numpy as jnp
+
+    import bpe_transformer_tpu.models as models
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.training.train_step import (
+        TrainHParams,
+        make_loss_fn,
+        make_train_step,
+    )
+
+    name_to_attr = {
+        "tinystories-4l": "TINYSTORIES_4L",
+        "tinystories-12l": "TINYSTORIES_12L",
+        "gpt2-small-32k": "GPT2_SMALL_32K",
+        "gpt2-medium": "GPT2_MEDIUM",
+    }
+    base = getattr(models, name_to_attr[args.config])
+    base = dataclasses.replace(
+        base, activation_dtype="bfloat16",
+        attention_impl="flash" if base.context_length >= 1024 else "xla",
+    )
+    device = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, base.vocab_size, size=(args.batch, base.context_length))
+    x = jnp.asarray(ids)
+    y = jnp.asarray(np.roll(ids, -1, axis=1))
+
+    def emit(stage: str, ms: float, **extra) -> None:
+        print(
+            json.dumps(
+                {
+                    "stage": stage,
+                    "ms": round(ms, 3),
+                    "config": args.config,
+                    "batch": args.batch,
+                    "platform": device.platform,
+                    **extra,
+                }
+            ),
+            flush=True,
+        )
+
+    def step_ms(config) -> float:
+        # make_train_step donates params/opt_state, so the timed loop must
+        # thread the returned state back in (reusing the donated input
+        # buffers raises on the real chip).
+        params = init_params(jax.random.PRNGKey(0), config)
+        opt_state = adamw_init(params)
+        step = make_train_step(config, TrainHParams())
+        for _ in range(2):
+            params, opt_state, metrics = step(params, opt_state, x, y)
+        jax.device_get(metrics["loss"])
+        start = time.perf_counter()
+        for _ in range(args.iters):
+            params, opt_state, metrics = step(params, opt_state, x, y)
+        jax.device_get(metrics["loss"])
+        return (time.perf_counter() - start) / args.iters * 1e3
+
+    # 1. The full update as shipped.
+    emit("full_step", step_ms(base), attention=base.attention_impl,
+         flash_block=base.flash_block_size, loss_chunk=base.loss_chunk_size)
+
+    # 2. Forward-only and grad-only splits (optimizer cost = full - valgrad).
+    params = init_params(jax.random.PRNGKey(0), base)
+    loss_fn = make_loss_fn(base)
+    fwd = jax.jit(loss_fn)
+    emit("forward", time_call(fwd, params, x, y, iters=args.iters))
+    vg = jax.jit(jax.value_and_grad(loss_fn))
+    emit("value_and_grad", time_call(lambda p: vg(p, x, y)[0], params, iters=args.iters))
+
+    # 3. Attention impl / tile size at this exact shape.
+    for attn, block in (("xla", None), ("flash", 256), ("flash", 512)):
+        if attn == base.attention_impl and (block or 256) == base.flash_block_size:
+            continue  # already row 1
+        over = {"attention_impl": attn}
+        if block:
+            over["flash_block_size"] = block
+        emit(
+            "full_step", step_ms(dataclasses.replace(base, **over)),
+            attention=attn, flash_block=block, loss_chunk=base.loss_chunk_size,
+        )
+
+    # 4. CE chunking policy.
+    for chunk in (None, 512):
+        if chunk == base.loss_chunk_size:
+            continue
+        emit(
+            "full_step", step_ms(dataclasses.replace(base, loss_chunk_size=chunk)),
+            attention=base.attention_impl, flash_block=base.flash_block_size,
+            loss_chunk=chunk,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

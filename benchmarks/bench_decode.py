@@ -70,9 +70,14 @@ def _uncached_generate(step, config, prompt, key, max_new_tokens):
 
 def _time(fn, *args, iters: int, label: str):
     try:
+        t0 = time.perf_counter()
         out = fn(*args)  # compile + first run
         jax.block_until_ready(out)
         float(jax.device_get(jnp.asarray(out).reshape(-1)[0]))  # hard barrier
+        print(
+            f"{label}: compiled+first-run in {time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+        )
         start = time.perf_counter()
         for _ in range(iters):
             out = fn(*args)
@@ -101,6 +106,7 @@ def main() -> int:
 
     names = [args.config] if args.config else sorted(CONFIGS)
     batches = [args.batch] if args.batch else [1, 8]
+    measured_any = False
     for name in names:
         # Each preset keeps its own activation dtype (gpt2 presets are bf16:
         # bf16 KV cache + einsums on the cached path, bf16 forward on the
@@ -135,6 +141,9 @@ def main() -> int:
                 label=f"uncached {name} B={batch}",
             )
 
+            if t_cached or t_uncached:
+                measured_any = True
+
             def tps(t):
                 return round(batch * new_tokens / t, 1) if t else None
 
@@ -157,7 +166,9 @@ def main() -> int:
                 ),
                 flush=True,
             )
-    return 0
+    # All timings failed -> nonzero so queue runners retry instead of
+    # committing an all-null row and marking the cell done.
+    return 0 if measured_any else 4
 
 
 if __name__ == "__main__":

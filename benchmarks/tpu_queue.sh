@@ -13,6 +13,13 @@ cd "$(dirname "$0")/.."
 OUT=/tmp/tpu_results
 CAP=benchmarks/captures
 mkdir -p "$OUT" "$CAP"
+# Single-flight: the recovery watcher and manual invocations can race; two
+# concurrent passes would contend for the one chip and pollute timings.
+exec 9> "$OUT/queue.lock"
+if ! flock -n 9; then
+  echo "$(date -u +%FT%TZ) another queue pass is running; exiting" >> "$OUT/log"
+  exit 0
+fi
 # Persistent XLA compilation cache: tunnel windows are short and first
 # compiles cost 20-40 s each — re-runs across queue passes should not
 # re-pay them.
@@ -33,14 +40,22 @@ run_job() {  # run_job <marker> <timeout_s> <outfile> <cmd...>
   # The tunnel can drop mid-queue and jax silently falls back to host CPU
   # with rc=0: CPU timings must never be recorded as TPU evidence or mark
   # the job done.
-  if grep -qE 'TFRT_CPU|"platform": "cpu"' "$tmp"; then
-    log "rc=$rc but CPU fallback detected, discarding: $*"
+  if grep -qE 'TFRT_CPU|"platform": "cpu"|"platform": null|"value": null' "$tmp"; then
+    log "rc=$rc but CPU-fallback/null result detected, discarding: $*"
     cat "$tmp" >> "$OUT/cpu_fallback.jsonl"; rm -f "$tmp"
     return 1
   fi
-  cat "$tmp" >> "$outfile"; rm -f "$tmp"
+  # Promote output only on success: a timed-out/killed job's partial rows
+  # must not land in committed capture files (each retry would append
+  # duplicates — every invocation emits its rows only on completion).
+  if [ "$rc" -eq 0 ]; then
+    cat "$tmp" >> "$outfile"
+    if [ "$marker" != "-" ]; then touch "$OUT/done_$marker"; fi
+  else
+    cat "$tmp" >> "$OUT/failed_runs.jsonl"
+  fi
+  rm -f "$tmp"
   log "rc=$rc: $*"
-  if [ "$rc" -eq 0 ] && [ "$marker" != "-" ]; then touch "$OUT/done_$marker"; fi
   return "$rc"
 }
 
@@ -61,10 +76,13 @@ for seq in 16384 4096 1024; do
     python benchmarks/bench_attention.py --seq "$seq"
 done
 
-# 4. Decode path (VERDICT #7), one cell per invocation.
+# 4. Decode path (VERDICT #7), one cell per invocation.  The gpt2 cells
+# need the longer leash: their first 600 s attempts produced no output at
+# all (compile + 128 sequential uncached forwards at 124M params).
 for cfg in tinystories-4l gpt2-small-32k; do
+  [ "$cfg" = gpt2-small-32k ] && tmo=1200 || tmo=600
   for b in 1 8; do
-    run_job "dec_${cfg}_$b" 600 "$CAP/decode.jsonl" \
+    run_job "dec_${cfg}_$b" "$tmo" "$CAP/decode.jsonl" \
       python benchmarks/bench_decode.py --config "$cfg" --batch "$b"
   done
 done
@@ -86,5 +104,10 @@ run_job gpt2s64 1200 "$OUT/bench_gpt2s64.jsonl" \
 run_job gpt2s_blk512 1200 "$OUT/bench_gpt2s_blk512.jsonl" \
   env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 BENCH_FLASH_BLOCK=512 \
   python bench.py --config gpt2-small-32k
+
+# 7. Per-stage breakdown of the gpt2-small step (MFU attribution: forward /
+# backward / attention impl / CE chunking each timed in its own jit).
+run_job breakdown 1500 "$CAP/breakdown.jsonl" \
+  python benchmarks/bench_breakdown.py --config gpt2-small-32k
 
 log "queue pass complete"
