@@ -98,11 +98,22 @@ class ModelConfig:
             )
 
     @classmethod
+    def from_dict(cls, raw: dict) -> "ModelConfig":
+        """Build from a plain dict, ignoring unknown keys (reference JSON
+        schema compatibility; also the checkpoint-stored config)."""
+        known = {f.name for f in dataclasses.fields(cls)}
+        coerced = {k: v for k, v in raw.items() if k in known}
+        # json round-trips tuples as lists; frozen dataclasses need hashables.
+        for k, v in coerced.items():
+            if isinstance(v, list):
+                coerced[k] = tuple(v)
+        return cls(**coerced)
+
+    @classmethod
     def from_json(cls, path: str | Path) -> "ModelConfig":
         with open(path) as f:
             raw: dict[str, Any] = json.load(f)
-        known = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in raw.items() if k in known})
+        return cls.from_dict(raw)
 
     def to_json(self, path: str | Path) -> None:
         payload = dataclasses.asdict(self)

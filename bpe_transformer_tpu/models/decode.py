@@ -29,6 +29,7 @@ from bpe_transformer_tpu.models.config import ModelConfig
 from bpe_transformer_tpu.models.transformer import Params, lm_head_weight
 from bpe_transformer_tpu.ops.core import (
     embedding,
+    head_logits,
     linear,
     merge_heads,
     rmsnorm,
@@ -138,9 +139,9 @@ def prefill(
 
     ``token_ids``: (batch, prompt_len).  Returns logits of the LAST prompt
     position ``(batch, vocab)`` and the filled cache.  ``lm_head`` overrides
-    the head weight — generate_cached passes the UNCAST master weight so the
-    head matmul stays float32 even when ``params`` were cast to bf16
-    (forward()'s logits policy, transformer.py).
+    the head weight — generate_cached passes a weight pre-cast to the
+    compute dtype once, outside the token loop (head_logits accumulates in
+    f32 either way, so logits stay float32-clean).
     """
     batch, plen = token_ids.shape
     positions = jnp.arange(plen)
@@ -173,9 +174,10 @@ def prefill(
 
     x = _norm(x, params["ln_final"], config)
     head = lm_head_weight(params, config) if lm_head is None else lm_head
-    logits = linear(
-        x[:, -1].astype(jnp.float32), head.astype(jnp.float32)
-    )
+    # head_logits: activation-dtype matmul, f32 accumulation — the
+    # head read (decode's per-token bandwidth bottleneck alongside the
+    # cache) happens at the compute width, logits stay f32-clean.
+    logits = head_logits(x[:, -1], head)
     return logits, new_cache
 
 
@@ -228,9 +230,7 @@ def decode_step(
 
     x = _norm(x, params["ln_final"], config)
     head = lm_head_weight(params, config) if lm_head is None else lm_head
-    logits = linear(
-        x[:, 0].astype(jnp.float32), head.astype(jnp.float32)
-    )
+    logits = head_logits(x[:, 0], head)
     return logits, new_cache
 
 
@@ -285,11 +285,12 @@ def generate_cached(
             f"context_length ({config.context_length})"
         )
     # Honor the config's compute dtype (mirrors forward(): params cast once,
-    # activations and the KV cache follow, but the LM head keeps the UNCAST
-    # master weight so logits stay float32-clean).  bf16 halves the cache's
-    # HBM footprint and the per-token bandwidth — the decode bottleneck.
+    # activations and the KV cache follow).  The LM head is pre-cast to the
+    # SAME compute dtype — _head_logits accumulates in f32, so logits stay
+    # float32-clean while the head read (the per-token bandwidth bottleneck
+    # alongside the cache) happens at the compute width.
     act_dtype = jnp.dtype(config.activation_dtype)
-    lm_head = lm_head_weight(params, config).astype(jnp.float32)
+    lm_head = lm_head_weight(params, config).astype(act_dtype)
     if act_dtype != jnp.float32:
         params = jax.tree_util.tree_map(lambda p: p.astype(act_dtype), params)
     cache = init_kv_cache(config, batch, dtype=act_dtype)

@@ -23,6 +23,7 @@ from jax import Array
 from bpe_transformer_tpu.models.config import ModelConfig
 from bpe_transformer_tpu.ops.core import (
     embedding,
+    head_logits,
     linear,
     multihead_self_attention,
     rmsnorm,
@@ -330,10 +331,9 @@ def forward(
     (load-balance) loss of MoE layers: ``(logits, aux)``.
     """
     x, aux_total = forward_hidden(params, token_ids, config, positions, attention_fn)
-    # LM head always runs in float32 for stable logits/loss.
-    logits = linear(
-        x.astype(jnp.float32), lm_head_weight(params, config).astype(jnp.float32)
-    )
+    # LM head: activation-dtype matmul, f32 accumulation (ops/core.py
+    # head_logits — f32 logits for stable loss/sampling at full MXU rate).
+    logits = head_logits(x, lm_head_weight(params, config))
     if return_aux:
         return logits, aux_total
     return logits

@@ -12,6 +12,8 @@ import jax.numpy as jnp
 from jax import Array, lax
 from jax.scipy.special import logsumexp
 
+from bpe_transformer_tpu.ops.core import head_logits
+
 
 def cross_entropy(logits: Array, targets: Array) -> Array:
     """Mean negative log-likelihood of ``targets`` under ``logits``.
@@ -51,14 +53,15 @@ def chunked_lm_cross_entropy(
             f"seq {seq} not divisible by loss chunk_size {chunk_size}"
         )
     n_chunks = seq // chunk_size
-    head32 = lm_head_w.astype(jnp.float32)
     h = hidden.reshape(batch, n_chunks, chunk_size, d).swapaxes(0, 1)
     t = targets.reshape(batch, n_chunks, chunk_size).swapaxes(0, 1)
 
     @jax.checkpoint
     def chunk_nll(args):
         hc, tc = args  # (batch, chunk, d), (batch, chunk)
-        logits = hc.astype(jnp.float32) @ head32.T
+        # head_logits: activation-dtype matmul, f32 accumulation — full MXU
+        # rate on the bf16 path, f32 logsumexp stability either way.
+        logits = head_logits(hc, lm_head_w)
         target_logit = jnp.take_along_axis(
             logits, tc[..., None].astype(jnp.int32), axis=-1
         )[..., 0]
@@ -85,5 +88,4 @@ def lm_loss(
     chunk = min(chunk_size, seq) if chunk_size else None
     if chunk and seq % chunk == 0:
         return chunked_lm_cross_entropy(hidden, lm_head_w, targets, chunk)
-    logits = hidden.astype(jnp.float32) @ lm_head_w.astype(jnp.float32).T
-    return cross_entropy(logits, targets)
+    return cross_entropy(head_logits(hidden, lm_head_w), targets)

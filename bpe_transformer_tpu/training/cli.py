@@ -40,10 +40,33 @@ def _specials(args) -> list[str]:
     return args.special_token if args.special_token else ["<|endoftext|>"]
 
 
-def _load_model_config(args) -> ModelConfig:
+def _load_model_config(args, stored: dict | None = None) -> ModelConfig:
+    """Resolve the architecture: explicit JSON > explicit --preset >
+    checkpoint-stored config > the preset default.
+
+    ``stored`` is the ``extra["model_config"]`` dict a training run saves
+    into its checkpoints — eval/generate pass it so an existing checkpoint
+    describes itself (a preset that mismatches the weights crashes deep in
+    RoPE with an opaque shape error).
+    """
     if args.model_config:
         return ModelConfig.from_json(args.model_config)
-    return PRESETS[args.preset]
+    preset = getattr(args, "preset", None)
+    if preset is not None:
+        return PRESETS[preset]
+    if stored:
+        import dataclasses
+
+        # The stored config pins the ARCHITECTURE (what the weights need);
+        # backend-specific execution knobs must not leak — a checkpoint
+        # trained with Pallas flash attention on TPU would otherwise fail
+        # to lower when evaluated on a CPU host.  Explicit --preset /
+        # --model-config still selects them deliberately.
+        cfg = ModelConfig.from_dict(stored)
+        return dataclasses.replace(
+            cfg, attention_impl="xla", ffn_impl="xla", remat=False
+        )
+    return PRESETS[getattr(args, "default_preset", "tinystories-4l")]
 
 
 def cmd_train_tokenizer(args) -> int:
@@ -138,8 +161,10 @@ def cmd_eval(args) -> int:
     from bpe_transformer_tpu.data import get_batch, load_token_file
     from bpe_transformer_tpu.training.train_step import make_eval_step
 
-    model_config = _load_model_config(args)
     payload = load_checkpoint(args.checkpoint)
+    model_config = _load_model_config(
+        args, stored=payload.get("extra", {}).get("model_config")
+    )
     eval_step = make_eval_step(model_config)
     data = load_token_file(args.data, args.dtype)
     rng = np.random.default_rng(args.seed)
@@ -155,8 +180,10 @@ def cmd_generate(args) -> int:
     from bpe_transformer_tpu.checkpointing import load_checkpoint
     from bpe_transformer_tpu.training.sampling import generate_text
 
-    model_config = _load_model_config(args)
     payload = load_checkpoint(args.checkpoint)
+    model_config = _load_model_config(
+        args, stored=payload.get("extra", {}).get("model_config")
+    )
     tokenizer = _load_tokenizer(args.tokenizer_dir, _specials(args))
     text = generate_text(
         payload["params"],
@@ -268,7 +295,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", required=True)
     p.add_argument("--data", required=True)
     p.add_argument("--dtype", default="uint16", choices=["uint16", "uint32"])
-    p.add_argument("--preset", default="tinystories-4l", choices=sorted(PRESETS))
+    # default None: prefer the config stored inside the checkpoint.
+    p.add_argument("--preset", default=None, choices=sorted(PRESETS))
     p.add_argument("--model-config", default=None)
     p.add_argument("--batches", type=int, default=16)
     p.add_argument("--batch-size", type=int, default=32)
@@ -278,7 +306,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("generate", help="sample text from a checkpoint")
     p.add_argument("--checkpoint", required=True)
     p.add_argument("--tokenizer-dir", required=True)
-    p.add_argument("--preset", default="tinystories-4l", choices=sorted(PRESETS))
+    # default None: prefer the config stored inside the checkpoint.
+    p.add_argument("--preset", default=None, choices=sorted(PRESETS))
     p.add_argument("--model-config", default=None)
     p.add_argument("--prompt", default="")
     p.add_argument("--max-new-tokens", type=int, default=128)

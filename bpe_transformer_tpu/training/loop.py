@@ -469,7 +469,15 @@ def train(
                     params=params,
                     opt_state=opt_state,
                     iteration=iteration,
-                    extra={"val_loss": val_loss, "train_loss": last_loss},
+                    extra={
+                        "val_loss": val_loss,
+                        "train_loss": last_loss,
+                        # Self-describing checkpoints: eval/generate can
+                        # recover the architecture without the user
+                        # re-passing --preset (a mismatched preset crashes
+                        # deep in RoPE with a shape error).
+                        "model_config": dataclasses.asdict(model_config),
+                    },
                 )
 
                 def update_latest(ckpt_path=ckpt_path, latest=latest):

@@ -162,6 +162,38 @@ def test_cli_end_to_end(tmp_path, tiny_corpus, capsys):
     gen_out = capsys.readouterr().out
     assert gen_out.startswith("the quick")
 
+    # Self-describing checkpoints: eval and generate recover the stored
+    # architecture when neither --preset nor --model-config is given (a
+    # defaulted preset that mismatches the weights used to crash deep in
+    # RoPE with an opaque shape error).
+    assert (
+        cli_main(
+            [
+                "eval",
+                "--checkpoint", str(ckpt_dir / "latest.ckpt"),
+                "--data", str(tokens_path),
+                "--batches", "1",
+                "--batch-size", "4",
+            ]
+        )
+        == 0
+    )
+    stored_eval = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert np.isfinite(stored_eval["val_loss"])
+    assert (
+        cli_main(
+            [
+                "generate",
+                "--checkpoint", str(ckpt_dir / "latest.ckpt"),
+                "--tokenizer-dir", str(tok_dir),
+                "--prompt", "the quick",
+                "--max-new-tokens", "4",
+            ]
+        )
+        == 0
+    )
+    assert capsys.readouterr().out.startswith("the quick")
+
 
 def test_generate_greedy_and_topk(byte_data):
     import jax
