@@ -247,3 +247,28 @@ def test_chunked_lm_cross_entropy_matches_full():
 
     with pytest.raises(ValueError, match="divisible"):
         chunked_lm_cross_entropy(hidden, head, targets, chunk_size=5)
+
+
+def test_head_logits_dtype_rule():
+    """head_logits: matmul in the hidden's dtype, f32 accumulation/output.
+
+    f32 inputs must be bit-identical to a plain f32 matmul; bf16 inputs
+    must produce f32 logits close to the f32 oracle (the head weight is
+    read at bf16, so tolerance is bf16-level).
+    """
+    from bpe_transformer_tpu.ops.core import head_logits
+
+    rng = np.random.default_rng(0)
+    hidden32 = jnp.asarray(rng.normal(size=(2, 16, 8)).astype(np.float32))
+    head32 = jnp.asarray(rng.normal(size=(50, 8)).astype(np.float32))
+
+    oracle = hidden32 @ head32.T
+    exact = head_logits(hidden32, head32)
+    assert exact.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(exact), np.asarray(oracle))
+
+    mixed = head_logits(hidden32.astype(jnp.bfloat16), head32)
+    assert mixed.dtype == jnp.float32  # accumulation/output stay f32
+    np.testing.assert_allclose(
+        np.asarray(mixed), np.asarray(oracle), rtol=0.05, atol=0.1
+    )
