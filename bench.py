@@ -218,6 +218,25 @@ def _try_replay_capture() -> bool:
             file=sys.stderr,
         )
         return False
+    # Execution-knob guards: a capture measured under a different remat or
+    # MoE-dispatch setting must not stand in for this run's configuration
+    # (same rationale as the attention checks above).  Absent fields mean
+    # the capture predates the knob — treat as the preset default.
+    want_remat = (
+        os.environ.get("BENCH_REMAT") == "1" or ARGS.config == "gpt2-medium"
+    )
+    if bool(captured.get("remat", ARGS.config == "gpt2-medium")) != want_remat:
+        print("capture remat setting differs; not replaying", file=sys.stderr)
+        return False
+    want_dispatch = os.environ.get("BENCH_MOE_DISPATCH") or "einsum"
+    cap_dispatch = captured.get("moe_dispatch") or "einsum"
+    if "moe" in ARGS.config and cap_dispatch != want_dispatch:
+        print(
+            f"capture moe_dispatch={cap_dispatch}, run wants {want_dispatch}; "
+            "not replaying",
+            file=sys.stderr,
+        )
+        return False
     RESULT.clear()
     RESULT.update(captured)
     RESULT["replayed_capture"] = True
@@ -325,6 +344,12 @@ def resolve_config(on_accel: bool):
     overrides["attention_impl"] = attention
     if ARGS.flash_block is not None:
         overrides["flash_block_size"] = ARGS.flash_block
+    if os.environ.get("BENCH_REMAT") == "1":
+        # Larger-batch variants that don't fit activations un-rematerialized.
+        overrides["remat"] = True
+    moe_dispatch = os.environ.get("BENCH_MOE_DISPATCH")
+    if moe_dispatch:
+        overrides["moe_dispatch"] = moe_dispatch
     if attention == "flash_fused":
         # An explicit flash_fused request means "measure the fused kernel":
         # disable the short-seq auto-fallback so the result isn't silently
@@ -417,6 +442,8 @@ def bench_jax(platform: str) -> None:
             seq=config.context_length,
             attention_impl=config.attention_impl,
             flash_block_size=config.flash_block_size,
+            remat=config.remat,
+            moe_dispatch=config.moe_dispatch if config.ffn_type == "moe" else None,
             flops_per_step=train_step_flops(config, batch),
         )
         # Leave room for the torch baseline (GPT-2-scale CPU steps take

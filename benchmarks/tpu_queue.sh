@@ -69,6 +69,12 @@ run_job ts12l 600 "$OUT/bench_12l.jsonl" \
   env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 python bench.py --config tinystories-12l
 run_job tsmoe 600 "$OUT/bench_moe.jsonl" \
   env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 python bench.py --config tinystories-moe
+# Index-routed dispatch variant (same routing semantics; the dense one-hot
+# dispatch einsums cost ~2x the expert FFN at this shape).  Same capture
+# file: _save_capture keeps whichever formulation measures faster.
+run_job tsmoe_gather 600 "$OUT/bench_moe.jsonl" \
+  env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 BENCH_MOE_DISPATCH=gather \
+  python bench.py --config tinystories-moe
 
 # 3. Attention kernel table, one length per invocation (VERDICT #3).
 for seq in 16384 4096 1024; do
@@ -97,8 +103,15 @@ run_job gpt2m 1500 "$OUT/bench_gpt2m.jsonl" \
 # improve the replayed headline.
 run_job inner40 300 "$OUT/bench_inner40.jsonl" \
   env BENCH_INNER_STEPS=40 BENCH_NO_CPU_FALLBACK=1 python bench.py
-run_job gpt2s64 1200 "$OUT/bench_gpt2s64.jsonl" \
-  env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 python bench.py --config gpt2-small-32k --batch 64
+# Remat fallback only when B=64 doesn't fit un-rematerialized; once the
+# fallback has succeeded, later passes skip the known-OOMing first attempt.
+if [ ! -e "$OUT/done_gpt2s64r" ]; then
+  run_job gpt2s64 1200 "$OUT/bench_gpt2s64.jsonl" \
+    env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 python bench.py --config gpt2-small-32k --batch 64 \
+    || run_job gpt2s64r 1200 "$OUT/bench_gpt2s64.jsonl" \
+      env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 BENCH_REMAT=1 \
+      python bench.py --config gpt2-small-32k --batch 64
+fi
 # Larger flash tile for the seq-1024 shape (own capture file keyed _blk512;
 # cite in RESULTS.md if it wins).
 run_job gpt2s_blk512 1200 "$OUT/bench_gpt2s_blk512.jsonl" \

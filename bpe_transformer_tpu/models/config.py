@@ -43,6 +43,13 @@ class ModelConfig:
     #: Experts per token: 1 = Switch routing, 2 = GShard-style top-2 (gates
     #: renormalized over the chosen experts).
     router_top_k: int = 1
+    #: Expert dispatch formulation.  "einsum" builds dense one-hot
+    #: dispatch/combine tensors (GShard-style; under an expert-sharded mesh
+    #: GSPMD turns them into all-to-alls).  "gather" routes tokens to expert
+    #: slots by index (identical assignments/gates) — the dense einsums cost
+    #: 2·n·e·cap·d flops EACH, which at bench shapes exceeds the expert FFN
+    #: compute itself, while gathers move only e·cap·d values.
+    moe_dispatch: str = "einsum"
     # TPU execution knobs (not part of the reference schema).
     activation_dtype: str = "float32"  # "bfloat16" for the perf path
     remat: bool = False  # rematerialize each block on the backward pass
@@ -88,6 +95,10 @@ class ModelConfig:
             raise ValueError(
                 'ffn_type="moe" requires n_experts >= 1 (got '
                 f"{self.n_experts}); set n_experts in the model config"
+            )
+        if self.moe_dispatch not in ("einsum", "gather"):
+            raise ValueError(
+                f'moe_dispatch={self.moe_dispatch!r} must be "einsum" or "gather"'
             )
         if self.ffn_type == "moe" and not (
             1 <= self.router_top_k <= self.n_experts

@@ -1,12 +1,18 @@
 """Mixture-of-experts FFN (top-1 Switch / top-k GShard routing) with expert
 parallelism.
 
-No reference precedent (SURVEY §2.4 lists EP as absent); built TPU-first in
-the GSPMD dense-dispatch formulation: expert weights are stacked on a leading
-``(n_experts, ...)`` dim, routing builds one-hot dispatch/combine tensors,
-and expert compute is a single batched einsum over all experts.  Sharding the
-expert dim over an ``expert`` mesh axis turns the dispatch einsums into
-all-to-alls over ICI — no per-expert Python loops, fully static shapes.
+No reference precedent (SURVEY §2.4 lists EP as absent); built TPU-first:
+expert weights are stacked on a leading ``(n_experts, ...)`` dim and expert
+compute is a single batched einsum over all experts — no per-expert Python
+loops, fully static shapes.  Two dispatch formulations share identical
+routing semantics (``ModelConfig.moe_dispatch``):
+
+* ``"einsum"`` (default): dense one-hot dispatch/combine tensors in the
+  GShard style; under an expert-sharded mesh GSPMD turns the dispatch
+  einsums into all-to-alls over ICI.
+* ``"gather"``: tokens reach their expert slots by row gather/scatter of
+  indices — the dense einsums cost ``2·n·e·cap·d`` flops each (more than
+  the expert FFN itself at training shapes), gathers move only the rows.
 
 Semantics (Switch Transformer, Fedus et al. 2021; GShard, Lepikhin et al.
 2020 — both public):
@@ -103,23 +109,67 @@ def switch_ffn(
     flat = assign.reshape(top_k * n, e)
     pos = jnp.cumsum(flat, axis=0) * flat - flat  # 0-based, 0 elsewhere
     keep = flat * (pos < cap)  # drop overflow assignments
-    dispatch = (
-        keep[:, :, None]
-        * jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
-    ).reshape(top_k, n, e, cap)
-    combine = gates.T[:, :, None, None] * dispatch  # (k, n, e, cap)
-    # A token holds at most one slot per expert, so summing ranks is exact.
-    dispatch = jnp.sum(dispatch, axis=0)  # (n, e, cap)
-    combine = jnp.sum(combine, axis=0)  # (n, e, cap)
 
-    # Dispatch -> expert SwiGLU -> combine, all batched over the expert dim.
     compute_dtype = tokens.dtype
-    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(compute_dtype), tokens)
+    if config.moe_dispatch == "gather":
+        # Index-routed dispatch: identical assignments/positions/gates, but
+        # tokens reach their expert slots by row gather instead of the dense
+        # (n, e, cap) one-hot einsums, whose 2·n·e·cap·d flops EACH rival
+        # the expert FFN compute itself at training shapes.
+        kn = top_k * n
+        # Row i of `flat` is (rank i // n, token i % n); its assigned expert
+        # and queue position live in that row's single nonzero column.
+        expert_of_row = topk_idx.T.reshape(kn)
+        pos_of_row = jnp.sum(pos, axis=1).astype(jnp.int32)
+        kept = jnp.sum(keep, axis=1) > 0
+        src_token = (jnp.arange(kn, dtype=jnp.int32) % n)
+        # Flat slot index; dropped assignments land on a sentinel slot past
+        # the real e*cap range.
+        dest = jnp.where(kept, expert_of_row * cap + pos_of_row, e * cap)
+        # slot -> source token (sentinel n = the appended zero row).  Kept
+        # destinations are unique by construction (cumsum queueing), so the
+        # scatter is collision-free over real slots.
+        slot_src = (
+            jnp.full((e * cap + 1,), n, jnp.int32).at[dest].set(src_token)
+        )
+        tokens_pad = jnp.concatenate([tokens, jnp.zeros((1, d), compute_dtype)])
+        expert_in = jnp.take(tokens_pad, slot_src[: e * cap], axis=0).reshape(
+            e, cap, d
+        )
+    else:
+        dispatch = (
+            keep[:, :, None]
+            * jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+        ).reshape(top_k, n, e, cap)
+        combine = gates.T[:, :, None, None] * dispatch  # (k, n, e, cap)
+        # A token holds at most one slot per expert, so summing ranks is
+        # exact.
+        dispatch = jnp.sum(dispatch, axis=0)  # (n, e, cap)
+        combine = jnp.sum(combine, axis=0)  # (n, e, cap)
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(compute_dtype), tokens)
+
+    # Expert SwiGLU, batched over the expert dim.
     up = jnp.einsum("ecd,efd->ecf", expert_in, moe_params["w1"])
     lin = jnp.einsum("ecd,efd->ecf", expert_in, moe_params["w3"])
     h = silu(up) * lin
     expert_out = jnp.einsum("ecf,edf->ecd", h, moe_params["w2"])
-    out = jnp.einsum("nec,ecd->nd", combine.astype(compute_dtype), expert_out)
+
+    if config.moe_dispatch == "gather":
+        out_rows = jnp.take(
+            jnp.concatenate(
+                [expert_out.reshape(e * cap, d), jnp.zeros((1, d), expert_out.dtype)]
+            ),
+            dest,
+            axis=0,
+        )  # (k·n, d); dropped assignments read the zero row
+        gates_flat = (gates.T.reshape(kn) * jnp.sum(keep, axis=1)).astype(
+            compute_dtype
+        )
+        out = jnp.sum(
+            (out_rows * gates_flat[:, None]).reshape(top_k, n, d), axis=0
+        )
+    else:
+        out = jnp.einsum("nec,ecd->nd", combine.astype(compute_dtype), expert_out)
 
     # Load-balance loss over the *pre-capacity* first-choice assignments
     # (the Switch definition; ranks >= 1 follow the same router so the
