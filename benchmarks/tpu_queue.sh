@@ -27,11 +27,31 @@ export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_ccache}"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/log"; }
 
+wait_for_driver() {
+  # A direct bench.py run (the driver's official capture) owns the chip:
+  # pause between queue jobs while the flag's writer PID is alive.  A
+  # dead writer (crash, Ctrl-C, SIGKILL) is detected within one poll and
+  # its flag reclaimed; a 30-min hard cap guards against PID reuse.
+  local waited=0 pid
+  while [ -e "$OUT/driver_active" ] && [ $waited -lt 1800 ]; do
+    pid=$(cat "$OUT/driver_active" 2>/dev/null)
+    if ! [ "$pid" -gt 0 ] 2>/dev/null || ! kill -0 "$pid" 2>/dev/null; then
+      log "driver flag orphaned (pid ${pid:-unreadable} dead); reclaiming"
+      rm -f "$OUT/driver_active"; break
+    fi
+    [ $waited -eq 0 ] && log "driver bench active (pid $pid); queue paused"
+    sleep 10; waited=$((waited + 10))
+  done
+  [ $waited -ge 1800 ] && log "driver wait cap hit; resuming queue"
+  return 0
+}
+
 run_job() {  # run_job <marker> <timeout_s> <outfile> <cmd...>
   local marker="$1" tmo="$2" outfile="$3"; shift 3
   if [ "$marker" != "-" ] && [ -e "$OUT/done_$marker" ]; then
     log "skip $marker (done)"; return 0
   fi
+  wait_for_driver
   log "start ${marker:-job}: $*"
   local tmp
   tmp=$(mktemp "$OUT/job.XXXXXX")
@@ -60,7 +80,9 @@ run_job() {  # run_job <marker> <timeout_s> <outfile> <cmd...>
 }
 
 # 1. Headline (always re-run: refreshes the replay capture).
-run_job - 300 "$OUT/bench_headline.jsonl" python bench.py
+# BENCH_DRIVER_FLAG=0: a queue job must not raise the driver-priority flag
+# (a timeout-kill would orphan it and pause the rest of this very pass).
+run_job - 300 "$OUT/bench_headline.jsonl" env BENCH_DRIVER_FLAG=0 python bench.py
 
 # 2. Compute-bound MFU on the real model sizes (VERDICT #2).
 run_job gpt2s 1200 "$OUT/bench_gpt2s.jsonl" \
