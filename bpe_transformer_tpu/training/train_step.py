@@ -148,6 +148,12 @@ def grad_accum_step_fn(
     loss_fn = make_loss_fn(config)
 
     def step(params, opt_state: AdamWState, xs, ys):
+        if xs.ndim != 3 or ys.ndim != 3 or xs.shape[0] != accum_steps:
+            raise ValueError(
+                f"grad-accum step wants (accum_steps={accum_steps}, "
+                f"micro_batch, seq) token ids, got xs {xs.shape} — reshape "
+                "the batch (training/loop.py does this for CLI runs)"
+            )
         grad_fn = jax.value_and_grad(loss_fn)
 
         def body(carry, batch):
