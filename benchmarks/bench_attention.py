@@ -26,7 +26,9 @@ from bpe_transformer_tpu.kernels.pallas.flash_attention import (
 from bpe_transformer_tpu.ops.rope import apply_rope, rope_tables
 
 BATCH, HEADS, D_HEAD = 1, 8, 64
-# Override with e.g. `--seq 16384` to split long runs across invocations.
+# Override with e.g. `--seq 16384` to split long runs across invocations;
+# `--batch 8 --heads 12` measures a training-shaped grid (the default B=1
+# cells are latency-dominated at short seq and noisy between runs).
 SEQ_LENS = (1024, 4096, 16384)
 
 
@@ -72,13 +74,18 @@ def main() -> int:
     if "--seq" in sys.argv:
         arg = sys.argv[sys.argv.index("--seq") + 1]
         seq_lens = tuple(int(s) for s in arg.split(","))
+    batch, heads = BATCH, HEADS
+    if "--batch" in sys.argv:
+        batch = int(sys.argv[sys.argv.index("--batch") + 1])
+    if "--heads" in sys.argv:
+        heads = int(sys.argv[sys.argv.index("--heads") + 1])
 
     rng = np.random.default_rng(0)
     cos, sin = rope_tables(D_HEAD, max(seq_lens))
     on_tpu = jax.default_backend() == "tpu"
 
     for seq in seq_lens:
-        shape = (BATCH, HEADS, seq, D_HEAD)
+        shape = (batch, heads, seq, D_HEAD)
         q, k, v = (
             jnp.asarray(rng.standard_normal(shape), dtype=jnp.bfloat16)
             for _ in range(3)
@@ -165,7 +172,8 @@ def main() -> int:
         print(
             json.dumps(
                 {
-                    "metric": f"rope+causal_attention seq={seq} (B=1,H=8,D=64,bf16)",
+                    "metric": f"rope+causal_attention seq={seq} "
+                    f"(B={batch},H={heads},D=64,bf16)",
                     "xla_ms": _ms(t_xla),
                     "pallas_ms": _ms(t_flash),
                     "pallas_fused_rope_ms": _ms(t_fused),

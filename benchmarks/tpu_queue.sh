@@ -103,6 +103,12 @@ for seq in 16384 4096 1024; do
   run_job "attn$seq" 900 "$CAP/attention.jsonl" \
     python benchmarks/bench_attention.py --seq "$seq"
 done
+# Training-shaped row (gpt2-small head geometry, batched): the B=1 cells
+# are launch-latency-dominated at 1k and noisy between runs.
+for seq in 1024 4096; do
+  run_job "attnB8_$seq" 900 "$CAP/attention.jsonl" \
+    python benchmarks/bench_attention.py --seq "$seq" --batch 8 --heads 12
+done
 
 # 4. Decode path (VERDICT #7), one cell per invocation.  The gpt2 cells
 # need the longer leash: their first 600 s attempts produced no output at
