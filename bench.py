@@ -43,6 +43,12 @@ from pathlib import Path
 
 import numpy as np
 
+# Persistent compile cache (shared with tpu_queue.sh / __graft_entry__):
+# bench invocations are deadline-bounded and a cold TPU compile costs
+# 20-40 s per program — repeat runs must not re-pay it.  Set before any
+# jax import in this process.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_ccache")
+
 T0 = time.monotonic()
 #: Config-dependent default deadline (GPT-2-scale torch-CPU baseline steps
 #: take minutes each); BENCH_DEADLINE_S overrides.  Finalized in main()
