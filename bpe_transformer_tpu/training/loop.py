@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import shutil
 from pathlib import Path
 
@@ -470,7 +471,7 @@ def train(
                     opt_state=opt_state,
                     iteration=iteration,
                     extra={
-                        "val_loss": val_loss,
+                        "val_loss": None if math.isnan(val_loss) else val_loss,
                         "train_loss": last_loss,
                         # Self-describing checkpoints: eval/generate can
                         # recover the architecture without the user
@@ -527,7 +528,9 @@ def train(
     summary = {
         "steps": loop.steps,
         "final_train_loss": last_loss,
-        "final_val_loss": val_loss,
+        # None (JSON null) when no eval ran — a NaN literal breaks strict
+        # JSON consumers of summary.json / the CLI's summary line.
+        "final_val_loss": None if math.isnan(val_loss) else val_loss,
         "history": history,
     }
     if loop.checkpoint_dir is not None:
