@@ -58,7 +58,7 @@ P = PartitionSpec
 
 
 def _fold_visiting_block(
-    q32, k_blk, v_blk, state, row_base, col_base, causal, kv_chunk
+    q, k_blk, v_blk, state, row_base, col_base, causal, kv_chunk, scale
 ):
     """Fold one visiting K/V block into the online-softmax ``state``.
 
@@ -67,14 +67,25 @@ def _fold_visiting_block(
     memory drops from O(S_local^2) to O(S_local * kv_chunk) — the blockwise
     (flash) trick at shard granularity, with the chunk body recomputed on
     the backward pass instead of storing its scores.
+
+    Matmuls take COMPUTE-dtype inputs with f32 accumulation
+    (``preferred_element_type``): bf16 shards keep full MXU rate — f32
+    inputs run the systolic array at ~1/4 speed — while the online-softmax
+    statistics stay f32.  The softmax scale is applied to the f32 scores.
     """
-    s_q = q32.shape[-2]
+    s_q = q.shape[-2]
     s_kv = k_blk.shape[-2]
     rows = jnp.arange(s_q)[:, None]
 
     def fold(state, k_c, v_c, col0, width):
         m, l, acc = state
-        scores = jnp.einsum("...qd,...kd->...qk", q32, k_c.astype(jnp.float32))
+        scores = (
+            jnp.einsum(
+                "...qd,...kd->...qk", q, k_c,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
         if causal:
             cols = jnp.arange(width)[None, :]
             keep = (row_base + rows) >= (col_base + col0 + cols)
@@ -84,7 +95,8 @@ def _fold_visiting_block(
         p = jnp.exp(scores - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.einsum(
-            "...qk,...kv->...qv", p, v_c.astype(jnp.float32)
+            "...qk,...kv->...qv", p.astype(v_c.dtype), v_c,
+            preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
 
@@ -134,7 +146,6 @@ def ring_self_attention(
     d = q.shape[-1]
     scale = 1.0 / (d**0.5)
 
-    q32 = q.astype(jnp.float32) * scale
     stat_shape = (*q.shape[:-1], 1)
     m = jnp.full(stat_shape, NEG_INF, jnp.float32)
     l = jnp.zeros(stat_shape, jnp.float32)
@@ -145,7 +156,7 @@ def ring_self_attention(
         src = (me - step) % n  # which shard's K/V we hold this step
 
         m_new, l_new, acc_new = _fold_visiting_block(
-            q32,
+            q,
             k_cur,
             v_cur,
             (m, l, acc),
@@ -153,6 +164,7 @@ def ring_self_attention(
             src * s_local,
             causal,
             kv_chunk,
+            scale,
         )
 
         if causal:
@@ -545,7 +557,9 @@ def zigzag_ring_self_attention(
     scale = 1.0 / (d**0.5)
 
     split = lambda x: (x[..., :c, :], x[..., c:, :])
-    qa, qb = split(q.astype(jnp.float32) * scale)
+    # Compute-dtype matmul inputs, f32 accumulation/stats (same dtype rule
+    # as _fold_visiting_block): bf16 shards keep full MXU rate.
+    qa, qb = split(q)
     stat = lambda: (
         jnp.full((*qa.shape[:-1], 1), NEG_INF, jnp.float32),
         jnp.zeros((*qa.shape[:-1], 1), jnp.float32),
@@ -561,11 +575,17 @@ def zigzag_ring_self_attention(
         p = jnp.exp(scores - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.einsum(
-            "...qk,...kv->...qv", p, v_blk.astype(jnp.float32)
+            "...qk,...kv->...qv", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
 
-    dots = lambda qq, kk: jnp.einsum("...qd,...kd->...qk", qq, kk.astype(jnp.float32))
+    dots = lambda qq, kk: (
+        jnp.einsum(
+            "...qd,...kd->...qk", qq, kk, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
     tri = jnp.arange(c)[:, None] >= jnp.arange(c)[None, :]
 
     # Step 0: own K/V — the diagonal step.

@@ -302,6 +302,55 @@ def test_zigzag_ring_attention_matches_xla_and_ring():
     np.testing.assert_allclose(np.asarray(out_zig), np.asarray(expected), atol=1e-5)
 
 
+def test_ring_attention_bf16_inputs_match_f32_reference():
+    """The compute-dtype matmul rule (bf16 inputs, f32 accumulation) must
+    track the f32 oracle within bf16 tolerance for BOTH XLA ring schedules.
+    All other ring tests run f32, where preferred_element_type is a no-op —
+    this is the only coverage of the precision-affecting path."""
+    from functools import partial
+
+    from bpe_transformer_tpu.ops.core import causal_mask, scaled_dot_product_attention
+    from bpe_transformer_tpu.parallel.ring_attention import (
+        ring_self_attention,
+        zigzag_indices,
+        zigzag_inverse_indices,
+        zigzag_ring_self_attention,
+    )
+
+    n = 8
+    B, H, S, D = 2, 2, 64, 16
+    mesh = make_mesh({"seq": n})
+    rng = np.random.default_rng(1)
+    q32, k32, v32 = (
+        jnp.asarray(rng.standard_normal((B, H, S, D)).astype(np.float32))
+        for _ in range(3)
+    )
+    expected = scaled_dot_product_attention(q32, k32, v32, causal_mask(S))
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q32, k32, v32))
+
+    spec = PartitionSpec(None, None, "seq", None)
+    ring = jax.shard_map(
+        partial(ring_self_attention, axis_name="seq", causal=True),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+    )
+    out = ring(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(expected), atol=0.03
+    )
+
+    perm = zigzag_indices(S, n)
+    inv = zigzag_inverse_indices(S, n)
+    zig = jax.shard_map(
+        partial(zigzag_ring_self_attention, axis_name="seq"),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+    )
+    out_zig = zig(q[..., perm, :], k[..., perm, :], v[..., perm, :])[..., inv, :]
+    np.testing.assert_allclose(
+        np.asarray(out_zig, np.float32), np.asarray(expected), atol=0.03
+    )
+
+
 def test_zigzag_positions_cover_sequence():
     from bpe_transformer_tpu.parallel.ring_attention import (
         zigzag_indices,
