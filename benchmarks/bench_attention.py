@@ -19,11 +19,20 @@ import jax
 import jax.numpy as jnp
 
 from bpe_transformer_tpu.kernels.pallas.flash_attention import (
-    _xla_attention,
     flash_attention,
     flash_attention_with_rope,
 )
+from bpe_transformer_tpu.ops.core import causal_mask, scaled_dot_product_attention
 from bpe_transformer_tpu.ops.rope import apply_rope, rope_tables
+
+
+def _xla_baseline(q, k, v, causal):
+    """The model's OWN attention_impl="xla" math (ops/core.py): compute-
+    dtype matmuls, f32 softmax.  The f32-upcast parity oracle
+    (kernels/pallas/flash_attention._xla_attention) is NOT a fair speed
+    baseline — f32 matmuls run the MXU at ~1/4 rate."""
+    mask = causal_mask(q.shape[-2]) if causal else None
+    return scaled_dot_product_attention(q, k, v, mask)
 
 BATCH, HEADS, D_HEAD = 1, 8, 64
 # Override with e.g. `--seq 16384` to split long runs across invocations;
@@ -102,7 +111,7 @@ def main() -> int:
         cos_s, sin_s = cos[:seq], sin[:seq]
         iters = 10 if seq < 16384 else 3
         t_xla = _bench(
-            roped(lambda q, k, v: _xla_attention(q, k, v, True)), q, k, v,
+            roped(lambda q, k, v: _xla_baseline(q, k, v, True)), q, k, v,
             label=f"xla_fwd@{seq}", iters=iters,
         )
         t_flash = _bench(
@@ -145,7 +154,7 @@ def main() -> int:
             return timed
 
         t_xla_bwd = _bench(
-            grad_of(roped(lambda q, k, v: _xla_attention(q, k, v, True))),
+            grad_of(roped(lambda q, k, v: _xla_baseline(q, k, v, True))),
             q, k, v,
             label=f"xla_bwd@{seq}", iters=iters,
         )
