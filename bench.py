@@ -246,6 +246,13 @@ def _try_replay_capture() -> bool:
             file=sys.stderr,
         )
         return False
+    want_ffn = os.environ.get("BENCH_FFN_IMPL") or "xla"
+    if captured.get("ffn_impl", "xla") != want_ffn:
+        print(
+            f"capture ffn_impl differs from requested {want_ffn}; not replaying",
+            file=sys.stderr,
+        )
+        return False
     RESULT.clear()
     RESULT.update(captured)
     RESULT["replayed_capture"] = True
@@ -364,6 +371,12 @@ def resolve_config(on_accel: bool):
     moe_dispatch = os.environ.get("BENCH_MOE_DISPATCH")
     if moe_dispatch:
         overrides["moe_dispatch"] = moe_dispatch
+    ffn_impl = os.environ.get("BENCH_FFN_IMPL")
+    if ffn_impl:
+        if not on_accel and ffn_impl != "xla":
+            print("BENCH_FFN_IMPL=pallas needs the TPU backend; using xla", file=sys.stderr)
+        else:
+            overrides["ffn_impl"] = ffn_impl
     if attention == "flash_fused":
         # An explicit flash_fused request means "measure the fused kernel":
         # disable the short-seq auto-fallback so the result isn't silently
@@ -457,6 +470,7 @@ def bench_jax(platform: str) -> None:
             attention_impl=config.attention_impl,
             flash_block_size=config.flash_block_size,
             remat=config.remat,
+            ffn_impl=config.ffn_impl,
             moe_dispatch=config.moe_dispatch if config.ffn_type == "moe" else None,
             flops_per_step=train_step_flops(config, batch),
         )

@@ -146,6 +146,12 @@ run_job gpt2s_blk512 1200 "$OUT/bench_gpt2s_blk512.jsonl" \
   env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 BENCH_FLASH_BLOCK=512 \
   python bench.py --config gpt2-small-32k
 
+# Pallas fused-SwiGLU FFN at the gpt2 shape (parity-tested; never timed
+# on chip).  Own capture semantics via the recorded ffn_impl field.
+run_job gpt2s_ffnp 1200 "$OUT/bench_gpt2s_ffnp.jsonl" \
+  env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 BENCH_FFN_IMPL=pallas \
+  python bench.py --config gpt2-small-32k
+
 # 7. Per-stage breakdown of the gpt2-small step (MFU attribution: forward /
 # backward / attention impl / CE chunking each timed in its own jit).
 run_job breakdown 1500 "$CAP/breakdown.jsonl" \
