@@ -98,6 +98,11 @@ run_job tsmoe_gather 600 "$OUT/bench_moe.jsonl" \
   env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 BENCH_MOE_DISPATCH=gather \
   python bench.py --config tinystories-moe
 
+# 2b. GPT-2-medium MFU (VERDICT #2's second shape) — ahead of the attention
+# re-captures and decode cells so a short window still lands it.
+run_job gpt2m 1500 "$OUT/bench_gpt2m.jsonl" \
+  env BENCH_DEADLINE_S=1200 BENCH_NO_CPU_FALLBACK=1 python bench.py --config gpt2-medium
+
 # 3. Attention kernel table, one length per invocation (VERDICT #3).
 for seq in 16384 4096 1024; do
   run_job "attn$seq" 900 "$CAP/attention.jsonl" \
@@ -120,10 +125,6 @@ for cfg in tinystories-4l gpt2-small-32k; do
       python benchmarks/bench_decode.py --config "$cfg" --batch "$b"
   done
 done
-
-# 5. GPT-2-medium MFU (largest single-chip shape; remat on).
-run_job gpt2m 1500 "$OUT/bench_gpt2m.jsonl" \
-  env BENCH_DEADLINE_S=1200 BENCH_NO_CPU_FALLBACK=1 python bench.py --config gpt2-medium
 
 # 6. Tuning variants: deeper dispatch amortization for the small model and
 # a bigger batch for gpt2-small (own capture file; may OOM -> discarded).
