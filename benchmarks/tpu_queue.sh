@@ -153,6 +153,10 @@ run_job gpt2s_ffnp 1200 "$OUT/bench_gpt2s_ffnp.jsonl" \
   env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 BENCH_FFN_IMPL=pallas \
   python bench.py --config gpt2-small-32k
 
+# MoE dispatch formulations head-to-head at the bench shape (bf16, chip).
+run_job moedisp 600 "$CAP/moe_dispatch.jsonl" \
+  python benchmarks/bench_moe_dispatch.py
+
 # 7. Per-stage breakdown of the gpt2-small step (MFU attribution: forward /
 # backward / attention impl / CE chunking each timed in its own jit).
 run_job breakdown 1500 "$CAP/breakdown.jsonl" \
