@@ -1,0 +1,64 @@
+"""Shared accelerator-gate for benchmark scripts (ADVICE r3: the probe was
+duplicated in bench_decode/bench_attention/bench_moe_dispatch and
+near-duplicated in bench_breakdown; one copy here so it can't drift).
+
+The container's axon backend HANGS on init when its tunnel is down rather
+than raising, so the probe must be a subprocess with a timeout — a direct
+``jax.devices()`` call would burn the caller's whole queue timeout.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def probe_platform(timeout_s: float = 60.0) -> tuple[str | None, str]:
+    """Probe what platform a fresh interpreter reaches.
+
+    Returns ``(platform, note)``: platform is e.g. ``"tpu"`` when a non-CPU
+    backend answered, else ``None`` with ``note`` explaining why (timeout,
+    CPU-only, stderr tail) — callers that annotate their output (bench.py's
+    RESULT note) need the reason, not just the boolean.
+    """
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True,
+            timeout=timeout_s,
+        )
+        out = probe.stdout.decode().strip().splitlines()
+        if probe.returncode == 0 and out and out[-1] not in ("", "cpu"):
+            return out[-1], ""
+        if probe.returncode == 0:
+            return None, "backend resolved to host CPU"
+        return None, (probe.stderr or b"").decode(errors="replace")[-200:]
+    except subprocess.TimeoutExpired:
+        return None, f"backend init exceeded {timeout_s:.0f}s"
+    except Exception as exc:  # noqa: BLE001 - a probe failure is just "down"
+        return None, repr(exc)
+
+
+def accelerator_up(timeout_s: float = 60.0) -> bool:
+    """True when a fresh interpreter reaches a non-CPU backend."""
+    return probe_platform(timeout_s)[0] is not None
+
+
+def require_accelerator(name: str = "benchmark", timeout_s: float = 60.0) -> None:
+    """Exit rc=3 (the queue's "retry later" code) when the tunnel is down.
+
+    An explicit ``JAX_PLATFORMS=cpu`` run (dev/CI smoke on hosts with no
+    accelerator) skips the probe — the caller asked for CPU, so CPU numbers
+    are what they expect.
+    """
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return
+    if accelerator_up(timeout_s):
+        return
+    print(
+        f"{name}: accelerator unreachable; exiting for fast queue retry "
+        "(set JAX_PLATFORMS=cpu for an explicit CPU smoke run)",
+        file=sys.stderr,
+    )
+    raise SystemExit(3)

@@ -13,6 +13,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from _accel import require_accelerator  # noqa: E402  (benchmarks/_accel.py)
+
 import numpy as np
 
 import jax
@@ -78,35 +80,10 @@ def _ratio(a: float | None, b: float | None):
     return round(a / b, 2) if a and b else None
 
 
-def _require_accelerator() -> None:
-    """Exit fast (rc=3) when the accelerator tunnel is down.
-
-    The axon backend HANGS on init when its tunnel is down, which would
-    otherwise burn this job's full queue timeout.  An explicit
-    JAX_PLATFORMS=cpu run (dev/CI smoke) skips the probe.
-    """
-    import os
-    import subprocess
-
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        return
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True,
-            timeout=60,
-        )
-        out = probe.stdout.decode().strip().splitlines()
-        if probe.returncode == 0 and out and out[-1] not in ("", "cpu"):
-            return
-    except Exception:
-        pass
-    print("accelerator unreachable; exiting for fast queue retry", file=sys.stderr)
-    raise SystemExit(3)
 
 
 def main() -> int:
-    _require_accelerator()
+    require_accelerator(Path(__file__).stem)
     seq_lens = SEQ_LENS
     if "--seq" in sys.argv:
         arg = sys.argv[sys.argv.index("--seq") + 1]

@@ -20,26 +20,16 @@ import argparse
 import dataclasses
 import json
 import os
-import subprocess
 import sys
 import time
+from pathlib import Path
 
 import numpy as np
 
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-def probe() -> bool:
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True,
-            timeout=60,
-        )
-        return out.returncode == 0 and out.stdout.decode().strip().splitlines()[-1] not in (
-            "",
-            "cpu",
-        )
-    except Exception:
-        return False
+from _accel import accelerator_up  # noqa: E402  (benchmarks/_accel.py)
+
 
 
 def time_call(fn, *args, iters: int = 10, warmup: int = 2) -> float:
@@ -71,7 +61,7 @@ def main() -> int:
     # BREAKDOWN_ALLOW_CPU=1 is a functional smoke for the script itself
     # (CI/dev); rows it emits carry platform "cpu" and the queue's run_job
     # discards them, so they can never pollute TPU evidence.
-    if os.environ.get("BREAKDOWN_ALLOW_CPU") != "1" and not probe():
+    if os.environ.get("BREAKDOWN_ALLOW_CPU") != "1" and not accelerator_up():
         print("accelerator unreachable; refusing to record CPU numbers", file=sys.stderr)
         return 3
 

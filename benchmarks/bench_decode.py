@@ -22,6 +22,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from _accel import require_accelerator  # noqa: E402  (benchmarks/_accel.py)
+
 import numpy as np
 
 import jax
@@ -88,35 +90,10 @@ def _time(fn, *args, iters: int, label: str):
         return None
 
 
-def _require_accelerator() -> None:
-    """Exit fast (rc=3) when the accelerator tunnel is down.
-
-    The axon backend HANGS on init when its tunnel is down, which would
-    otherwise burn this job's full queue timeout.  An explicit
-    JAX_PLATFORMS=cpu run (dev/CI smoke) skips the probe.
-    """
-    import os
-    import subprocess
-
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        return
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True,
-            timeout=60,
-        )
-        out = probe.stdout.decode().strip().splitlines()
-        if probe.returncode == 0 and out and out[-1] not in ("", "cpu"):
-            return
-    except Exception:
-        pass
-    print("accelerator unreachable; exiting for fast queue retry", file=sys.stderr)
-    raise SystemExit(3)
 
 
 def main() -> int:
-    _require_accelerator()
+    require_accelerator(Path(__file__).stem)
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", choices=sorted(CONFIGS), default=None)
     parser.add_argument("--batch", type=int, default=None)

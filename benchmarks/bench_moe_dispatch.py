@@ -6,11 +6,13 @@ cost ``2·n·e·cap·d`` flops EACH — at training shapes that exceeds the
 expert FFN compute itself — while the gather formulation moves rows by
 index.
 
-Run anywhere: on CPU the numbers are relative (formulation arithmetic,
-like the ring-schedule comparison); on the TPU they are wall-clock
-evidence.  Prints one JSON line.
+On the TPU the numbers are wall-clock evidence; for a host-CPU run
+(relative formulation arithmetic, like the ring-schedule comparison) set
+``JAX_PLATFORMS=cpu`` explicitly — without it the accelerator gate exits
+rc=3 when the tunnel is down, producing no output (ADVICE r3).
 
     python benchmarks/bench_moe_dispatch.py [--tokens N] [--d D] [--ff F]
+    JAX_PLATFORMS=cpu python benchmarks/bench_moe_dispatch.py   # CPU smoke
 """
 
 from __future__ import annotations
@@ -24,6 +26,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from _accel import require_accelerator  # noqa: E402  (benchmarks/_accel.py)
+
 import numpy as np
 
 import bpe_transformer_tpu  # noqa: F401  (re-asserts JAX_PLATFORMS before backend init)
@@ -31,35 +35,10 @@ import jax
 import jax.numpy as jnp
 
 
-def _require_accelerator() -> None:
-    """Exit fast (rc=3) when the accelerator tunnel is down.
-
-    The axon backend HANGS on init when its tunnel is down, which would
-    otherwise burn this job's full queue timeout.  An explicit
-    JAX_PLATFORMS=cpu run (dev/CI smoke) skips the probe.
-    """
-    import os
-    import subprocess
-
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        return
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True,
-            timeout=60,
-        )
-        out = probe.stdout.decode().strip().splitlines()
-        if probe.returncode == 0 and out and out[-1] not in ("", "cpu"):
-            return
-    except Exception:
-        pass
-    print("accelerator unreachable; exiting for fast queue retry", file=sys.stderr)
-    raise SystemExit(3)
 
 
 def main() -> int:
-    _require_accelerator()
+    require_accelerator(Path(__file__).stem)
     parser = argparse.ArgumentParser()
     # Defaults: the tinystories-moe bench shape on accelerators, a scaled
     # shape (same n/(3*ff) dispatch:FFN flop ratio regime) on host CPU.

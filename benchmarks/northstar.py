@@ -1,0 +1,357 @@
+"""BASELINE north star, demonstrated in ONE on-chip run.
+
+Target (BASELINE.json): the TinyStories 4-layer LM reaches the PyTorch-CPU
+reference validation loss at >= 10x its tokens/sec.  Prior rounds proved the
+two halves separately — throughput on the chip (bench.py) and loss parity at
+toy shape on CPU (val_parity.py).  This script closes the loop at the REAL
+config-1 shape (`TINYSTORIES_4L`: vocab 10k, seq 256, 4L/256d) with the
+training run itself on the accelerator.
+
+Protocol (LR-matched, identical on both substrates — val_parity.py's):
+same BPE-tokenized corpus, same train/val split, same pre-drawn batch
+schedule, same init (the JAX init copied into torch), same warmup+cosine
+AdamW schedule (`TrainHParams` defaults).  The torch side is the
+reference-architecture step from ``bench.make_torch_lm`` (defined by
+`/root/reference/tests/adapters.py:282-361`; the reference ships no loop).
+
+Corpus: BASELINE config 1 names `tinystories_sample.txt`, but the mounted
+copy is 3.7 KB and the 5 MB sample is a missing blob
+(`/root/reference/tests/.MISSING_LARGE_BLOBS`); `corpus.en` (130 KB) is the
+largest text the reference ships, so it is the corpus here — recorded in
+the artifact, as in val_parity.py.
+
+Phases (so a short tunnel window only pays for the accelerator part):
+  --phase data    tokenize the corpus at vocab 10k; cache to
+                  benchmarks/northstar_tokens.npz (deterministic, committed)
+  --phase torch   the torch-CPU reference run; writes
+                  benchmarks/northstar_torch.json (curve, final val loss,
+                  tokens/sec).  Runs offline, no accelerator needed.
+  --phase jax     the accelerator run.  Checkpoints every eval to
+                  /tmp/tpu_results/northstar_ckpt.pkl so a tunnel drop
+                  RESUMES instead of restarting; on completion writes
+                  benchmarks/captures/northstar.json with both final val
+                  losses, both tokens/sec, and the speedup.
+  (default)       data + torch if their artifacts are missing, then jax.
+
+Numerics: both sides train in f32; the JAX run pins
+``jax.default_matmul_precision("highest")`` so the TPU trajectory tracks the
+torch-f32 oracle (TPU's default f32 matmul rounds through bf16 passes and
+would drift over hundreds of steps).  Even at highest precision the tiny
+model clears the 10x bar by orders of magnitude — the HONEST perf numbers
+live in bench.py's captures; this run is the convergence evidence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_ccache")
+
+from _accel import require_accelerator  # noqa: E402  (benchmarks/_accel.py)
+
+SEQ = 256
+BATCH = 16
+VOCAB = 10_000
+#: NORTHSTAR_STEPS is a smoke-test override; the artifacts record the value
+#: used, and phase_jax refuses a torch reference run at a different length.
+STEPS = int(os.environ.get("NORTHSTAR_STEPS", "200"))
+EVAL_EVERY = 25
+VAL_FRACTION = 0.1
+SPECIAL = "<|endoftext|>"
+CORPUS = "/root/reference/tests/fixtures/corpus.en"
+
+TOKENS_NPZ = REPO / "benchmarks" / "northstar_tokens.npz"
+TORCH_JSON = REPO / "benchmarks" / "northstar_torch.json"
+CAPTURE = REPO / "benchmarks" / "captures" / "northstar.json"
+CKPT = Path("/tmp/tpu_results/northstar_ckpt.pkl")
+
+
+def _write_json(path: Path, payload: dict) -> None:
+    """tmp + os.replace, as bench.py's captures: a queue timeout landing
+    mid-write must not leave a torn artifact for bench.py to half-read."""
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(payload, indent=2) + "\n")
+    os.replace(tmp, path)
+
+
+def phase_data() -> np.ndarray:
+    """Tokenize the corpus (vocab 10k BPE trained on it) and cache the ids.
+
+    Deterministic — the BPE trainer's tie-breaking is pinned by the
+    reference's own snapshot tests — so the cache is just a time saver for
+    the accelerator window, not a correctness requirement.
+    """
+    if TOKENS_NPZ.exists():
+        return np.load(TOKENS_NPZ)["tokens"]
+    from bpe_transformer_tpu import BPETokenizer, train_bpe
+
+    corpus = Path(CORPUS)
+    vocab, merges = train_bpe(str(corpus), VOCAB, [SPECIAL])
+    tok = BPETokenizer(vocab, merges, [SPECIAL])
+    ids = tok.encode(corpus.read_text(encoding="utf-8", errors="ignore"))
+    tokens = np.asarray(ids, dtype=np.int32)
+    np.savez_compressed(TOKENS_NPZ, tokens=tokens, vocab_size=len(vocab))
+    print(f"tokenized {corpus.name}: {len(tokens)} tokens, "
+          f"{len(vocab)} vocab entries", file=sys.stderr)
+    return tokens
+
+
+def split_tokens(tokens: np.ndarray):
+    n_val = max(int(len(tokens) * VAL_FRACTION), SEQ + 1)
+    return tokens[:-n_val], tokens[-n_val:]
+
+
+def batch_schedule(n_tokens: int) -> np.ndarray:
+    """All start indices drawn up front from one seed — a resumed run at
+    step k sees exactly the batches the uninterrupted run would have."""
+    rng = np.random.default_rng(0)
+    return rng.integers(0, n_tokens - SEQ - 1, size=(STEPS, BATCH))
+
+
+def gather_batch(tokens: np.ndarray, starts: np.ndarray):
+    x = np.stack([tokens[s : s + SEQ] for s in starts])
+    y = np.stack([tokens[s + 1 : s + SEQ + 1] for s in starts])
+    return x.astype(np.int64), y.astype(np.int64)
+
+
+def val_batches(val_toks: np.ndarray):
+    n = (len(val_toks) - 1) // SEQ
+    for i in range(min(n, 8)):
+        s = i * SEQ
+        yield (
+            val_toks[s : s + SEQ][None, :].astype(np.int64),
+            val_toks[s + 1 : s + SEQ + 1][None, :].astype(np.int64),
+        )
+
+
+def model_config():
+    import dataclasses
+
+    from bpe_transformer_tpu.models import TINYSTORIES_4L
+
+    assert TINYSTORIES_4L.vocab_size == VOCAB
+    assert TINYSTORIES_4L.context_length == SEQ
+    return dataclasses.replace(TINYSTORIES_4L)
+
+
+def init_params_np():
+    """The shared starting point: JAX's deterministic init (threefry is
+    platform-independent), fetched to host numpy for the torch loader."""
+    import jax
+
+    from bpe_transformer_tpu.models import init_params
+
+    params = init_params(jax.random.PRNGKey(0), model_config())
+    return jax.tree_util.tree_map(np.asarray, params)
+
+
+def phase_torch() -> dict:
+    if TORCH_JSON.exists():
+        return json.loads(TORCH_JSON.read_text())
+    import torch
+
+    from bench import make_torch_lm
+    from benchmarks.val_parity import _load_jax_params_into_torch
+
+    tokens = phase_data()
+    train_toks, val_toks = split_tokens(tokens)
+    schedule = batch_schedule(len(train_toks))
+    cfg = model_config()
+    model, train_step, eval_loss = make_torch_lm(cfg)
+    _load_jax_params_into_torch(model, init_params_np())
+
+    def val_loss():
+        losses = [
+            eval_loss(torch.from_numpy(x), torch.from_numpy(y))
+            for x, y in val_batches(val_toks)
+        ]
+        return sum(losses) / len(losses)
+
+    curve = []
+    start = time.perf_counter()
+    train_s = 0.0
+    for i in range(STEPS):
+        x, y = gather_batch(train_toks, schedule[i])
+        t0 = time.perf_counter()
+        loss = train_step(torch.from_numpy(x), torch.from_numpy(y))
+        train_s += time.perf_counter() - t0
+        if (i + 1) % EVAL_EVERY == 0 or i == STEPS - 1:
+            curve.append({"step": i + 1, "train_loss": loss, "val_loss": val_loss()})
+            print(f"torch step {i + 1}: {curve[-1]}", file=sys.stderr)
+    result = {
+        "config": "TINYSTORIES_4L (vocab 10k, seq 256), batch 16",
+        "corpus": CORPUS,
+        "steps": STEPS,
+        "curve": curve,
+        "final_val_loss": curve[-1]["val_loss"],
+        # tokens/sec over train-step time only (evals excluded on both
+        # sides — the comparison is the training step, the reference's
+        # contract surface).
+        "tokens_per_sec": round(STEPS * BATCH * SEQ / train_s, 1),
+        "wall_s": round(time.perf_counter() - start, 1),
+    }
+    _write_json(TORCH_JSON, result)
+    print(f"torch reference: final val {result['final_val_loss']:.4f}, "
+          f"{result['tokens_per_sec']:,.0f} tok/s", file=sys.stderr)
+    return result
+
+
+def phase_jax(allow_cpu: bool) -> int:
+    if not allow_cpu:
+        require_accelerator("northstar")
+    torch_ref = json.loads(TORCH_JSON.read_text())
+    if torch_ref["steps"] != STEPS:
+        raise SystemExit(
+            f"torch reference ran {torch_ref['steps']} steps but this run "
+            f"wants {STEPS}; delete {TORCH_JSON} or match NORTHSTAR_STEPS"
+        )
+
+    import jax
+    import jax.numpy as jnp
+
+    from bpe_transformer_tpu.checkpointing import load_checkpoint, save_checkpoint
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.training.train_step import (
+        TrainHParams,
+        make_eval_step,
+        make_train_step,
+    )
+
+    tokens = phase_data()
+    train_toks, val_toks = split_tokens(tokens)
+    schedule = batch_schedule(len(train_toks))
+    cfg = model_config()
+    device = jax.devices()[0]
+
+    with jax.default_matmul_precision("highest"):
+        step = make_train_step(cfg, TrainHParams())
+        ev = make_eval_step(cfg)
+
+        if CKPT.exists():
+            payload = load_checkpoint(CKPT)
+            ckpt_platform = payload["extra"].get("platform")
+            ckpt_steps = payload["extra"].get("steps")
+            if ckpt_platform != device.platform or ckpt_steps != STEPS:
+                # An interrupted --allow-cpu smoke must not seed the real
+                # on-chip run (the capture would claim a trajectory trained
+                # mostly on the wrong substrate), and a checkpoint from a
+                # different-length protocol must not shortcut this one (a
+                # stale iteration >= STEPS would skip training entirely and
+                # write an inconsistent artifact); restart from scratch.
+                print(
+                    f"checkpoint is platform={ckpt_platform!r} steps={ckpt_steps!r}; "
+                    f"this run is platform={device.platform!r} steps={STEPS}; "
+                    "discarding and starting fresh",
+                    file=sys.stderr,
+                )
+                CKPT.unlink()
+                payload = None
+        else:
+            payload = None
+        if payload is not None:
+            params, opt_state = payload["params"], payload["opt_state"]
+            start_step = payload["iteration"]
+            curve = payload["extra"]["curve"]
+            train_s = payload["extra"]["train_s"]
+            print(f"resuming from step {start_step}", file=sys.stderr)
+        else:
+            params = init_params(jax.random.PRNGKey(0), cfg)
+            opt_state = adamw_init(params)
+            start_step, curve, train_s = 0, [], 0.0
+
+        def val_loss():
+            losses = [
+                float(ev(params, jnp.asarray(x), jnp.asarray(y)))
+                for x, y in val_batches(val_toks)
+            ]
+            return sum(losses) / len(losses)
+
+        for i in range(start_step, STEPS):
+            x, y = gather_batch(train_toks, schedule[i])
+            t0 = time.perf_counter()
+            params, opt_state, m = step(params, opt_state, jnp.asarray(x), jnp.asarray(y))
+            loss = float(jax.device_get(m["loss"]))  # execution barrier
+            train_s += time.perf_counter() - t0
+            if (i + 1) % EVAL_EVERY == 0 or i == STEPS - 1:
+                curve.append({"step": i + 1, "train_loss": loss, "val_loss": val_loss()})
+                print(f"jax step {i + 1}: {curve[-1]}", file=sys.stderr)
+                CKPT.parent.mkdir(parents=True, exist_ok=True)
+                save_checkpoint(
+                    CKPT,
+                    params=params,
+                    opt_state=opt_state,
+                    iteration=i + 1,
+                    extra={
+                        "curve": curve,
+                        "train_s": train_s,
+                        "platform": device.platform,
+                        "steps": STEPS,
+                    },
+                )
+
+    jax_tps = STEPS * BATCH * SEQ / train_s
+    final_val = curve[-1]["val_loss"]
+    result = {
+        "metric": "north star: reference val loss on-accel at >=10x torch-CPU tok/s",
+        "config": torch_ref["config"],
+        "corpus": CORPUS,
+        "steps": STEPS,
+        "platform": device.platform,
+        "device": str(device),
+        "precision": "f32, matmul precision=highest (parity with the torch-f32 oracle)",
+        "curve": curve,
+        "final_val_loss": {"jax": final_val, "torch_cpu": torch_ref["final_val_loss"]},
+        "reached_reference": final_val <= torch_ref["final_val_loss"] + 0.02,
+        "tokens_per_sec": {
+            "jax": round(jax_tps, 1),
+            "torch_cpu": torch_ref["tokens_per_sec"],
+        },
+        "speedup": round(jax_tps / torch_ref["tokens_per_sec"], 2),
+        "captured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00", time.gmtime()),
+    }
+    CAPTURE.parent.mkdir(parents=True, exist_ok=True)
+    _write_json(CAPTURE, result)
+    print(json.dumps({k: result[k] for k in (
+        "platform", "final_val_loss", "reached_reference", "speedup")}))
+    # The measurement is COMPLETE either way — the artifact records the
+    # verdict honestly.  Exit 0 so the queue's done-marker stops re-runs
+    # (a deterministic protocol would just reproduce the same result), and
+    # clear the exhausted checkpoint so a deliberate re-run starts fresh.
+    CKPT.unlink(missing_ok=True)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--phase", choices=["data", "torch", "jax"], default=None)
+    ap.add_argument(
+        "--allow-cpu", action="store_true",
+        help="let --phase jax run on host CPU (smoke testing only; the "
+        "committed capture then records platform=cpu and bench.py ignores it)",
+    )
+    args = ap.parse_args()
+    if args.phase == "data":
+        phase_data()
+        return 0
+    if args.phase == "torch":
+        phase_torch()
+        return 0
+    if args.phase == "jax":
+        return phase_jax(args.allow_cpu)
+    phase_torch()  # runs data implicitly; both cached
+    return phase_jax(args.allow_cpu)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

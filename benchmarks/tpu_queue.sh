@@ -84,6 +84,15 @@ run_job() {  # run_job <marker> <timeout_s> <outfile> <cmd...>
 # (a timeout-kill would orphan it and pause the rest of this very pass).
 run_job - 300 "$OUT/bench_headline.jsonl" env BENCH_DRIVER_FLAG=0 python bench.py
 
+# 1b. North-star convergence run (VERDICT r3 #2): TinyStories 4L at the real
+# config-1 shape trained ON THE CHIP to the precomputed torch-CPU reference
+# val loss.  Checkpoints every eval to /tmp/tpu_results/northstar_ckpt.pkl,
+# so a tunnel drop mid-run RESUMES on the next pass; exits 0 (-> done
+# marker) once the full measurement lands, whatever the verdict —
+# benchmarks/captures/northstar.json records it honestly either way.
+# ~200 steps of an 8M-param model: minutes of device time, run it early.
+run_job northstar 900 "$OUT/northstar.jsonl" python benchmarks/northstar.py --phase jax
+
 # 2. Compute-bound MFU on the real model sizes (VERDICT #2).
 run_job gpt2s 1200 "$OUT/bench_gpt2s.jsonl" \
   env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 python bench.py --config gpt2-small-32k
@@ -92,8 +101,10 @@ run_job ts12l 600 "$OUT/bench_12l.jsonl" \
 run_job tsmoe 600 "$OUT/bench_moe.jsonl" \
   env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 python bench.py --config tinystories-moe
 # Index-routed dispatch variant (same routing semantics; the dense one-hot
-# dispatch einsums cost ~2x the expert FFN at this shape).  Same capture
-# file: _save_capture keeps whichever formulation measures faster.
+# dispatch einsums cost ~2x the expert FFN at this shape).  Own capture
+# file (_gather suffix, ADVICE r3): each formulation keeps its own
+# best-of-N; bench_moe_dispatch.py below is the direct head-to-head, and
+# TINYSTORIES_MOE's default flips to gather only if the chip confirms it.
 run_job tsmoe_gather 600 "$OUT/bench_moe.jsonl" \
   env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 BENCH_MOE_DISPATCH=gather \
   python bench.py --config tinystories-moe
@@ -148,7 +159,7 @@ run_job gpt2s_blk512 1200 "$OUT/bench_gpt2s_blk512.jsonl" \
   python bench.py --config gpt2-small-32k
 
 # Pallas fused-SwiGLU FFN at the gpt2 shape (parity-tested; never timed
-# on chip).  Own capture semantics via the recorded ffn_impl field.
+# on chip).  Own capture file via the _ffnp suffix (ADVICE r3).
 run_job gpt2s_ffnp 1200 "$OUT/bench_gpt2s_ffnp.jsonl" \
   env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 BENCH_FFN_IMPL=pallas \
   python bench.py --config gpt2-small-32k
