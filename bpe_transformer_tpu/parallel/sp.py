@@ -36,7 +36,7 @@ from bpe_transformer_tpu.parallel.ring_attention import (
     zigzag_ring_flash_attention,
     zigzag_ring_self_attention,
 )
-from bpe_transformer_tpu.training.train_step import TrainHParams
+from bpe_transformer_tpu.training.train_step import TrainHParams, accumulate_grads
 
 P = PartitionSpec
 
@@ -104,6 +104,7 @@ def make_sp_train_step(
     data_axis: str = "data",
     seq_axis: str = "seq",
     zigzag: bool = False,
+    accum_steps: int = 1,
 ) -> Callable:
     """Train step over a 2-D (data x seq) mesh: batch split on ``data``,
     every sequence split on ``seq``; params/opt-state replicated.
@@ -114,7 +115,17 @@ def make_sp_train_step(
     feed batches through :func:`shard_sp_batch` with ``zigzag=True`` so the
     on-device layout matches, and note positions/loss are permutation-
     consistent (targets ride the same permutation as inputs).
+
+    ``accum_steps > 1``: gradient accumulation INSIDE the sharded program —
+    each chip scans its local microbatch shards (``lax.scan``, so peak
+    activation memory stays one microbatch even though sp exists precisely
+    because long-context activations are HBM-limited), and the grad/loss
+    ``pmean`` over (data, seq) runs ONCE per update, after accumulation.
+    Batches become ``(accum_steps, micro_batch, seq)``; feed them through
+    :func:`shard_sp_batch` with ``stacked=True``.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     n_seq = mesh.shape[seq_axis]
     if zigzag and config.ring_kv_chunk:
         raise ValueError(
@@ -129,7 +140,7 @@ def make_sp_train_step(
         raise ValueError(_FLASH_RING_KV_CHUNK_ERROR)
 
     def local_step(params, opt_state: AdamWState, x, y):
-        def loss_fn(p):
+        def loss_fn(p, x, y):
             # Memory-lean loss on the LOCAL sequence shard (already seq/N
             # long); lm_loss applies the shared clamp/divisibility guard.
             from bpe_transformer_tpu.models.transformer import (
@@ -162,8 +173,15 @@ def make_sp_train_step(
                 loss = loss + config.router_aux_weight * aux
             return loss
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        # Equal-size shards: the global mean is the mean of shard means.
+        grad_fn = jax.value_and_grad(loss_fn)
+        if accum_steps > 1:
+            loss, grads = accumulate_grads(
+                grad_fn, params, x, y, accum_steps, context="sp grad-accum step"
+            )
+        else:
+            loss, grads = grad_fn(params, x, y)
+        # Equal-size shards: the global mean is the mean of shard means —
+        # ONE collective per update, after any local accumulation.
         grads = jax.lax.pmean(grads, (data_axis, seq_axis))
         loss = jax.lax.pmean(loss, (data_axis, seq_axis))
 
@@ -187,7 +205,9 @@ def make_sp_train_step(
         }
         return params, opt_state, metrics
 
-    batch_spec = P(data_axis, seq_axis)
+    batch_spec = (
+        P(None, data_axis, seq_axis) if accum_steps > 1 else P(data_axis, seq_axis)
+    )
     mapped = jax.shard_map(
         local_step,
         mesh=mesh,
@@ -204,16 +224,21 @@ def shard_sp_batch(
     data_axis: str = "data",
     seq_axis: str = "seq",
     zigzag: bool = False,
+    stacked: bool = False,
 ):
     """Place ``(B, S)`` batch arrays split over (data, seq).
 
     ``zigzag=True`` permutes the sequence axis into the striped layout
     (shard ``i`` gets global chunks ``(i, 2n-1-i)``) before placement, for
-    :func:`make_sp_train_step`'s balanced schedule.
+    :func:`make_sp_train_step`'s balanced schedule.  ``stacked=True``
+    places ``(accum_steps, micro_batch, S)`` arrays with the leading dim
+    unsharded (the grad-accum layout; zigzag permutes the last axis either
+    way).
     """
     if zigzag:
         n = mesh.shape[seq_axis]
         perm = zigzag_indices(jax.tree_util.tree_leaves(batch)[0].shape[-1], n)
         batch = jax.tree_util.tree_map(lambda a: a[..., perm], batch)
-    sharding = NamedSharding(mesh, P(data_axis, seq_axis))
+    spec = P(None, data_axis, seq_axis) if stacked else P(data_axis, seq_axis)
+    sharding = NamedSharding(mesh, spec)
     return jax.device_put(batch, sharding)

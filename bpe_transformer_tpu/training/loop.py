@@ -69,10 +69,12 @@ class LoopConfig:
     #: Microbatches per optimizer update (gradient accumulation): each
     #: batch of ``batch_size`` is split into this many sequential
     #: microbatches, capping activation memory at one microbatch while the
-    #: update math is identical.  Works single-device and under dp/GSPMD
-    #: meshes (one collective per update, after local accumulation); not
-    #: with sp/pp.  Must divide batch_size (and the microbatch must divide
-    #: the data mesh axis); mutually exclusive with inner_steps > 1.
+    #: update math is identical.  Works single-device and under dp/sp/GSPMD
+    #: meshes (one collective per update, after local accumulation — under
+    #: sp that's the long-context HBM-relief combo); not with pp, which
+    #: already microbatches.  Must divide batch_size (and the microbatch
+    #: must divide the data mesh axis); mutually exclusive with
+    #: inner_steps > 1.
     grad_accum_steps: int = 1
     #: Overlap checkpoint serialization/IO with training: save() snapshots
     #: to host synchronously and writes in a background thread (at most one
@@ -244,10 +246,10 @@ def train(
 
     accum = loop.grad_accum_steps
     if accum > 1:
-        if loop.parallel in ("sp", "pp"):
+        if loop.parallel == "pp":
             raise NotImplementedError(
-                "grad_accum_steps > 1 is not supported with the sp/pp "
-                "schedules (pp already microbatches; sp shards the sequence)"
+                "grad_accum_steps > 1 is not supported with the pp schedule "
+                "(pp already microbatches; raise pp_microbatches instead)"
             )
         if stride > 1:
             raise ValueError(
@@ -307,11 +309,16 @@ def train(
         place, place_plain = _mesh_places()
     elif loop.parallel == "sp":
         step_fn = make_sp_train_step(
-            model_config, hparams, mesh, zigzag=loop.sp_zigzag
+            model_config, hparams, mesh, zigzag=loop.sp_zigzag,
+            accum_steps=accum,
         )
-        place = place_plain = lambda b: shard_sp_batch(
-            b, mesh, zigzag=loop.sp_zigzag
+        place = lambda b: shard_sp_batch(
+            b, mesh, zigzag=loop.sp_zigzag, stacked=accum > 1
         )
+        # place_plain's contract is "plain (B, S), global order, for eval":
+        # the dense eval forward must NEVER see the zigzag permutation
+        # (run_eval's sp branch also places without it).
+        place_plain = lambda b: shard_sp_batch(b, mesh)
     elif loop.parallel == "pp":
         from bpe_transformer_tpu.parallel.pp import make_pp_train_step
 

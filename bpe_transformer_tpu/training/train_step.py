@@ -120,6 +120,43 @@ def make_train_step(config: ModelConfig, hparams: TrainHParams) -> Callable:
     return jax.jit(train_step_fn(config, hparams), donate_argnums=(0, 1))
 
 
+def accumulate_grads(grad_fn, params, xs, ys, accum_steps: int, context: str = ""):
+    """Scan-accumulated ``(loss, grads)`` over a leading microbatch dim.
+
+    ``grad_fn(params, x, y) -> (loss, grads)`` runs once per microbatch
+    inside a ``lax.scan`` (peak activation memory = one microbatch);
+    gradients are summed in f32 and averaged, so the result equals a single
+    step on the concatenated batch (mean-of-means over equal-size
+    microbatches).  Shared by the single-device/dp/GSPMD accumulation body
+    (:func:`grad_accum_step_fn`) and the sp ring-attention step
+    (`parallel/sp.py`) so the subtle numerics live in exactly one place.
+    """
+    if xs.ndim != 3 or ys.ndim != 3 or xs.shape[0] != accum_steps:
+        raise ValueError(
+            f"{context or 'grad-accum step'} wants (accum_steps="
+            f"{accum_steps}, micro_batch, seq) token ids, got xs "
+            f"{xs.shape} — reshape the batch (training/loop.py does this "
+            "for CLI runs)"
+        )
+
+    def body(carry, batch):
+        loss_sum, grad_sum = carry
+        loss, grads = grad_fn(params, batch[0], batch[1])
+        grad_sum = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), grad_sum, grads
+        )
+        return (loss_sum + loss, grad_sum), None
+
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params
+    )
+    (loss_sum, grad_sum), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), zeros), (xs, ys)
+    )
+    inv = 1.0 / accum_steps
+    return loss_sum * inv, jax.tree_util.tree_map(lambda g: g * inv, grad_sum)
+
+
 def grad_accum_step_fn(
     config: ModelConfig,
     hparams: TrainHParams,
@@ -148,31 +185,9 @@ def grad_accum_step_fn(
     loss_fn = make_loss_fn(config)
 
     def step(params, opt_state: AdamWState, xs, ys):
-        if xs.ndim != 3 or ys.ndim != 3 or xs.shape[0] != accum_steps:
-            raise ValueError(
-                f"grad-accum step wants (accum_steps={accum_steps}, "
-                f"micro_batch, seq) token ids, got xs {xs.shape} — reshape "
-                "the batch (training/loop.py does this for CLI runs)"
-            )
-        grad_fn = jax.value_and_grad(loss_fn)
-
-        def body(carry, batch):
-            loss_sum, grad_sum = carry
-            loss, grads = grad_fn(params, batch[0], batch[1])
-            grad_sum = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(jnp.float32), grad_sum, grads
-            )
-            return (loss_sum + loss, grad_sum), None
-
-        zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        loss, grads = accumulate_grads(
+            jax.value_and_grad(loss_fn), params, xs, ys, accum_steps
         )
-        (loss_sum, grad_sum), _ = jax.lax.scan(
-            body, (jnp.zeros((), jnp.float32), zeros), (xs, ys)
-        )
-        inv = 1.0 / accum_steps
-        loss = loss_sum * inv
-        grads = jax.tree_util.tree_map(lambda g: g * inv, grad_sum)
         if reduce_axis is not None:
             grads = jax.lax.pmean(grads, reduce_axis)
             loss = jax.lax.pmean(loss, reduce_axis)

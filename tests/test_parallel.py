@@ -160,6 +160,38 @@ def test_sp_step_matches_single_device():
     )
 
 
+@pytest.mark.parametrize("zigzag", [False, True], ids=["ring", "zigzag"])
+def test_sp_grad_accum_matches_full_batch_step(zigzag):
+    """Gradient accumulation INSIDE the sp (ring attention) program: each
+    chip scans its local microbatch shards, one pmean over (data, seq) per
+    update, and the result equals the single-device full-batch update —
+    the long-context HBM-relief combo (VERDICT r3 #9)."""
+    from bpe_transformer_tpu.parallel import make_sp_train_step, shard_sp_batch
+
+    accum = 2
+    params, opt_state, x, y = _setup()
+    single = make_train_step(CFG, HP)
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    params2, opt_state2, x2, y2 = _setup()
+    micro = x2.shape[0] // accum
+    x2 = x2.reshape(accum, micro, -1)
+    y2 = y2.reshape(accum, micro, -1)
+    step = make_sp_train_step(CFG, HP, mesh, zigzag=zigzag, accum_steps=accum)
+    x2, y2 = shard_sp_batch((x2, y2), mesh, zigzag=zigzag, stacked=True)
+    p2, s2, m2 = step(params2, opt_state2, x2, y2)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        p1,
+        p2,
+    )
+
+
 def test_sp_forward_matches_full_forward():
     from bpe_transformer_tpu.parallel import sp_forward
     from functools import partial
