@@ -1,0 +1,132 @@
+"""The trained-weights test family, on a regenerated fixture.
+
+The reference pins trained-model behavior through its ``ts_state_dict``
+fixture (`/root/reference/tests/conftest.py:194-202`), but its input
+weights `ts_tests/model.pt` are a missing large blob — that family can
+never be replayed from this mount.  These tests run the same KINDS of
+checks against this repo's regenerated trained 3L/64d fixture
+(tools/make_trained_fixture.py; exact `model_config.json` shape):
+
+* trained weights round-trip through the torch-style state-dict schema and
+  reproduce pinned forward logits;
+* the same weights produce the same logits through the reference's adapter
+  seam (``run_transformer_lm``), i.e. the trained-weights family runs
+  through `compat/adapters.py` as the reference's `test_model.py` ran it;
+* a 5-step AdamW trajectory continuing from the trained state is pinned
+  (optimizer + schedule + clip on a REAL loss surface, not random init).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+FIXTURE = Path(__file__).parent / "fixtures" / "trained_3l64d.npz"
+
+
+@pytest.fixture(scope="module")
+def fixture_arrays():
+    if not FIXTURE.exists():
+        pytest.skip("trained fixture missing; run tools/make_trained_fixture.py")
+    with np.load(FIXTURE) as z:
+        return {k: z[k] for k in z.files}
+
+
+@pytest.fixture(scope="module")
+def state_dict(fixture_arrays):
+    return {
+        k: v for k, v in fixture_arrays.items() if not k.startswith("pin/")
+    }
+
+
+def test_trained_forward_matches_pinned_logits(fixture_arrays, state_dict):
+    import jax
+    import jax.numpy as jnp
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG
+    from bpe_transformer_tpu.models.transformer import forward, params_from_state_dict
+
+    params = params_from_state_dict(state_dict, TS_TEST_CONFIG.num_layers)
+    ids = jnp.asarray(fixture_arrays["pin/input_ids"])
+    logits = jax.jit(lambda p, t: forward(p, t, TS_TEST_CONFIG))(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(logits), fixture_arrays["pin/logits"], atol=1e-4, rtol=1e-4
+    )
+
+
+def test_trained_weights_through_adapter_seam(fixture_arrays, state_dict):
+    """The reference's trained-weights path: torch state dict in,
+    ``run_transformer_lm`` out (`/root/reference/tests/test_model.py:117-133`
+    ran exactly this against its lost model.pt)."""
+    import torch
+
+    from bpe_transformer_tpu.compat.adapters import run_transformer_lm
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG as C
+
+    weights = {k: torch.from_numpy(v.copy()) for k, v in state_dict.items()}
+    logits = run_transformer_lm(
+        vocab_size=C.vocab_size,
+        context_length=C.context_length,
+        d_model=C.d_model,
+        num_layers=C.num_layers,
+        num_heads=C.num_heads,
+        d_ff=C.d_ff,
+        rope_theta=C.rope_theta,
+        weights=weights,
+        in_indices=torch.from_numpy(fixture_arrays["pin/input_ids"].astype(np.int64)),
+    )
+    np.testing.assert_allclose(
+        logits.detach().cpu().numpy(),
+        fixture_arrays["pin/logits"],
+        atol=1e-4,
+        rtol=1e-4,
+    )
+
+
+def test_trained_adamw_trajectory_matches_pinned(fixture_arrays, state_dict):
+    """5 AdamW steps from the trained state on seeded batches reproduce the
+    pinned lm_head and loss curve — optimizer/schedule/clip pinned on a
+    real loss surface."""
+    import jax
+    import jax.numpy as jnp
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG as C
+    from bpe_transformer_tpu.models.transformer import params_from_state_dict
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.training.train_step import TrainHParams, make_train_step
+
+    tokens = np.load(
+        Path(__file__).parent.parent / "benchmarks" / "northstar_tokens.npz"
+    )["tokens"]
+    params = params_from_state_dict(state_dict, C.num_layers)
+    # The fixture generator's trajectory continues from the END-of-training
+    # optimizer state being reset here would diverge — regenerate both sides
+    # identically instead: the generator also starts its pinned trajectory
+    # from a FRESH adamw_init at the trained params (see
+    # tools/make_trained_fixture.py), so this is apples-to-apples.
+    opt_state = adamw_init(params)
+    step = make_train_step(C, TrainHParams())
+
+    rng = np.random.default_rng(2)
+    losses = []
+    for _ in range(5):
+        starts = rng.integers(0, len(tokens) - C.context_length - 1, size=32)
+        x = np.stack([tokens[s : s + C.context_length] for s in starts])
+        y = np.stack([tokens[s + 1 : s + C.context_length + 1] for s in starts])
+        params, opt_state, m = step(
+            params, opt_state, jnp.asarray(x.astype(np.int32)),
+            jnp.asarray(y.astype(np.int32)),
+        )
+        losses.append(float(m["loss"]))
+
+    np.testing.assert_allclose(
+        losses, fixture_arrays["pin/traj_losses"], atol=1e-4, rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(params["lm_head"]),
+        fixture_arrays["pin/traj_lm_head"],
+        atol=1e-4,
+        rtol=1e-4,
+    )
