@@ -1,0 +1,49 @@
+"""Certify the reference repo's own test files, run VERBATIM, in-suite.
+
+`tools/run_reference_suite.py` stages every reference test file, conftest,
+snapshot, and fixture byte-identical (symlinks into the read-only mount)
+and swaps exactly one file — `tests/adapters.py`, the suite's designed
+seam — for a re-export of `bpe_transformer_tpu.compat.adapters`.  This
+test runs that staged suite as a subprocess and asserts the strongest
+parity statement available: the reference's unmodified tests pass against
+this framework.
+
+Skipped tests inside the run are ONLY the missing-large-blob family
+(`/root/reference/tests/.MISSING_LARGE_BLOBS`), which the reference itself
+cannot run from this mount; tests/test_trained_fixture.py covers that
+family's test kinds on a regenerated fixture.
+"""
+
+from __future__ import annotations
+
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+RUNNER = REPO / "tools" / "run_reference_suite.py"
+REF_TESTS = Path("/root/reference/tests")
+
+
+@pytest.mark.skipif(not REF_TESTS.exists(), reason="reference mount absent")
+def test_reference_suite_passes_verbatim():
+    proc = subprocess.run(
+        [sys.executable, str(RUNNER)],
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    tail = "\n".join((proc.stdout + proc.stderr).splitlines()[-15:])
+    assert proc.returncode == 0, f"reference suite failed:\n{tail}"
+    summary = re.search(r"(\d+) passed(?:, (\d+) skipped)?", proc.stdout)
+    assert summary, f"no pytest summary found:\n{tail}"
+    passed = int(summary.group(1))
+    skipped = int(summary.group(2) or 0)  # a blob-restored mount has 0 skips
+    # 48 collected as of the r4 mount: 36 runnable (all must pass — rc==0
+    # already guarantees no failures) + 12 skipped missing-blob tests.  A
+    # future mount with the blobs restored would only move skips to passes.
+    assert passed >= 36, f"expected >=36 passing reference tests, got {passed}"
+    assert passed + skipped >= 48, f"collection shrank: {passed}+{skipped} < 48"
