@@ -16,7 +16,7 @@ reference-architecture step from ``bench.make_torch_lm`` (defined by
 
 Corpus: BASELINE config 1 names `tinystories_sample.txt`, but the mounted
 copy is 3.7 KB and the 5 MB sample is a missing blob
-(`/root/reference/tests/.MISSING_LARGE_BLOBS`); `corpus.en` (130 KB) is the
+(`/root/reference/.MISSING_LARGE_BLOBS`); `corpus.en` (130 KB) is the
 largest text the reference ships, so it is the corpus here — recorded in
 the artifact, as in val_parity.py.
 

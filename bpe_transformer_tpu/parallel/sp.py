@@ -36,7 +36,11 @@ from bpe_transformer_tpu.parallel.ring_attention import (
     zigzag_ring_flash_attention,
     zigzag_ring_self_attention,
 )
-from bpe_transformer_tpu.training.train_step import TrainHParams, accumulate_grads
+from bpe_transformer_tpu.training.train_step import (
+    TrainHParams,
+    accumulate_grads,
+    scanned_step_fn,
+)
 
 P = PartitionSpec
 
@@ -105,6 +109,7 @@ def make_sp_train_step(
     seq_axis: str = "seq",
     zigzag: bool = False,
     accum_steps: int = 1,
+    inner_steps: int = 1,
 ) -> Callable:
     """Train step over a 2-D (data x seq) mesh: batch split on ``data``,
     every sequence split on ``seq``; params/opt-state replicated.
@@ -123,9 +128,19 @@ def make_sp_train_step(
     ``pmean`` over (data, seq) runs ONCE per update, after accumulation.
     Batches become ``(accum_steps, micro_batch, seq)``; feed them through
     :func:`shard_sp_batch` with ``stacked=True``.
+
+    ``inner_steps > 1``: several FULL updates per dispatch (``lax.scan``
+    over the whole local update incl. its per-update pmean), amortizing
+    host launch latency exactly like the dp/GSPMD scanned steps; batches
+    are ``(inner_steps, batch, seq)``, also via ``stacked=True``.  Metrics
+    report the last inner update.  Mutually exclusive with accumulation.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if inner_steps < 1:
+        raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
+    if accum_steps > 1 and inner_steps > 1:
+        raise ValueError("grad_accum_steps and inner_steps cannot both exceed 1")
     n_seq = mesh.shape[seq_axis]
     if zigzag and config.ring_kv_chunk:
         raise ValueError(
@@ -205,8 +220,12 @@ def make_sp_train_step(
         }
         return params, opt_state, metrics
 
+    if inner_steps > 1:
+        local_step = scanned_step_fn(config, hparams, inner_steps, body=local_step)
+
+    stacked = accum_steps > 1 or inner_steps > 1
     batch_spec = (
-        P(None, data_axis, seq_axis) if accum_steps > 1 else P(data_axis, seq_axis)
+        P(None, data_axis, seq_axis) if stacked else P(data_axis, seq_axis)
     )
     mapped = jax.shard_map(
         local_step,

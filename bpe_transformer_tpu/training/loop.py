@@ -62,9 +62,10 @@ class LoopConfig:
     sp_zigzag: bool = False
     #: Optimizer updates per XLA dispatch (lax.scan over the update body).
     #: >1 amortizes host launch latency for small models — identical math.
-    #: Works single-device and under dp/GSPMD meshes (the scan compiles
-    #: inside the sharded program); not with sp/pp.  log/eval/checkpoint
-    #: cadences must be multiples.
+    #: Works single-device and under dp/sp/GSPMD meshes (the scan compiles
+    #: inside the sharded program); not with pp, which already amortizes
+    #: dispatch over its microbatches.  log/eval/checkpoint cadences must
+    #: be multiples.
     inner_steps: int = 1
     #: Microbatches per optimizer update (gradient accumulation): each
     #: batch of ``batch_size`` is split into this many sequential
@@ -229,10 +230,11 @@ def train(
 
     stride = loop.inner_steps
     if stride > 1:
-        if loop.parallel in ("sp", "pp"):
+        if loop.parallel == "pp":
             raise NotImplementedError(
-                "inner_steps > 1 is not supported with the sp/pp schedules; "
-                "use parallel=None/'dp' or a GSPMD strategy"
+                "inner_steps > 1 is not supported with the pp schedule (the "
+                "pipeline already amortizes dispatch over its microbatches); "
+                "use parallel=None/'dp'/'sp' or a GSPMD strategy"
             )
         for name, every in (
             ("log_every", loop.log_every),
@@ -308,17 +310,21 @@ def train(
         step_fn = build_step()
         place, place_plain = _mesh_places()
     elif loop.parallel == "sp":
-        step_fn = make_sp_train_step(
-            model_config, hparams, mesh, zigzag=loop.sp_zigzag,
-            accum_steps=accum,
-        )
+        def build_step(n=stride):
+            return make_sp_train_step(
+                model_config, hparams, mesh, zigzag=loop.sp_zigzag,
+                accum_steps=accum, inner_steps=n,
+            )
+
+        step_fn = build_step()
         place = lambda b: shard_sp_batch(
-            b, mesh, zigzag=loop.sp_zigzag, stacked=accum > 1
+            b, mesh, zigzag=loop.sp_zigzag, stacked=stacked_batches
         )
-        # place_plain's contract is "plain (B, S), global order, for eval":
-        # the dense eval forward must NEVER see the zigzag permutation
-        # (run_eval's sp branch also places without it).
-        place_plain = lambda b: shard_sp_batch(b, mesh)
+        # place_plain feeds build_step(1) at a 1-step inner tail, so it must
+        # carry the TRAINING layout (zigzag as configured, unstacked).  The
+        # dense eval forward never uses it for sp — run_eval's sp branch
+        # places its own batches in global order, without the permutation.
+        place_plain = lambda b: shard_sp_batch(b, mesh, zigzag=loop.sp_zigzag)
     elif loop.parallel == "pp":
         from bpe_transformer_tpu.parallel.pp import make_pp_train_step
 

@@ -233,6 +233,7 @@ def scanned_step_fn(
     hparams: TrainHParams,
     inner_steps: int,
     reduce_axis: str | None = None,
+    body: Callable | None = None,
 ) -> Callable:
     """Un-jitted body: ``inner_steps`` optimizer updates via ``lax.scan``.
 
@@ -242,7 +243,9 @@ def scanned_step_fn(
     over ``inner_steps`` real updates — identical math, one dispatch.
 
     ``reduce_axis`` threads through to each inner update's gradient pmean
-    (the shard_map dp path).
+    (the shard_map dp path).  ``body`` overrides the default single-update
+    body with a caller-built one (the sp ring-attention step passes its own
+    local update) so the scan/last-metrics plumbing lives in one place.
 
     Signature: ``(params, opt_state, xs, ys) -> (params, opt_state,
     metrics)`` where ``xs``/``ys`` carry a leading ``(inner_steps,)`` batch
@@ -251,7 +254,8 @@ def scanned_step_fn(
     """
     if inner_steps < 1:
         raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
-    body = train_step_fn(config, hparams, reduce_axis)
+    if body is None:
+        body = train_step_fn(config, hparams, reduce_axis)
 
     def multi(params, opt_state: AdamWState, xs, ys):
         def scan_body(carry, batch):

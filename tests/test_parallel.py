@@ -192,6 +192,36 @@ def test_sp_grad_accum_matches_full_batch_step(zigzag):
     )
 
 
+def test_sp_inner_steps_match_sequential_sp_steps():
+    """inner_steps under the sp mesh: one scanned dispatch of 3 full updates
+    (each with its own pmean) equals 3 sequential sp steps."""
+    from bpe_transformer_tpu.parallel import make_sp_train_step, shard_sp_batch
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    params, opt_state, x, y = _setup()
+    seq_step = make_sp_train_step(CFG, HP, mesh)
+    xp, yp = shard_sp_batch((x, y), mesh)
+    p1, s1 = params, opt_state
+    for _ in range(3):
+        p1, s1, m1 = seq_step(p1, s1, xp, yp)
+
+    params2, opt_state2, x2, y2 = _setup()
+    scan_step = make_sp_train_step(CFG, HP, mesh, inner_steps=3)
+    xs = jnp.broadcast_to(x2, (3, *x2.shape))
+    ys = jnp.broadcast_to(y2, (3, *y2.shape))
+    xs, ys = shard_sp_batch((xs, ys), mesh, stacked=True)
+    p2, s2, m2 = scan_step(params2, opt_state2, xs, ys)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        p1,
+        p2,
+    )
+
+
 def test_sp_forward_matches_full_forward():
     from bpe_transformer_tpu.parallel import sp_forward
     from functools import partial

@@ -9,7 +9,7 @@ parity statement available: the reference's unmodified tests pass against
 this framework.
 
 Skipped tests inside the run are ONLY the missing-large-blob family
-(`/root/reference/tests/.MISSING_LARGE_BLOBS`), which the reference itself
+(`/root/reference/.MISSING_LARGE_BLOBS`), which the reference itself
 cannot run from this mount; tests/test_trained_fixture.py covers that
 family's test kinds on a regenerated fixture.
 """

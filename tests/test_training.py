@@ -451,6 +451,32 @@ def test_loop_sp_grad_accum_trains_and_evals(tmp_path):
     assert np.isfinite(summary["final_val_loss"])
 
 
+def test_loop_sp_inner_steps_with_tail_trains(tmp_path):
+    """inner_steps under sp through the loop, with a 1-step TAIL (9 steps,
+    stride 4 -> scans of 4+4+1): the tail rebuilds the step via
+    build_step(1) and feeds it the unstacked TRAINING layout (zigzag as
+    configured) through place_plain, while eval still sees global order."""
+    from bpe_transformer_tpu.models.config import ModelConfig
+    from bpe_transformer_tpu.training.loop import LoopConfig, train
+    from bpe_transformer_tpu.training.train_step import TrainHParams
+
+    cfg = ModelConfig(vocab_size=128, context_length=32, d_model=32,
+                      num_layers=2, num_heads=2, d_ff=64)
+    data = np.tile(np.arange(cfg.vocab_size, dtype=np.int32), 100)
+    summary = train(
+        cfg,
+        TrainHParams(warmup_iters=2, cosine_cycle_iters=40),
+        LoopConfig(steps=9, batch_size=8, log_every=4, eval_every=1000,
+                   eval_batches=2, checkpoint_every=1000,
+                   parallel="sp", mesh_axes={"data": 2, "seq": 4},
+                   sp_zigzag=True, inner_steps=4),
+        train_data=data, val_data=data[:2000],
+        log_fn=lambda *_: None,
+    )
+    assert summary["history"][-1]["loss"] < summary["history"][0]["loss"]
+    assert np.isfinite(summary["final_val_loss"])
+
+
 def test_loop_grad_accum_on_mesh_trains(byte_data):
     """The training loop drives grad accumulation under a dp mesh (the
     r2 NotImplementedError is gone): microbatch scan inside the sharded

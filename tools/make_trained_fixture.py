@@ -3,7 +3,7 @@
 The reference's trained-weights test family (everything consuming its
 ``ts_state_dict`` fixture) is unrunnable from this mount: its input weights
 `tests/fixtures/ts_tests/model.pt` are a missing large blob
-(`/root/reference/tests/.MISSING_LARGE_BLOBS`), while the snapshot outputs
+(`/root/reference/.MISSING_LARGE_BLOBS`), while the snapshot outputs
 they produced remain.  Those snapshots can never be replayed without the
 original weights, so this script regenerates the equivalent artifact —
 a BRIEFLY TRAINED model at the exact `model_config.json` shape

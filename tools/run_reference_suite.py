@@ -16,7 +16,7 @@ the reference files:
     (`gpt2_vocab.json`, 50,257 entries) — same ids, same regex, same
     special token.
   * Tests whose fixtures are the repo's missing large blobs
-    (`/root/reference/tests/.MISSING_LARGE_BLOBS`: `ts_tests/model.pt`,
+    (`/root/reference/.MISSING_LARGE_BLOBS`: `ts_tests/model.pt`,
     `tinystories_sample_5M.txt`) are SKIPPED with an explicit reason —
     nobody, including the reference itself, can run those from this mount.
 
@@ -33,11 +33,11 @@ import os
 import shutil
 import subprocess
 import sys
+import tempfile
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 REF_TESTS = Path("/root/reference/tests")
-STAGE = Path("/tmp/refsuite")
 
 ADAPTERS_SHIM = '''\
 """The one swapped file: the reference suite's designed seam.
@@ -113,7 +113,7 @@ _5M_TESTS = {
 def pytest_collection_modifyitems(config, items):
     skip_blob = pytest.mark.skip(
         reason="fixture is a missing large blob (see "
-        "/root/reference/tests/.MISSING_LARGE_BLOBS); unrunnable from "
+        "/root/reference/.MISSING_LARGE_BLOBS); unrunnable from "
         "this mount by the reference itself"
     )
     for item in items:
@@ -125,11 +125,13 @@ def pytest_collection_modifyitems(config, items):
 
 
 def stage() -> Path:
-    if STAGE.exists():
-        shutil.rmtree(STAGE)
-    tests = STAGE / "tests"
+    """Build a fresh staging tree; a per-run tempdir, so concurrent
+    invocations (the in-suite certification test vs a manual run) can never
+    rmtree each other's tree mid-run."""
+    stage_root = Path(tempfile.mkdtemp(prefix="refsuite-"))
+    tests = stage_root / "tests"
     tests.mkdir(parents=True)
-    (STAGE / "conftest.py").write_text(OUTER_CONFTEST)
+    (stage_root / "conftest.py").write_text(OUTER_CONFTEST)
     for entry in REF_TESTS.iterdir():
         if entry.name == "adapters.py":
             continue  # the designed swap point
@@ -137,11 +139,11 @@ def stage() -> Path:
             continue
         (tests / entry.name).symlink_to(entry)
     (tests / "adapters.py").write_text(ADAPTERS_SHIM)
-    return tests
+    return stage_root
 
 
 def main() -> int:
-    stage()
+    stage_root = stage()
     cmd = [
         sys.executable,
         "-m",
@@ -160,8 +162,14 @@ def main() -> int:
     # the first jax-using test hangs forever), and a TPU has no role in
     # this parity run anyway.
     env["JAX_PLATFORMS"] = "cpu"
-    print(f"running reference suite: {' '.join(cmd)} (cwd={STAGE})", file=sys.stderr)
-    return subprocess.call(cmd, cwd=STAGE, env=env)
+    print(
+        f"running reference suite: {' '.join(cmd)} (cwd={stage_root})",
+        file=sys.stderr,
+    )
+    try:
+        return subprocess.call(cmd, cwd=stage_root, env=env)
+    finally:
+        shutil.rmtree(stage_root, ignore_errors=True)
 
 
 if __name__ == "__main__":
