@@ -1,0 +1,91 @@
+"""One-screen summary of every persisted TPU capture + queue artifact.
+
+Reads benchmarks/captures/*.json (bench.py per-config captures,
+northstar.json) and the attention/decode/breakdown/moe-dispatch JSONL
+files, and prints a compact table per group — what's measured, when, and
+at what knobs.  Pure host-side file reads: safe to run any time (no jax).
+
+    python benchmarks/summarize_captures.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+CAP = Path(__file__).resolve().parent / "captures"
+
+
+def _rows(path: Path):
+    try:
+        with path.open() as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        pass
+    except OSError:
+        return
+
+
+def main() -> int:
+    if not CAP.exists():
+        print("no captures directory", file=sys.stderr)
+        return 1
+
+    print("== bench.py captures (tokens/sec/chip) ==")
+    for p in sorted(CAP.glob("tpu_capture_*.json")):
+        try:
+            c = json.loads(p.read_text())
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"  {p.name}: unreadable ({exc!r})")
+            continue
+        knobs = [f"att={c.get('attention_impl', '?')}"]
+        for key in ("ffn_impl", "moe_dispatch"):
+            if c.get(key) not in (None, "xla", "einsum"):
+                knobs.append(f"{key}={c[key]}")
+        if c.get("remat"):
+            knobs.append("remat")
+        print(
+            f"  {p.name[12:-5]:28s} {c.get('value') or 0:>12,.0f} tok/s"
+            f"  mfu={c.get('mfu')}  vs_torch={c.get('vs_baseline')}"
+            f"  B={c.get('batch')} steps={c.get('measure_steps')}"
+            f"  @{c.get('captured_at_utc', '?')[:16]}  [{', '.join(knobs)}]"
+        )
+
+    ns = CAP / "northstar.json"
+    print("== north star ==")
+    if ns.exists():
+        try:
+            c = json.loads(ns.read_text())
+            print(
+                f"  platform={c.get('platform')}  "
+                f"val jax={c['final_val_loss']['jax']:.4f} vs "
+                f"torch={c['final_val_loss']['torch_cpu']:.4f}  "
+                f"reached={c.get('reached_reference')}  "
+                f"speedup={c.get('speedup')}x  @{c.get('captured_at_utc', '?')[:16]}"
+            )
+        except (OSError, json.JSONDecodeError, KeyError, TypeError) as exc:
+            print(f"  unreadable ({exc!r})")
+    else:
+        print("  (not yet captured — torch half lives in northstar_torch.json)")
+
+    for name, keys in (
+        ("attention.jsonl", ("metric", "speedup", "speedup_bwd")),
+        ("decode.jsonl", ("metric", "speedup")),
+        ("moe_dispatch.jsonl", ("metric", "speedup")),
+        ("breakdown.jsonl", ("stage", "ms", "config")),
+    ):
+        path = CAP / name
+        rows = list(_rows(path))
+        print(f"== {name} ({len(rows)} rows) ==")
+        for r in rows[-12:]:
+            print("  " + "  ".join(f"{k}={r.get(k)}" for k in keys if k in r))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
