@@ -68,8 +68,10 @@ def test_example_long_context_sp(tmp_path, sample):
     out = run_example(
         tmp_path, sample, "5_long_context_sp.py",
         "--steps", "6", "--context", "256", "--vocab-size", "300",
+        "--grad-accum", "2",  # the r4 combo: accumulation inside the ring
     )
     assert "long-context sp OK" in out
+    assert "2 scanned microbatches/update" in out
 
 
 @pytest.mark.slow
