@@ -177,5 +177,9 @@ run_job breakdown 1500 "$CAP/breakdown.jsonl" \
 # the per-stage device times prove or refute that quantitatively.
 run_job breakdown4l 600 "$CAP/breakdown.jsonl" \
   python benchmarks/bench_breakdown.py --config tinystories-4l
+# And the 12l (measured 32.3% MFU): per-stage rows show what the remaining
+# two-thirds goes to at the seq-512/xla-attention shape.
+run_job breakdown12l 600 "$CAP/breakdown.jsonl" \
+  python benchmarks/bench_breakdown.py --config tinystories-12l
 
 log "queue pass complete"
