@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -106,7 +107,22 @@ def main() -> int:
     from bpe_transformer_tpu.models.decode import generate_cached
 
     on_accel = jax.default_backend() != "cpu"
-    new_tokens = 128 if on_accel else 16
+    # BENCH_DECODE_NEW_TOKENS caps the generation length (the gpt2-scale
+    # cells timed out at 128: scan-program remote compile + 128 sequential
+    # uncached forwards).  NOTE the cached tok/s amortizes the fixed
+    # prefill over the generated tokens, so rows at different lengths are
+    # not directly comparable — every row records prompt=/new= for that.
+    raw_new = os.environ.get(
+        "BENCH_DECODE_NEW_TOKENS", "128" if on_accel else "16"
+    )
+    try:
+        new_tokens = int(raw_new)
+    except ValueError:
+        print(f"invalid BENCH_DECODE_NEW_TOKENS={raw_new!r}", file=sys.stderr)
+        return 2
+    if new_tokens <= 0:
+        print(f"BENCH_DECODE_NEW_TOKENS must be positive, got {raw_new}", file=sys.stderr)
+        return 2
     iters = 3 if on_accel else 1
 
     names = [args.config] if args.config else sorted(CONFIGS)
