@@ -50,8 +50,12 @@ from _accel import probe_platform as _accel_probe  # noqa: E402
 # Persistent compile cache (shared with tpu_queue.sh / __graft_entry__):
 # bench invocations are deadline-bounded and a cold TPU compile costs
 # 20-40 s per program — repeat runs must not re-pay it.  Set before any
-# jax import in this process.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_ccache")
+# jax import in this process.  Repo-local scratch, not /tmp: the cache
+# must survive container recycles between tunnel windows (VERDICT r4 #7).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    str(Path(__file__).resolve().parent / ".scratch" / "jax_ccache"),
+)
 
 T0 = time.monotonic()
 #: Config-dependent default deadline (GPT-2-scale torch-CPU baseline steps
@@ -127,7 +131,9 @@ def _capture_path() -> Path:
     if ARGS.flash_block not in (None, DEFAULT_FLASH_BLOCK):
         suffix += f"_blk{ARGS.flash_block}"
     if os.environ.get("BENCH_FFN_IMPL") not in (None, "", "xla"):
-        suffix += f"_ffn{os.environ['BENCH_FFN_IMPL'][:1]}"
+        # Full impl name, not an initial: two impls sharing a first letter
+        # must not collide into one capture file (ADVICE r4).
+        suffix += f"_ffn_{os.environ['BENCH_FFN_IMPL']}"
     if os.environ.get("BENCH_MOE_DISPATCH") not in (None, "", "einsum"):
         suffix += f"_{os.environ['BENCH_MOE_DISPATCH']}"
     if ARGS.attention not in (None, _default_accel_attention(ARGS.config)):
@@ -167,7 +173,11 @@ def _save_capture() -> None:
     # complete same-shape measurements, keep the FASTER one (best-of-N —
     # the capture records the framework's measured capability, and slower
     # runs are usually tunnel-noise on this relayed backend).
-    if prior.get("batch") == RESULT.get("batch") and (
+    # prior must have a real value to be worth keeping: a null-value capture
+    # (legacy/hand-edited) can never replay (both the replay guard and the
+    # queue's discard grep reject it), so keeping it over a fresh live
+    # measurement would permanently lose the offline fallback (review r5).
+    if prior.get("value") and prior.get("batch") == RESULT.get("batch") and (
         (prior.get("measure_steps") or 0) > (RESULT.get("measure_steps") or 0)
         or (
             (prior.get("measure_steps") or 0) == (RESULT.get("measure_steps") or 0)
@@ -185,6 +195,8 @@ def _save_capture() -> None:
         )
         # ...but don't discard a torch baseline this run measured that the
         # kept capture lacks: backfill it (same shape, stable across runs).
+        # The division below is safe: the keep-prior condition above already
+        # required prior["value"] truthy (ADVICE r4).
         if not prior.get("torch_cpu_tokens_per_sec") and RESULT.get(
             "torch_cpu_tokens_per_sec"
         ):

@@ -28,6 +28,23 @@ sys.path.insert(0, str(REPO))
 SAMPLE = Path("/root/reference/tests/fixtures/tinystories_sample.txt")
 
 
+def usable_cores() -> int:
+    """Cores this process can actually burn: CPU affinity intersected with
+    the cgroup-v2 quota (this container advertises many host CPUs but pins
+    the quota to 1 — `cpu_count()` alone would report a fantasy grid;
+    VERDICT r4 #7 / benchmarks/RESULTS.md host-tokenization caveat)."""
+    n = len(os.sched_getaffinity(0))
+    try:
+        quota_raw, period_raw = (
+            Path("/sys/fs/cgroup/cpu.max").read_text().split()
+        )
+        if quota_raw != "max":
+            n = min(n, max(1, int(int(quota_raw) / int(period_raw))))
+    except (OSError, ValueError):
+        pass
+    return max(n, 1)
+
+
 def build_corpus(mb: float, out: Path) -> Path:
     base = SAMPLE.read_text(encoding="utf-8")
     reps = max(1, int(mb * 1e6 / len(base.encode())))
@@ -47,7 +64,50 @@ def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--mb", type=float, default=20.0)
     parser.add_argument("--vocab", type=int, default=10_000)
+    parser.add_argument(
+        "--grid-if-multicore",
+        action="store_true",
+        help="armed-trap mode (VERDICT r4 #7): exit immediately with no "
+        "rows unless >1 core is actually usable; otherwise capture the "
+        "2/4/8-worker scaling grid the parallel-scaling claim needs",
+    )
+    parser.add_argument(
+        "--covered-file",
+        type=Path,
+        default=None,
+        help="with --grid-if-multicore: also exit without rows when this "
+        "JSONL already records a grid captured at >= the current core "
+        "count (so the trap disarms once covered but RE-fires if the "
+        "container later grows more cores)",
+    )
     args = parser.parse_args()
+
+    if args.grid_if_multicore:
+        cores_now = usable_cores()
+        if cores_now <= 1:
+            print(
+                f"single usable core ({cores_now}); multi-worker grid "
+                "still environment-blocked — trap stays armed",
+                file=sys.stderr,
+            )
+            return 0
+        if args.covered_file is not None and args.covered_file.exists():
+            covered = 0
+            for line in args.covered_file.read_text().splitlines():
+                try:
+                    row = json.loads(line)
+                    if isinstance(row, dict):  # torn fragments can parse as
+                        # bare scalars; .get on those would AttributeError
+                        covered = max(covered, int(row.get("usable_cores") or 0))
+                except (json.JSONDecodeError, TypeError, ValueError):
+                    continue
+            if covered >= cores_now:
+                print(
+                    f"grid already captured at {covered} cores "
+                    f"(now {cores_now}); trap disarmed",
+                    file=sys.stderr,
+                )
+                return 0
 
     from multiprocessing import cpu_count
 
@@ -81,10 +141,13 @@ def main() -> int:
     n_pretokens = None
     # count_pretokens clamps workers to the host CPU count; bench the
     # EFFECTIVE counts so no row is mislabeled (this container may expose
-    # a single core, collapsing the grid).
-    count_grid = sorted({min(w, cpu_count()) for w in (1, 4, cpu_count())})
+    # a single core, collapsing the grid).  `usable_cores()` (affinity ∧
+    # cgroup quota), not cpu_count(): advertised host CPUs that the quota
+    # never schedules would label fantasy rows.
+    cores = usable_cores()
+    worker_grid = sorted({min(w, cores) for w in (1, 2, 4, 8, cores)})
     for engine in (["python", "native"] if is_available() else ["python"]):
-        for workers in count_grid:
+        for workers in worker_grid:
             t_count, counts = timed(
                 lambda e=engine, w=workers: count_pretokens(
                     corpus, specials, training=True, n_workers=w,
@@ -135,7 +198,7 @@ def main() -> int:
 
     t_enc_py, _ = timed(lambda: encode_stream(tok_py))
     n_tokens = None
-    for workers in sorted({1, 4, cpu_count()}):
+    for workers in worker_grid:
         t_enc, n_tokens = timed(lambda w=workers: encode_stream(tok, workers=w))
         report(
             "encode_stream",
@@ -152,6 +215,10 @@ def main() -> int:
                 "tokens": n_tokens,
                 "pretokens": n_pretokens,
                 "cpu_count": cpu_count(),
+                "usable_cores": cores,
+                "captured_at_utc": time.strftime(
+                    "%Y-%m-%dT%H:%M:%S+00:00", time.gmtime()
+                ),
             }
         )
     )

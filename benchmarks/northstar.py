@@ -26,9 +26,10 @@ Phases (so a short tunnel window only pays for the accelerator part):
   --phase torch   the torch-CPU reference run; writes
                   benchmarks/northstar_torch.json (curve, final val loss,
                   tokens/sec).  Runs offline, no accelerator needed.
-  --phase jax     the accelerator run.  Checkpoints every eval to
-                  /tmp/tpu_results/northstar_ckpt.pkl so a tunnel drop
-                  RESUMES instead of restarting; on completion writes
+  --phase jax     the accelerator run.  Checkpoints every eval to the
+                  repo-local gitignored scratch (.scratch/northstar_ckpt.pkl,
+                  NORTHSTAR_CKPT overrides) so a tunnel drop OR a container
+                  recycle RESUMES instead of restarting; on completion writes
                   benchmarks/captures/northstar.json with both final val
                   losses, both tokens/sec, and the speedup.
   (default)       data + torch if their artifacts are missing, then jax.
@@ -55,7 +56,10 @@ import numpy as np
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_ccache")
+# Recycle-safe compile cache, same default as bench.py / tpu_queue.sh.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", str(REPO / ".scratch" / "jax_ccache")
+)
 
 from _accel import require_accelerator  # noqa: E402  (benchmarks/_accel.py)
 
@@ -73,7 +77,19 @@ CORPUS = "/root/reference/tests/fixtures/corpus.en"
 TOKENS_NPZ = REPO / "benchmarks" / "northstar_tokens.npz"
 TORCH_JSON = REPO / "benchmarks" / "northstar_torch.json"
 CAPTURE = REPO / "benchmarks" / "captures" / "northstar.json"
-CKPT = Path("/tmp/tpu_results/northstar_ckpt.pkl")
+#: Resume checkpoint lives in the repo's gitignored scratch, not /tmp: a
+#: container recycle between tunnel windows must not discard mid-run
+#: progress (VERDICT r4 weak #7).  Legacy /tmp checkpoints are migrated in
+#: phase_jax so an in-flight resume survives this path change.
+CKPT = Path(
+    os.environ.get("NORTHSTAR_CKPT", str(REPO / ".scratch" / "northstar_ckpt.pkl"))
+)
+LEGACY_CKPT = Path("/tmp/tpu_results/northstar_ckpt.pkl")
+#: Val-loss slack for the reached_reference verdict: two independent f32
+#: trajectories (torch-CPU vs TPU at matmul precision=highest) drift a few
+#: centinats over 200 steps; recorded in the artifact so the claim is
+#: self-describing (ADVICE r4).
+VAL_TOLERANCE = 0.02
 
 
 def _write_json(path: Path, payload: dict) -> None:
@@ -238,6 +254,12 @@ def phase_jax(allow_cpu: bool) -> int:
         step = make_train_step(cfg, TrainHParams())
         ev = make_eval_step(cfg)
 
+        if not CKPT.exists() and LEGACY_CKPT.exists():
+            import shutil  # move, not rename: /tmp and the repo can be
+                           # different filesystems (rename would EXDEV)
+            CKPT.parent.mkdir(parents=True, exist_ok=True)
+            shutil.move(str(LEGACY_CKPT), str(CKPT))
+            print(f"migrated legacy checkpoint {LEGACY_CKPT} -> {CKPT}", file=sys.stderr)
         if CKPT.exists():
             payload = load_checkpoint(CKPT)
             ckpt_platform = payload["extra"].get("platform")
@@ -312,7 +334,9 @@ def phase_jax(allow_cpu: bool) -> int:
         "precision": "f32, matmul precision=highest (parity with the torch-f32 oracle)",
         "curve": curve,
         "final_val_loss": {"jax": final_val, "torch_cpu": torch_ref["final_val_loss"]},
-        "reached_reference": final_val <= torch_ref["final_val_loss"] + 0.02,
+        "reached_reference": final_val <= torch_ref["final_val_loss"] + VAL_TOLERANCE,
+        "reference_tolerance": VAL_TOLERANCE,
+        "val_loss_delta_vs_torch": round(final_val - torch_ref["final_val_loss"], 4),
         "tokens_per_sec": {
             "jax": round(jax_tps, 1),
             "torch_cpu": torch_ref["tokens_per_sec"],

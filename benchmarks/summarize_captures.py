@@ -53,7 +53,9 @@ def main() -> int:
             f"  {p.name[12:-5]:28s} {c.get('value') or 0:>12,.0f} tok/s"
             f"  mfu={c.get('mfu')}  vs_torch={c.get('vs_baseline')}"
             f"  B={c.get('batch')} steps={c.get('measure_steps')}"
-            f"  @{c.get('captured_at_utc', '?')[:16]}  [{', '.join(knobs)}]"
+            # `or '?'` not a .get default: the key can be present with a JSON
+            # null (ADVICE r4), and None[:16] would kill the whole summary.
+            f"  @{(c.get('captured_at_utc') or '?')[:16]}  [{', '.join(knobs)}]"
         )
 
     ns = CAP / "northstar.json"
@@ -66,7 +68,7 @@ def main() -> int:
                 f"val jax={c['final_val_loss']['jax']:.4f} vs "
                 f"torch={c['final_val_loss']['torch_cpu']:.4f}  "
                 f"reached={c.get('reached_reference')}  "
-                f"speedup={c.get('speedup')}x  @{c.get('captured_at_utc', '?')[:16]}"
+                f"speedup={c.get('speedup')}x  @{(c.get('captured_at_utc') or '?')[:16]}"
             )
         except (OSError, json.JSONDecodeError, KeyError, TypeError) as exc:
             print(f"  unreadable ({exc!r})")
@@ -78,11 +80,30 @@ def main() -> int:
         ("decode.jsonl", ("metric", "speedup")),
         ("moe_dispatch.jsonl", ("metric", "speedup")),
         ("breakdown.jsonl", ("stage", "ms", "config")),
+        (
+            "host_tokenization.jsonl",
+            (
+                "stage",
+                "engine",
+                "n_workers",
+                "pretokens_per_s",
+                "tokens_per_s",
+                "speedup",
+                # The trailing summary row carries the grid's provenance —
+                # whether these are real multicore rows or a collapsed
+                # single-core grid.
+                "usable_cores",
+                "captured_at_utc",
+            ),
+        ),
     ):
         path = CAP / name
         rows = list(_rows(path))
         print(f"== {name} ({len(rows)} rows) ==")
-        for r in rows[-12:]:
+        # 20, not 12: a full multicore host-tokenization grid is 14+ rows
+        # and truncating it would cut the python-engine rows the
+        # native-vs-python comparison needs (review r5).
+        for r in rows[-20:]:
             print("  " + "  ".join(f"{k}={r.get(k)}" for k in keys if k in r))
     return 0
 

@@ -1,0 +1,71 @@
+#!/bin/bash
+# TPU recovery watcher (repo-resident since round 5; earlier rounds kept it
+# in /tmp, which a container recycle would silently erase — VERDICT r4 #7).
+#
+# Probes the accelerator every 60 s; the moment a window opens, runs the
+# benchmark queue (benchmarks/tpu_queue.sh, idempotent + flock-guarded).
+# Separately, once an hour, re-checks whether this container has grown a
+# second usable CPU core and captures the multi-worker host-tokenization
+# grid the moment it does (VERDICT r4 #7 — no TPU needed for that one).
+#
+# Re-arm after any recycle with:
+#   nohup bash /root/repo/benchmarks/tpu_watch.sh >/dev/null 2>&1 &
+# Single-flight: a second invocation exits immediately (flock on the repo
+# scratch, which survives recycles).
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG=/tmp/tpu_watch5.log
+mkdir -p "$REPO/.scratch"
+exec 8> "$REPO/.scratch/watch.lock"
+flock -n 8 || exit 0
+last_core_check=0
+while true; do
+  # JAX_PLATFORMS=axon is exported by the container boot; when the tunnel is
+  # down the first jax call hangs in the connect-retry loop, hence timeout.
+  if timeout 90 python -c "import jax; d=jax.devices()[0]; assert 'TPU' in str(d)" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) tpu up, running queue" >> "$LOG"
+    # 8>&-: children must not inherit the watch lock — a queue pass (or a
+    # 20-min northstar job inside it) outliving a killed watcher would
+    # silently block re-arming (review r5).
+    bash "$REPO/benchmarks/tpu_queue.sh" 8>&- >> "$LOG" 2>&1
+    echo "$(date -u +%FT%TZ) queue pass done" >> "$LOG"
+    # Short re-probe gap: windows have measured ~25-40 min and a completed
+    # pass leaves only the always-rerun headline; a long sleep here could
+    # waste the tail of the same window a new job list might use.
+    sleep 120
+  else
+    echo "$(date -u +%FT%TZ) tpu down" >> "$LOG"
+    sleep 60
+  fi
+  now=$(date +%s)
+  HOSTTOK="$REPO/benchmarks/captures/host_tokenization.jsonl"
+  if [ $((now - last_core_check)) -ge 3600 ]; then
+    last_core_check=$now
+    # Backgrounded subshell: the grid bench can run ~15 min and the probe
+    # loop must keep watching for tunnel windows meanwhile (review r5).
+    # Dedicated hosttok lock (manual bench invocations and a previous
+    # still-running trap can race this); NOT queue.lock — a CPU-only bench
+    # must never serialize against TPU work.  Disarm/duplicate logic lives
+    # in the bench itself (--covered-file): it skips when single-core AND
+    # when a grid at >= the current core count is already recorded, so the
+    # trap re-fires if the container later grows more cores.
+    (
+      mkdir -p /tmp/tpu_results
+      exec 7> /tmp/tpu_results/hosttok.lock
+      flock -n 7 || exit 0
+      # Buffer-then-promote (as tpu_queue.sh's run_job): a timeout-kill must
+      # not leave partial/torn rows in the committed evidence file.  Failed
+      # attempts keep their partial output in the scratch mirror.
+      t=$(mktemp)
+      if JAX_PLATFORMS=cpu timeout 900 python \
+          "$REPO/benchmarks/bench_tokenization.py" --grid-if-multicore \
+          --covered-file "$HOSTTOK" 8>&- > "$t" 2>> "$LOG" && [ -s "$t" ]; then
+        cat "$t" >> "$HOSTTOK"
+        echo "$(date -u +%FT%TZ) multicore trap fired: host tokenization grid captured" >> "$LOG"
+      elif [ -s "$t" ]; then
+        cat "$t" >> "$REPO/.scratch/hosttok_failed.jsonl"
+      fi
+      rm -f "$t"
+    ) 8>&- &
+  fi
+done
