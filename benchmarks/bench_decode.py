@@ -123,6 +123,18 @@ def main() -> int:
     if new_tokens <= 0:
         print(f"BENCH_DECODE_NEW_TOKENS must be positive, got {raw_new}", file=sys.stderr)
         return 2
+    # BENCH_DECODE_ATTN=pallas times the flash-decoding kernel
+    # (kernels/pallas/decode_attention.py) on the cached path; rows carry a
+    # dec= tag so the two formulations land as distinct evidence.
+    decode_attn = os.environ.get("BENCH_DECODE_ATTN", "xla")
+    if decode_attn not in ("xla", "pallas"):
+        print(f"invalid BENCH_DECODE_ATTN={decode_attn!r}", file=sys.stderr)
+        return 2
+    # BENCH_DECODE_SKIP_UNCACHED=1: variant cells (e.g. the pallas rows)
+    # only need the cached timing — re-running the minutes-long uncached
+    # baseline the base cell already measured would burn tunnel-window time
+    # and renew its timeout risk.
+    skip_uncached = os.environ.get("BENCH_DECODE_SKIP_UNCACHED") == "1"
     iters = 3 if on_accel else 1
 
     names = [args.config] if args.config else sorted(CONFIGS)
@@ -134,7 +146,9 @@ def main() -> int:
         # uncached baseline — same dtype both sides, so the comparison stays
         # algorithmic).
         config = dataclasses.replace(
-            getattr(models, CONFIGS[name]), attention_impl="xla"
+            getattr(models, CONFIGS[name]),
+            attention_impl="xla",
+            decode_attention_impl=decode_attn,
         )
         params = init_params(jax.random.PRNGKey(0), config)
         rng = np.random.default_rng(0)
@@ -153,14 +167,17 @@ def main() -> int:
                 iters=iters,
                 label=f"cached {name} B={batch}",
             )
-            uncached_step = make_uncached_step(params, config)
-            t_uncached = _time(
-                lambda: _uncached_generate(
-                    uncached_step, config, prompt, key, new_tokens
-                ),
-                iters=iters,
-                label=f"uncached {name} B={batch}",
-            )
+            if skip_uncached:
+                t_uncached = None
+            else:
+                uncached_step = make_uncached_step(params, config)
+                t_uncached = _time(
+                    lambda: _uncached_generate(
+                        uncached_step, config, prompt, key, new_tokens
+                    ),
+                    iters=iters,
+                    label=f"uncached {name} B={batch}",
+                )
 
             if t_cached or t_uncached:
                 measured_any = True
@@ -173,7 +190,8 @@ def main() -> int:
                     {
                         "metric": f"decode_tokens_per_sec ({name}, B={batch}, "
                         f"prompt={PROMPT_LEN}, new={new_tokens}, "
-                        f"{config.activation_dtype})",
+                        f"{config.activation_dtype})"
+                        + (f" dec={decode_attn}" if decode_attn != "xla" else ""),
                         "kv_cached_tok_per_s": tps(t_cached),
                         "uncached_tok_per_s": tps(t_uncached),
                         "speedup": (

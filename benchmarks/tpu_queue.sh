@@ -197,6 +197,17 @@ for cfg in tinystories-4l gpt2-small-32k; do
       python benchmarks/bench_decode.py --config "$cfg" --batch "$b"
   done
 done
+# Flash-decoding Pallas kernel head-to-head at the bandwidth-boundest cell
+# (gpt2-small B=1; VERDICT r4 #6).  Parity is CPU-pinned in
+# tests/test_kernels.py; this row is its first device timing.
+# SKIP_UNCACHED: the base dec_* cells above already time the uncached
+# baseline; these rows exist for the pallas-cached number only.
+run_job dec_pallas_gpt2s_1 1200 "$CAP/decode.jsonl" \
+  env BENCH_DECODE_NEW_TOKENS=64 BENCH_DECODE_ATTN=pallas BENCH_DECODE_SKIP_UNCACHED=1 \
+  python benchmarks/bench_decode.py --config gpt2-small-32k --batch 1
+run_job dec_pallas_ts4l_1 600 "$CAP/decode.jsonl" \
+  env BENCH_DECODE_NEW_TOKENS=128 BENCH_DECODE_ATTN=pallas BENCH_DECODE_SKIP_UNCACHED=1 \
+  python benchmarks/bench_decode.py --config tinystories-4l --batch 1
 
 # 6. Tuning variants: deeper dispatch amortization for the small model and
 # a bigger batch for gpt2-small (own capture file; may OOM -> discarded).

@@ -1,5 +1,6 @@
 """Pallas (Mosaic) TPU kernels for the hot ops."""
 
+from bpe_transformer_tpu.kernels.pallas.decode_attention import decode_attention
 from bpe_transformer_tpu.kernels.pallas.flash_attention import (
     flash_attention,
     flash_attention_with_rope,
@@ -7,6 +8,7 @@ from bpe_transformer_tpu.kernels.pallas.flash_attention import (
 from bpe_transformer_tpu.kernels.pallas.gelu import gelu, gelu_reference
 
 __all__ = [
+    "decode_attention",
     "flash_attention",
     "flash_attention_with_rope",
     "gelu",

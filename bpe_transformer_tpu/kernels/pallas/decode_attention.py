@@ -1,0 +1,223 @@
+"""Single-query (decode-step) attention against the KV cache as a Pallas
+kernel.
+
+The decode hot loop attends ONE new token per sequence against the whole
+cached context (`models/decode.py:decode_step`) — a capability the
+reference never implements (its contract stops at training logits,
+`/root/reference/tests/adapters.py:282-361`).  Per token the XLA path
+materializes a (B, KV, G, 1, ctx) score tensor, runs a separate f32
+softmax pass, then a second contraction — three HBM round trips over
+score-sized intermediates for what is fundamentally a bandwidth-bound
+streaming reduction over the cache.  This kernel is the flash-decoding
+formulation: the cache is streamed block-by-block through VMEM exactly
+once, scores never leave VMEM, and the online-softmax accumulator
+(`kernels/pallas/flash_attention.py`'s, specialized to a single query
+position) produces the normalized output in the same pass.
+
+Shapes (GQA-native — queries arrive grouped per KV head so the kernel
+reads the COMPACT cache, preserving decode's GQA bandwidth win):
+
+* ``q``        (batch, num_heads, d_head)     — the one new token's queries,
+                                                RoPE already applied
+* ``k_cache``  (batch, kv_heads, ctx, d_head) — written positions <= pos
+* ``v_cache``  (batch, kv_heads, ctx, d_head)
+* ``pos``      scalar int32 (traced)          — attend to cache[0..pos]
+* returns      (batch, num_heads, d_head)
+
+Grid ``(batch*kv_heads, ctx/block_k)``, key axis innermost; ``pos`` rides
+scalar prefetch (SMEM) so the causal frontier is a traced value — the
+generation loop's ``lax.scan`` carries it — while the program stays a
+single compilation.  Key blocks entirely beyond ``pos`` are predicated
+off (their DMAs still run; at decode's cache sizes the tail blocks are a
+minority of traffic and the predication keeps the kernel a single static
+grid).
+
+The kernel is forward-only by design: decoding is inference.  Training
+gradients flow through the training attention paths (flash/ring), never
+through this one.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bpe_transformer_tpu.ops.core import MASK_VALUE as NEG_INF
+
+LANES = 128
+SUBLANES = 8
+
+
+def _decode_kernel(
+    pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, block_k: int, num_k_blocks: int,
+):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    pos = pos_ref[0]
+    # Blocks whose first key index is beyond the causal frontier contribute
+    # nothing (pos >= 0 always leaves block 0 live, so l > 0 at finalize).
+    @pl.when(j * block_k <= pos)
+    def _block():
+        q = q_ref[0].astype(jnp.float32) * scale  # (G_pad, d)
+        k = k_ref[0].astype(jnp.float32)          # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (G_pad, block_k)
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_k
+        s = jnp.where(cols <= pos, s, NEG_INF)
+
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        # eps guards the division only; padded (zero) query rows score 0
+        # everywhere visible and emit a harmless uniform average of v —
+        # the caller's out[:, :group] slice discards them.
+        denom = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    pos: jax.Array | int,
+    *,
+    block_k: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """One decode step of attention: ``softmax(q k^T / sqrt(d)) v`` over
+    cache positions ``<= pos``, streamed blockwise (see module docstring).
+
+    ``interpret=None`` resolves via ``runtime.interpret_mode()`` (compiled
+    Mosaic on TPU, interpreter elsewhere), like the sibling kernels.
+    """
+    if interpret is None:
+        from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
+
+        interpret = interpret_mode()
+    batch, num_heads, d = q.shape
+    b2, kv_heads, ctx, d2 = k_cache.shape
+    if (b2, d2) != (batch, d) or v_cache.shape != k_cache.shape:
+        raise ValueError(
+            f"shape mismatch: q {q.shape}, k_cache {k_cache.shape}, "
+            f"v_cache {v_cache.shape}"
+        )
+    if num_heads % kv_heads:
+        raise ValueError(
+            f"num_heads={num_heads} not divisible by kv_heads={kv_heads}"
+        )
+    group = num_heads // kv_heads
+    # Shrink block_k (sublane-aligned) to a divisor of ctx when one exists,
+    # so the no-copy fast path below covers every aligned context — e.g.
+    # ctx=384 runs at block 128 instead of padding to 512.  Contexts with
+    # no multiple-of-8 divisor (ragged test shapes) take the explicit
+    # padded fallback with a sublane-aligned block.
+    bk = min(block_k, ctx) - (min(block_k, ctx) % SUBLANES)
+    while bk >= SUBLANES and ctx % bk:
+        bk -= SUBLANES
+    if bk >= SUBLANES:
+        block_k = bk
+    else:
+        block_k = min(block_k, pl.cdiv(ctx, SUBLANES) * SUBLANES)
+
+    # The CACHE is never copied: its head dim passes through the BlockSpec
+    # at the true width (XLA's TPU layout already lane-pads the minor dim
+    # physically, so block reads at d < 128 move the same tiles) and the
+    # context axis is blocked in place.  A per-step jnp.pad of the whole
+    # cache would materialize a padded HBM copy of every layer's cache on
+    # every generated token — timing the copy, not the kernel (review r5).
+    # Only the per-step operands are padded: the one-token query tile
+    # (rows to the sublane width — padded G rows normalize against the eps
+    # denominator and are sliced off) and, for ragged standalone contexts
+    # only, the cache's trailing partial block (decode.py caches are always
+    # context_length, a multiple of any shipped block_k).
+    g_pad = pl.cdiv(group, SUBLANES) * SUBLANES
+    ctx_pad = pl.cdiv(ctx, block_k) * block_k
+    nk = ctx_pad // block_k
+    bkv = batch * kv_heads
+
+    qg = q.reshape(batch, kv_heads, group, d).reshape(bkv, group, d)
+    qg = jnp.pad(qg, ((0, 0), (0, g_pad - group), (0, 0)))
+    prep = lambda c: (
+        c.reshape(bkv, ctx, d)
+        if ctx_pad == ctx
+        else jnp.pad(c.reshape(bkv, ctx, d), ((0, 0), (0, ctx_pad - ctx), (0, 0)))
+    )
+    kp, vp = prep(k_cache), prep(v_cache)
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+
+    kernel = functools.partial(
+        _decode_kernel,
+        scale=1.0 / (d**0.5),  # true head dim, not the lane-padded one
+        block_k=block_k,
+        num_k_blocks=nk,
+    )
+    # Scalar-prefetch index maps receive the scalar ref as a trailing arg.
+    qspec = pl.BlockSpec(
+        (1, g_pad, d), lambda b, j, p: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    kvspec = pl.BlockSpec(
+        (1, block_k, d), lambda b, j, p: (b, j, 0), memory_space=pltpu.VMEM
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bkv, nk),
+        in_specs=[qspec, kvspec, kvspec],
+        out_specs=qspec,
+        scratch_shapes=[
+            pltpu.VMEM((g_pad, d), jnp.float32),      # output accumulator
+            pltpu.VMEM((g_pad, LANES), jnp.float32),  # running row max
+            pltpu.VMEM((g_pad, LANES), jnp.float32),  # running denominator
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bkv, g_pad, d), q.dtype),
+        interpret=interpret,
+    )(pos_arr, qg, kp, vp)
+    return out[:, :group, :].reshape(batch, num_heads, d)
+
+
+def xla_decode_attention(q, k_cache, v_cache, pos):
+    """Materialized-scores formulation: the grouped einsum straight against
+    the compact GQA cache (the per-token hot path reads only
+    ``kv_heads * ctx`` values — no head expansion), f32 scores + softmax.
+    This IS `models/decode.py:decode_step`'s xla attention (that path calls
+    here — single implementation) and the kernel's parity oracle.
+    """
+    batch, num_heads, d = q.shape
+    kv_heads, ctx = k_cache.shape[1], k_cache.shape[2]
+    qg = q.reshape(batch, kv_heads, num_heads // kv_heads, 1, d)
+    # f32 scale promotes the scores out of bf16 before masking/softmax,
+    # matching the kernel's f32 score accumulation.
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    scores = jnp.einsum("bkgqd,bkcd->bkgqc", qg, k_cache) * scale
+    visible = jnp.arange(ctx) <= pos
+    scores = jnp.where(visible[None, None, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    att = jnp.einsum("bkgqc,bkcd->bkgqd", probs, v_cache)
+    return att.reshape(batch, num_heads, d)

@@ -57,6 +57,11 @@ class ModelConfig:
     attention_impl: str = "xla"
     # "xla" | "pallas" (fused SwiGLU kernel; swiglu FFNs only)
     ffn_impl: str = "xla"
+    #: Decode-step attention against the KV cache: "xla" (grouped einsum,
+    #: materialized scores) | "pallas" (flash-decoding streamed reduction,
+    #: kernels/pallas/decode_attention.py).  Inference-only knob — the
+    #: training attention path is attention_impl.
+    decode_attention_impl: str = "xla"
     flash_block_size: int = 256  # q/k tile size for the flash kernel
     #: attention_impl="flash_fused" auto-falls-back to the plain flash
     #: kernel (RoPE outside) below this sequence length: the in-kernel RoPE
@@ -99,6 +104,11 @@ class ModelConfig:
         if self.moe_dispatch not in ("einsum", "gather"):
             raise ValueError(
                 f'moe_dispatch={self.moe_dispatch!r} must be "einsum" or "gather"'
+            )
+        if self.decode_attention_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"decode_attention_impl={self.decode_attention_impl!r} "
+                'must be "xla" or "pallas"'
             )
         if self.ffn_type == "moe" and not (
             1 <= self.router_top_k <= self.n_experts

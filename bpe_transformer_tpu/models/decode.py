@@ -197,9 +197,6 @@ def decode_step(
     """
     x = embedding(params["token_embeddings"], token[:, None])  # (B, 1, d)
     positions = pos[None]  # (1,)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(config.d_head, jnp.float32))
-    # Attend only to filled positions <= pos.
-    visible = jnp.arange(config.context_length) <= pos  # (ctx,)
 
     new_cache = []
     for block_params, layer_cache in zip(params["layers"], cache):
@@ -210,20 +207,28 @@ def decode_step(
             k_cache = lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, pos, 0))
             v_cache = lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, pos, 0))
             new_cache.append({"k": k_cache, "v": v_cache})
-            # Grouped contraction straight against the compact cache: the
-            # per-token hot path must READ only num_kv_heads * ctx bytes —
-            # repeating the cache up to num_heads here would forfeit GQA's
-            # decode-bandwidth win.
-            b, n_h, _, dh = q.shape
-            kv_heads = k_cache.shape[1]
-            qg = q.reshape(b, kv_heads, n_h // kv_heads, 1, dh)
-            scores = jnp.einsum("bkgqd,bkcd->bkgqc", qg, k_cache) * scale
-            scores = jnp.where(visible[None, None, None, None, :], scores, -jnp.inf)
-            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
-                h.dtype
-            )
-            att = jnp.einsum("bkgqc,bkcd->bkgqd", probs, v_cache)
-            att = merge_heads(att.reshape(b, n_h, 1, dh))
+            # Both impls read the COMPACT GQA cache — the per-token hot path
+            # reads only num_kv_heads * ctx bytes; expanding heads here
+            # would forfeit GQA's decode-bandwidth win.
+            if config.decode_attention_impl == "pallas":
+                # Flash-decoding kernel: the cache streams through VMEM
+                # once, scores never reach HBM
+                # (kernels/pallas/decode_attention.py; parity pinned by
+                # tests/test_kernels.py + tests/test_decode.py).
+                from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+                    decode_attention,
+                )
+
+                att = decode_attention(q[:, :, 0], k_cache, v_cache, pos)
+            else:
+                # Materialized grouped einsum — the same single
+                # implementation the kernel parity tests pin against.
+                from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+                    xla_decode_attention,
+                )
+
+                att = xla_decode_attention(q[:, :, 0], k_cache, v_cache, pos)
+            att = merge_heads(att[:, :, None, :])
             return linear(att, block_params["attn"]["output_proj"])
 
         x = _block_apply(x, block_params, config, attend)

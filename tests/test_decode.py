@@ -329,3 +329,54 @@ def test_generate_ids_bf16_uses_cached_fast_path(monkeypatch):
     out = generate_ids(params, cfg, [1, 2, 3], max_new_tokens=6, temperature=0.5)
     assert calls, "bf16 config took the slow sliding-window path"
     assert len(out) == 6 and all(0 <= t < cfg.vocab_size for t in out)
+
+
+def test_pallas_decode_attention_impl_matches_xla(setup):
+    """decode_attention_impl="pallas" (flash-decoding kernel) reproduces the
+    grouped-einsum decode path: same greedy tokens end-to-end and matching
+    step logits (kernel parity itself is pinned in tests/test_kernels.py)."""
+    params, ids = setup
+    cfg_pallas = dataclasses.replace(CFG, decode_attention_impl="pallas")
+
+    full = forward(params, ids, CFG)
+    cache = init_kv_cache(CFG, ids.shape[0])
+    logits, cache = prefill(params, ids[:, :4], cfg_pallas, cache)
+    for p in range(4, ids.shape[1]):
+        logits, cache = decode_step(
+            params, ids[:, p], jnp.asarray(p), cache, cfg_pallas
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, p]), atol=1e-4,
+            err_msg=f"position {p}",
+        )
+
+    prompt = ids[:, :5]
+    a = generate_cached(
+        params, prompt, jax.random.PRNGKey(0), config=CFG,
+        max_new_tokens=8, temperature=0.0,
+    )
+    b = generate_cached(
+        params, prompt, jax.random.PRNGKey(0), config=cfg_pallas,
+        max_new_tokens=8, temperature=0.0,
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pallas_decode_attention_impl_gqa():
+    """The kernel path reads the COMPACT GQA cache (no head expansion):
+    per-step logits match the full forward on a grouped-query config."""
+    gqa = dataclasses.replace(
+        CFG, num_kv_heads=2, decode_attention_impl="pallas"
+    )
+    params = init_params(jax.random.PRNGKey(1), gqa)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, gqa.vocab_size, size=(2, 10)), jnp.int32)
+    full = forward(params, ids, gqa)
+    cache = init_kv_cache(gqa, ids.shape[0])
+    logits, cache = prefill(params, ids[:, :3], gqa, cache)
+    for p in range(3, ids.shape[1]):
+        logits, cache = decode_step(params, ids[:, p], jnp.asarray(p), cache, gqa)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, p]), atol=1e-4,
+            err_msg=f"position {p}",
+        )

@@ -424,3 +424,97 @@ def test_model_fused_swiglu_matches_xla_impl():
     a = forward(params, ids, cfg_xla)
     b = forward(params, ids, cfg_pallas)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+# ------------------------------------------------------ decode attention
+
+
+@pytest.mark.parametrize(
+    "batch,heads,kv_heads,ctx,d,pos",
+    [
+        (2, 4, 4, 128, 64, 100),   # MHA
+        (2, 8, 2, 256, 64, 0),     # GQA, frontier at the first position
+        (1, 4, 1, 200, 48, 199),   # MQA, ragged ctx + odd head dim, full cache
+        (3, 6, 3, 512, 64, 17),    # frontier inside the first block
+    ],
+)
+def test_decode_attention_matches_xla(batch, heads, kv_heads, ctx, d, pos):
+    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+        decode_attention,
+        xla_decode_attention,
+    )
+
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((batch, heads, d)).astype(np.float32))
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((batch, kv_heads, ctx, d)).astype(np.float32)
+    )
+    k, v = mk(), mk()
+    out = decode_attention(q, k, v, pos, interpret=True)
+    ref = xla_decode_attention(q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_decode_attention_traced_pos_single_compile():
+    """pos rides scalar prefetch: one jitted program serves every frontier
+    (the generation loop's lax.scan carries pos as a traced value)."""
+    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+        decode_attention,
+        xla_decode_attention,
+    )
+
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((2, 8, 64)).astype(np.float32))
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((2, 4, 256, 64)).astype(np.float32)
+    )
+    k, v = mk(), mk()
+    f = jax.jit(lambda q, k, v, p: decode_attention(q, k, v, p, interpret=True))
+    for pos in (0, 100, 255):
+        np.testing.assert_allclose(
+            np.asarray(f(q, k, v, jnp.int32(pos))),
+            np.asarray(xla_decode_attention(q, k, v, pos)),
+            atol=2e-5,
+            err_msg=f"pos {pos}",
+        )
+
+
+def test_decode_attention_bf16():
+    """bf16 cache/queries (the decode perf path): f32 accumulation inside,
+    output close to the f32 oracle at bf16 tolerance."""
+    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+        decode_attention,
+        xla_decode_attention,
+    )
+
+    rng = np.random.default_rng(4)
+    q32 = rng.standard_normal((2, 4, 64)).astype(np.float32)
+    k32 = rng.standard_normal((2, 4, 128, 64)).astype(np.float32)
+    v32 = rng.standard_normal((2, 4, 128, 64)).astype(np.float32)
+    out = decode_attention(
+        jnp.asarray(q32, jnp.bfloat16),
+        jnp.asarray(k32, jnp.bfloat16),
+        jnp.asarray(v32, jnp.bfloat16),
+        64,
+        interpret=True,
+    )
+    assert out.dtype == jnp.bfloat16
+    ref = xla_decode_attention(
+        jnp.asarray(q32), jnp.asarray(k32), jnp.asarray(v32), 64
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=3e-2
+    )
+
+
+def test_decode_attention_rejects_bad_shapes():
+    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+        decode_attention,
+    )
+
+    q = jnp.zeros((2, 5, 64))
+    kv = jnp.zeros((2, 2, 128, 64))
+    with pytest.raises(ValueError, match="not divisible"):
+        decode_attention(q, kv, kv, 0, interpret=True)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        decode_attention(jnp.zeros((2, 4, 32)), kv, kv, 0, interpret=True)
