@@ -241,6 +241,8 @@ def make_pp_train_step(
     num_microbatches: int = 4,
     pp_axis: str = "pp",
     dp_axis: str = "data",
+    accum_steps: int = 1,
+    inner_steps: int = 1,
 ) -> Callable:
     """Jitted pipeline(+data)-parallel step over ``mesh``.
 
@@ -249,15 +251,46 @@ def make_pp_train_step(
     (placed with :func:`shard_pp_params`) and ``opt_state`` from
     :func:`jax.eval_shape`-compatible :func:`~bpe_transformer_tpu.optim.
     adamw.adamw_init` over it.
+
+    ``accum_steps > 1``: gradient accumulation around the pipeline — each
+    accumulation slice runs the FULL GPipe schedule (all
+    ``num_microbatches`` ticks), gradients sum in f32 via the shared
+    :func:`~bpe_transformer_tpu.training.train_step.accumulate_grads`
+    (same numerics as the dp/sp/GSPMD paths) and the optimizer updates
+    once.  This stacks a second, memory-motivated microbatching level on
+    top of the pipeline's own (which exists to fill the bubble, not to
+    shrink activations): peak activation memory is one accum slice's
+    pipeline.  Batches become ``(accum_steps, batch, seq)`` — feed through
+    ``shard_batch(..., stacked=True)``.
+
+    ``inner_steps > 1``: several FULL updates per dispatch (``lax.scan``
+    over the whole update body inside the pipelined program, via the
+    shared :func:`~bpe_transformer_tpu.training.train_step.
+    scanned_step_fn`); batches ``(inner_steps, batch, seq)``, also
+    ``stacked=True``.  Metrics report the last update.
     """
     if pp_axis not in mesh.shape:
         raise ValueError(f"mesh {dict(mesh.shape)} lacks axis {pp_axis!r}")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if inner_steps < 1:
+        raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
+    if accum_steps > 1 and inner_steps > 1:
+        raise ValueError("accum_steps and inner_steps cannot both exceed 1")
     pp_size = mesh.shape[pp_axis]
     use_dp = dp_axis in mesh.shape and mesh.shape[dp_axis] > 1
     loss_fn = _pp_loss_fn(config, num_microbatches, pp_axis, pp_size)
 
     def step(pp_params, opt_state: AdamWState, x, y):
-        local_loss, grads = jax.value_and_grad(loss_fn)(pp_params, x, y)
+        if accum_steps > 1:
+            from bpe_transformer_tpu.training.train_step import accumulate_grads
+
+            local_loss, grads = accumulate_grads(
+                jax.value_and_grad(loss_fn), pp_params, x, y, accum_steps,
+                context="pp grad-accum step",
+            )
+        else:
+            local_loss, grads = jax.value_and_grad(loss_fn)(pp_params, x, y)
         loss = lax.psum(local_loss, pp_axis)  # loss lives on the last rank
         # Shared params saw real gradients on one rank only (embed on rank 0,
         # head/final-norm on the last): psum over pp makes them global.
@@ -301,9 +334,18 @@ def make_pp_train_step(
         metrics = {"loss": loss, "lr": lr, "grad_norm": global_norm}
         return pp_params_new, opt_state, metrics
 
+    if inner_steps > 1:
+        from bpe_transformer_tpu.training.train_step import scanned_step_fn
+
+        step = scanned_step_fn(config, hparams, inner_steps, body=step)
+
     param_specs = {"stages": P(pp_axis), "shared": P()}
     opt_specs = AdamWState(step=P(), m=param_specs, v=param_specs)
-    batch_spec = P(dp_axis) if use_dp else P()
+    stacked = accum_steps > 1 or inner_steps > 1
+    if use_dp:
+        batch_spec = P(None, dp_axis) if stacked else P(dp_axis)
+    else:
+        batch_spec = P()
     metric_specs = {"loss": P(), "lr": P(), "grad_norm": P()}
 
     mapped = jax.shard_map(

@@ -140,7 +140,7 @@ def make_sp_train_step(
     if inner_steps < 1:
         raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
     if accum_steps > 1 and inner_steps > 1:
-        raise ValueError("grad_accum_steps and inner_steps cannot both exceed 1")
+        raise ValueError("accum_steps and inner_steps cannot both exceed 1")
     n_seq = mesh.shape[seq_axis]
     if zigzag and config.ring_kv_chunk:
         raise ValueError(

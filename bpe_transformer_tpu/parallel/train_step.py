@@ -49,7 +49,7 @@ def _multi_step_body(
     accumulation/scan mode, and whether batches carry a leading stacked dim
     (``(accum|inner, micro_batch, seq)`` instead of ``(batch, seq)``)."""
     if accum_steps > 1 and inner_steps > 1:
-        raise ValueError("grad_accum_steps and inner_steps cannot both exceed 1")
+        raise ValueError("accum_steps and inner_steps cannot both exceed 1")
     if accum_steps > 1:
         return grad_accum_step_fn(config, hparams, accum_steps, reduce_axis), True
     if inner_steps > 1:

@@ -230,12 +230,6 @@ def train(
 
     stride = loop.inner_steps
     if stride > 1:
-        if loop.parallel == "pp":
-            raise NotImplementedError(
-                "inner_steps > 1 is not supported with the pp schedule (the "
-                "pipeline already amortizes dispatch over its microbatches); "
-                "use parallel=None/'dp'/'sp' or a GSPMD strategy"
-            )
         for name, every in (
             ("log_every", loop.log_every),
             ("eval_every", loop.eval_every),
@@ -248,11 +242,6 @@ def train(
 
     accum = loop.grad_accum_steps
     if accum > 1:
-        if loop.parallel == "pp":
-            raise NotImplementedError(
-                "grad_accum_steps > 1 is not supported with the pp schedule "
-                "(pp already microbatches; raise pp_microbatches instead)"
-            )
         if stride > 1:
             raise ValueError(
                 "grad_accum_steps and inner_steps cannot both exceed 1"
@@ -328,10 +317,15 @@ def train(
     elif loop.parallel == "pp":
         from bpe_transformer_tpu.parallel.pp import make_pp_train_step
 
-        step_fn = make_pp_train_step(
-            model_config, hparams, mesh, num_microbatches=loop.pp_microbatches
-        )
-        place = place_plain = lambda b: shard_batch(b, mesh)
+        def build_step(n=stride):
+            return make_pp_train_step(
+                model_config, hparams, mesh,
+                num_microbatches=loop.pp_microbatches,
+                accum_steps=accum, inner_steps=n,
+            )
+
+        step_fn = build_step()
+        place, place_plain = _mesh_places()
     else:
         def build_step(n=stride):
             return make_gspmd_train_step(

@@ -292,6 +292,111 @@ def test_pp_step_matches_single_device():
     )
 
 
+def test_pp_grad_accum_matches_full_batch_step():
+    """Gradient accumulation AROUND the pipeline: each accumulation slice
+    runs the full GPipe schedule, gradients sum in f32 through the shared
+    accumulate_grads, and one update equals the single-device full-batch
+    step (closes the last pp NotImplementedError; VERDICT r4 minor)."""
+    from bpe_transformer_tpu.parallel.pp import (
+        init_pp_opt_state,
+        make_pp_train_step,
+        shard_pp_params,
+        stack_pipeline_params,
+        unstack_pipeline_params,
+    )
+
+    accum = 2
+    cfg = dataclasses.replace(CFG, num_layers=4)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    opt_state = adamw_init(params)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(16, cfg.context_length)))
+    y = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(16, cfg.context_length)))
+
+    single = make_train_step(cfg, HP)
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 2, "pp": 4})
+    params2 = init_params(jax.random.PRNGKey(0), cfg)
+    pp_params = shard_pp_params(stack_pipeline_params(params2, 4), mesh)
+    pp_opt = init_pp_opt_state(pp_params, mesh)
+    step = make_pp_train_step(
+        cfg, HP, mesh, num_microbatches=2, accum_steps=accum
+    )
+    micro = x.shape[0] // accum
+    xs = x.reshape(accum, micro, -1)
+    ys = y.reshape(accum, micro, -1)
+    xs, ys = shard_batch((xs, ys), mesh, stacked=True)
+    p2, s2, m2 = step(pp_params, pp_opt, xs, ys)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(m1["grad_norm"]), float(m2["grad_norm"]), rtol=1e-4
+    )
+    restored = unstack_pipeline_params(jax.device_get(p2))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        ),
+        p1,
+        restored,
+    )
+
+
+def test_pp_inner_steps_match_sequential_pp_steps():
+    """inner_steps under pp: one scanned dispatch of 3 full pipelined
+    updates equals 3 sequential pp steps."""
+    from bpe_transformer_tpu.parallel.pp import (
+        init_pp_opt_state,
+        make_pp_train_step,
+        shard_pp_params,
+        stack_pipeline_params,
+    )
+
+    cfg = dataclasses.replace(CFG, num_layers=4)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(8, cfg.context_length)))
+    y = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(8, cfg.context_length)))
+    mesh = make_mesh({"data": 2, "pp": 4})
+
+    def fresh():
+        params = init_params(jax.random.PRNGKey(2), cfg)
+        pp_params = shard_pp_params(stack_pipeline_params(params, 4), mesh)
+        return pp_params, init_pp_opt_state(pp_params, mesh)
+
+    seq_step = make_pp_train_step(cfg, HP, mesh, num_microbatches=2)
+    p1, s1 = fresh()
+    xp, yp = shard_batch((x, y), mesh)
+    for _ in range(3):
+        p1, s1, m1 = seq_step(p1, s1, xp, yp)
+
+    scan_step = make_pp_train_step(
+        cfg, HP, mesh, num_microbatches=2, inner_steps=3
+    )
+    p2, s2 = fresh()
+    xs = jnp.broadcast_to(x, (3, *x.shape))
+    ys = jnp.broadcast_to(y, (3, *y.shape))
+    xs, ys = shard_batch((xs, ys), mesh, stacked=True)
+    p2, s2, m2 = scan_step(p2, s2, xs, ys)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        jax.device_get(p1),
+        jax.device_get(p2),
+    )
+
+
+def test_pp_accum_and_inner_both_raise():
+    from bpe_transformer_tpu.parallel.pp import make_pp_train_step
+
+    mesh = make_mesh({"data": 2, "pp": 4})
+    with pytest.raises(ValueError, match="cannot both exceed 1"):
+        make_pp_train_step(CFG, HP, mesh, accum_steps=2, inner_steps=2)
+
+
 def test_pp_stack_unstack_roundtrip():
     from bpe_transformer_tpu.parallel.pp import (
         stack_pipeline_params,

@@ -515,3 +515,52 @@ def test_loop_inner_steps_on_fsdp_mesh_trains(byte_data):
     hist = summary["history"]
     assert hist[-1]["loss"] < hist[0]["loss"]
     assert hist[-1]["step"] == 18
+
+
+def test_loop_pp_grad_accum_trains_and_evals(byte_data, tmp_path):
+    """The training loop drives grad accumulation around the pipeline —
+    the last pp NotImplementedError is gone: each accumulation slice runs
+    the full GPipe schedule, eval still on plain batches via the dense
+    forward (VERDICT r4 minor)."""
+    loop = LoopConfig(
+        steps=8,
+        batch_size=16,
+        log_every=4,
+        eval_every=8,
+        eval_batches=2,
+        checkpoint_every=1000,
+        parallel="pp",
+        mesh_axes={"data": 4, "pp": 2},
+        pp_microbatches=2,
+        grad_accum_steps=2,  # micro=8 divides data axis (4)
+    )
+    summary = train(
+        TINY, HP, loop, byte_data, val_data=byte_data,
+        log_fn=lambda *_: None,
+    )
+    assert summary["history"][-1]["loss"] < summary["history"][0]["loss"]
+    assert np.isfinite(summary["final_val_loss"])
+
+
+def test_loop_pp_inner_steps_with_tail_trains(byte_data, tmp_path):
+    """inner_steps under pp through the loop, with a 1-step TAIL (9 steps,
+    stride 4 -> scans of 4+4+1): the tail rebuilds via build_step(1) and
+    feeds the unstacked layout through place_plain."""
+    loop = LoopConfig(
+        steps=9,
+        batch_size=16,
+        log_every=4,
+        eval_every=1000,
+        eval_batches=2,
+        checkpoint_every=1000,
+        parallel="pp",
+        mesh_axes={"data": 4, "pp": 2},
+        pp_microbatches=2,
+        inner_steps=4,
+    )
+    summary = train(
+        TINY, HP, loop, byte_data, val_data=byte_data,
+        log_fn=lambda *_: None,
+    )
+    assert summary["history"][-1]["loss"] < summary["history"][0]["loss"]
+    assert np.isfinite(summary["final_val_loss"])
