@@ -477,6 +477,18 @@ def flash_attention(
     return _flash_impl(q, k, v, causal, block_q, block_k, interpret)
 
 
+def flash_attention_for_config(q, k, v, config, *, causal: bool = True) -> jax.Array:
+    """Config-driven plain-flash dispatch: block size from
+    ``config.flash_block_size``, interpret mode from the backend.  The ONE
+    call shared by the training attention (`models/transformer.py`), the
+    decode prefill (`models/decode.py`), and future sites — so the call
+    signature and interpret-mode policy can't drift between copies."""
+    from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
+
+    block = config.flash_block_size
+    return flash_attention(q, k, v, causal, block, block, interpret_mode())
+
+
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _flash_impl(
         q, k, v, causal, block_q, block_k, interpret, return_lse=True

@@ -146,8 +146,15 @@ def prefill(
     batch, plen = token_ids.shape
     positions = jnp.arange(plen)
     x = embedding(params["token_embeddings"], token_ids)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(config.d_head, jnp.float32))
-    mask = jnp.tril(jnp.ones((plen, plen), bool))
+    # Long prompts honor the config's flash kernel: the materialized path
+    # needs an O(plen^2) score buffer per layer, which is exactly the
+    # memory wall the training side removes with flash attention.  RoPE is
+    # already applied outside (decode owns per-position tables), so both
+    # "flash" and "flash_fused" map to the plain flash kernel here.
+    use_flash = config.attention_impl in ("flash", "flash_fused")
+    if not use_flash:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(config.d_head, jnp.float32))
+        mask = jnp.tril(jnp.ones((plen, plen), bool))
 
     new_cache = []
     for block_params, layer_cache in zip(params["layers"], cache):
@@ -162,6 +169,13 @@ def prefill(
                 }
             )
             k, v = _expand_kv(k, config), _expand_kv(v, config)
+            if use_flash:
+                from bpe_transformer_tpu.kernels.pallas.flash_attention import (
+                    flash_attention_for_config,
+                )
+
+                att = merge_heads(flash_attention_for_config(q, k, v, config))
+                return linear(att, block_params["attn"]["output_proj"])
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
             scores = jnp.where(mask, scores, -jnp.inf)
             probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
@@ -246,7 +260,9 @@ def _sample_from_logits(
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k is not None:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        # lax.top_k is O(V log k) vs a full O(V log V) sort for one
+        # threshold — this runs once per generated token inside the scan.
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None:
         # Nucleus sampling: keep the smallest prob-descending prefix whose

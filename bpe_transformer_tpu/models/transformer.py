@@ -150,17 +150,13 @@ def _attention(
 ) -> Array:
     if attention_fn is None and config.attention_impl == "flash":
         from bpe_transformer_tpu.kernels.pallas.flash_attention import (
-            flash_attention,
+            flash_attention_for_config,
         )
-        from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
 
-        block = config.flash_block_size
-        attention_fn = lambda q, k, v: flash_attention(
-            q, k, v, True, block, block, interpret_mode()
-        )
+        attention_fn = lambda q, k, v: flash_attention_for_config(q, k, v, config)
     elif attention_fn is None and config.attention_impl == "flash_fused":
         from bpe_transformer_tpu.kernels.pallas.flash_attention import (
-            flash_attention,
+            flash_attention_for_config,
             flash_attention_with_rope,
         )
         from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
@@ -180,8 +176,8 @@ def _attention(
             # Below the measured crossover the in-kernel RoPE recompute
             # costs more than it saves: dispatch the plain flash kernel
             # with RoPE applied outside (identical numerics).
-            attention_fn = lambda q, k, v: flash_attention(
-                q, k, v, True, block, block, interpret_mode()
+            attention_fn = lambda q, k, v: flash_attention_for_config(
+                q, k, v, config
             )
         else:
             # RoPE moves inside the kernel: gather the tables at the true

@@ -87,3 +87,11 @@ def test_example_moe_expert_parallel(tmp_path, sample):
 def test_example_grad_accum_fsdp(tmp_path, sample):
     out = run_example(tmp_path, sample, "7_grad_accum_fsdp.py")
     assert "matches the single-device full-batch update" in out
+
+
+def test_example_kv_cache_decode(tmp_path, sample):
+    out = run_example(
+        tmp_path, sample, "8_kv_cache_decode.py", "--new-tokens", "8"
+    )
+    assert "decode demo OK" in out
+    assert "GQA" in out
