@@ -212,9 +212,11 @@ run_job dec_pallas_ts4l_1 600 "$CAP/decode.jsonl" \
 # 6. Tuning variants: deeper dispatch amortization for the small model and
 # a bigger batch for gpt2-small (own capture file; may OOM -> discarded).
 # _save_capture keeps the fastest same-shape capture, so these can only
-# improve the replayed headline.
-run_job inner40 300 "$OUT/bench_inner40.jsonl" \
-  env BENCH_INNER_STEPS=40 BENCH_NO_CPU_FALLBACK=1 python bench.py
+# improve the replayed headline.  inner=100 = ONE dispatch for the whole
+# 100-step measure: the pure device-rate ceiling (the default is now 40,
+# so this probes what latency remains beyond it).
+run_job inner100 300 "$OUT/bench_inner100.jsonl" \
+  env BENCH_INNER_STEPS=100 BENCH_NO_CPU_FALLBACK=1 python bench.py
 # Remat fallback only when B=64 doesn't fit un-rematerialized; once the
 # fallback has succeeded, later passes skip the known-OOMing first attempt.
 if [ ! -e "$OUT/done_gpt2s64r" ]; then
