@@ -23,6 +23,7 @@ from bpe_transformer_tpu.parallel.ring_attention import (
     make_ring_attention,
     ring_self_attention,
 )
+from bpe_transformer_tpu.parallel.ulysses import ulysses_attention
 from bpe_transformer_tpu.parallel.sp import (
     make_sp_train_step,
     shard_sp_batch,
@@ -46,6 +47,7 @@ __all__ = [
     "ring_self_attention",
     "shard_sp_batch",
     "sp_forward",
+    "ulysses_attention",
     "initialize_distributed",
     "make_dp_train_step",
     "make_gspmd_train_step",

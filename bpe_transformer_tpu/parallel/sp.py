@@ -36,6 +36,7 @@ from bpe_transformer_tpu.parallel.ring_attention import (
     zigzag_ring_flash_attention,
     zigzag_ring_self_attention,
 )
+from bpe_transformer_tpu.parallel.ulysses import ulysses_attention
 from bpe_transformer_tpu.training.train_step import (
     TrainHParams,
     accumulate_grads,
@@ -56,27 +57,37 @@ def sp_forward(
     local_token_ids: jax.Array,
     config: ModelConfig,
     seq_axis: str,
+    ulysses: bool = False,
 ) -> jax.Array:
     """Forward over a local sequence shard; call INSIDE shard_map.
 
     Positions are global (shard offset + local index) so RoPE sees the true
-    token positions; attention is the exact ring schedule over ``seq_axis``.
+    token positions; attention is the exact ring schedule over ``seq_axis``
+    (or the Ulysses all-to-all head-scatter with ``ulysses=True``).
     """
     s_local = local_token_ids.shape[-1]
     offset = jax.lax.axis_index(seq_axis) * s_local
     positions = offset + jnp.arange(s_local)
-    attention_fn = _ring_attention_fn(config, seq_axis)
+    attention_fn = _sp_attention_fn(config, seq_axis, ulysses=ulysses)
     return forward(
         params, local_token_ids, config, positions=positions, attention_fn=attention_fn
     )
 
 
-def _ring_attention_fn(config: ModelConfig, seq_axis: str, zigzag: bool = False):
-    """Per-shard attention for the ring, per the config:
-    ``attention_impl="flash"`` runs the Pallas kernel inside every shard
-    (ring-flash / zig-zag ring-flash), anything else the XLA online-softmax
-    ring (optionally kv-chunked; zig-zag has no chunk knob — its sub-blocks
-    are already half-size)."""
+def _sp_attention_fn(
+    config: ModelConfig,
+    seq_axis: str,
+    zigzag: bool = False,
+    ulysses: bool = False,
+):
+    """Per-shard attention for the sp schedules, per the config:
+    ``ulysses=True`` is the all-to-all head scatter (`parallel/ulysses.py`);
+    otherwise ``attention_impl="flash"`` runs the Pallas kernel inside every
+    ring shard (ring-flash / zig-zag ring-flash), anything else the XLA
+    online-softmax ring (optionally kv-chunked; zig-zag has no chunk knob —
+    its sub-blocks are already half-size)."""
+    if ulysses:
+        return partial(ulysses_attention, axis_name=seq_axis, config=config)
     if config.attention_impl == "flash":
         from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
 
@@ -108,11 +119,21 @@ def make_sp_train_step(
     data_axis: str = "data",
     seq_axis: str = "seq",
     zigzag: bool = False,
+    ulysses: bool = False,
     accum_steps: int = 1,
     inner_steps: int = 1,
 ) -> Callable:
     """Train step over a 2-D (data x seq) mesh: batch split on ``data``,
     every sequence split on ``seq``; params/opt-state replicated.
+
+    ``ulysses=True`` swaps the ring schedule for the all-to-all head
+    scatter (`parallel/ulysses.py`): one all_to_all re-partitions Q/K/V to
+    head-sharded, dense/flash attention runs over the FULL sequence per
+    head slice, and the inverse all_to_all restores sequence sharding.
+    Requires ``num_heads`` to be a multiple of the seq axis size;
+    contiguous layout
+    (mutually exclusive with ``zigzag`` — Ulysses has no load imbalance to
+    fix, every device already does identical full-sequence work).
 
     The global batch must divide the data axis and ``context_length`` must
     divide the seq axis.  With ``zigzag=True`` the causal ring runs the
@@ -141,7 +162,18 @@ def make_sp_train_step(
         raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
     if accum_steps > 1 and inner_steps > 1:
         raise ValueError("accum_steps and inner_steps cannot both exceed 1")
+    if zigzag and ulysses:
+        raise ValueError(
+            "zigzag and ulysses are mutually exclusive (the all-to-all "
+            "schedule has no causal load imbalance to stripe away)"
+        )
     n_seq = mesh.shape[seq_axis]
+    if ulysses and config.num_heads % n_seq:
+        raise ValueError(
+            f"ulysses scatters heads over the seq axis: num_heads="
+            f"{config.num_heads} must be a multiple of the {seq_axis!r} "
+            f"axis size {n_seq} (use the ring schedule otherwise)"
+        )
     if zigzag and config.ring_kv_chunk:
         raise ValueError(
             "the zig-zag schedule does not honor ring_kv_chunk (its "
@@ -149,9 +181,12 @@ def make_sp_train_step(
             'unset ring_kv_chunk and set attention_impl="flash" for '
             "VMEM-tiled zig-zag"
         )
-    if config.attention_impl == "flash" and config.ring_kv_chunk:
-        # Same guard lives in _ring_attention_fn (covers sp_forward too);
-        # raising here surfaces it at step-construction time.
+    if config.attention_impl == "flash" and config.ring_kv_chunk and not ulysses:
+        # Same guard lives in _sp_attention_fn (covers sp_forward too);
+        # raising here surfaces it at step-construction time.  Ulysses is
+        # carved out: it never consumes ring_kv_chunk (its inner attention
+        # is full-sequence flash/dense), so a ring-specific error about a
+        # knob the selected schedule ignores would only mislead.
         raise ValueError(_FLASH_RING_KV_CHUNK_ERROR)
 
     def local_step(params, opt_state: AdamWState, x, y):
@@ -169,11 +204,12 @@ def make_sp_train_step(
                 positions = zigzag_positions(
                     jax.lax.axis_index(seq_axis), s_local, n_seq
                 )
-                attention_fn = _ring_attention_fn(config, seq_axis, zigzag=True)
             else:
                 offset = jax.lax.axis_index(seq_axis) * s_local
                 positions = offset + jnp.arange(s_local)
-                attention_fn = _ring_attention_fn(config, seq_axis, zigzag=False)
+            attention_fn = _sp_attention_fn(
+                config, seq_axis, zigzag=zigzag, ulysses=ulysses
+            )
             hidden, aux = forward_hidden(
                 p, x, config, positions=positions, attention_fn=attention_fn
             )

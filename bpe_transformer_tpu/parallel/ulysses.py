@@ -1,0 +1,119 @@
+"""Ulysses-style all-to-all sequence parallelism (DeepSpeed-Ulysses).
+
+The second canonical long-context scheme next to ring attention
+(`parallel/ring_attention.py`): instead of rotating K/V shards around the
+mesh, ONE ``all_to_all`` re-partitions Q/K/V from sequence-sharded to
+HEAD-sharded — every device then runs ordinary dense (or Pallas flash)
+attention over the FULL sequence for its slice of the heads, and a second
+``all_to_all`` restores the sequence sharding for the token-local rest of
+the block.  No reference counterpart exists (max context there is 16
+tokens, SURVEY §2.4); built because long-context is first-class here.
+
+Trade-off vs the ring (why both exist):
+
+* communication: Ulysses moves each Q/K/V/O tensor once (4 all-to-alls of
+  O(B·S_local·d) per layer) regardless of mesh size; the ring moves K/V
+  ``n-1`` times (2·(n-1) ppermutes of the same volume).  On all-to-all-
+  friendly fabrics (TPU ICI is a torus — XLA lowers all_to_all to near-
+  optimal bisection traffic) Ulysses wins at larger ``n``.
+* constraint: the head count must be a multiple of the mesh axis size
+  (heads are the scatter dimension); the ring has no head constraint.
+* memory: per-device attention is (H/n heads, FULL S) — O(S²·H/n) scores
+  if materialized, so pair with ``attention_impl="flash"`` at long S; the
+  ring never materializes more than a shard-sized block.
+
+Gradients need no custom VJP: the transpose of an ``all_to_all`` is the
+reverse ``all_to_all``, so ``jax.grad`` derives the backward schedule.
+
+All functions run INSIDE ``shard_map`` over a mesh with ``axis_name``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bpe_transformer_tpu.models.config import ModelConfig
+from bpe_transformer_tpu.ops.core import causal_mask, scaled_dot_product_attention
+
+
+def _heads_to_seq(x, axis_name):
+    """(B, H, S_local, d) seq-sharded -> (B, H/n, S_global, d) head-sharded.
+
+    ``tiled=True`` keeps the split/concat in device order, and device order
+    along the seq axis IS global sequence order for the contiguous layout
+    (`shard_sp_batch` without zigzag), so the gathered sequence is in true
+    token order and causal masking stays the plain triangular mask.
+    """
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def _seq_to_heads(x, axis_name):
+    """Inverse of :func:`_heads_to_seq`."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    config: ModelConfig | None = None,
+) -> jax.Array:
+    """Causal attention over sequence-sharded Q/K/V via head scattering.
+
+    ``q/k/v``: (batch, heads, S_local, d_head), RoPE already applied with
+    GLOBAL positions (the sp loss does this), KV heads already expanded
+    (`ops.core.multihead_self_attention` broadcasts GQA before calling any
+    attention_fn).  Returns the attended values in the same seq-sharded
+    layout.
+
+    GQA: when ``config.num_kv_heads`` divides the axis too, the K/V
+    all_to_alls ship the COMPACT kv heads (the broadcast is undone by a
+    strided slice and re-applied after the exchange) — group× less K/V
+    communication, which is the scheme's whole currency.  The slice is
+    exact because `multihead_self_attention` expands with ``jnp.repeat``,
+    so every group of ``group`` consecutive heads is one kv head.
+
+    With ``config.attention_impl == "flash"`` the full-sequence inner
+    attention runs the Pallas flash kernel (no O(S²) score buffer);
+    otherwise the materialized XLA oracle.
+    """
+    n = lax.axis_size(axis_name)
+    heads = q.shape[-3]
+    if heads % n:
+        raise ValueError(
+            f"Ulysses scatters heads over the mesh axis: num_heads={heads} "
+            f"must be a multiple of the {axis_name!r} axis size {n} (use "
+            "the ring schedule for head counts that aren't)"
+        )
+    kv_heads = (config.num_kv_heads or heads) if config is not None else heads
+    group = heads // kv_heads
+    compact_kv = group > 1 and kv_heads % n == 0
+    if compact_kv:
+        k = k[:, ::group]
+        v = v[:, ::group]
+    qh = _heads_to_seq(q, axis_name)
+    kh = _heads_to_seq(k, axis_name)
+    vh = _heads_to_seq(v, axis_name)
+    if compact_kv:
+        # Device i's query heads [i·H/n, (i+1)·H/n) map exactly onto its kv
+        # shard [i·KV/n, (i+1)·KV/n) (H/n = group·KV/n), so re-expanding
+        # locally reproduces the expanded-path pairing.
+        kh = jnp.repeat(kh, group, axis=1)
+        vh = jnp.repeat(vh, group, axis=1)
+    if config is not None and config.attention_impl == "flash":
+        from bpe_transformer_tpu.kernels.pallas.flash_attention import (
+            flash_attention_for_config,
+        )
+
+        out = flash_attention_for_config(qh, kh, vh, config)
+    else:
+        mask = causal_mask(qh.shape[-2])
+        out = scaled_dot_product_attention(
+            qh.astype(jnp.float32), kh.astype(jnp.float32),
+            vh.astype(jnp.float32), mask,
+        ).astype(q.dtype)
+    return _seq_to_heads(out, axis_name)

@@ -136,6 +136,7 @@ def cmd_train(args) -> int:
         mesh_axes=mesh_axes,
         pp_microbatches=args.pp_microbatches,
         sp_zigzag=args.sp_zigzag,
+        sp_ulysses=args.sp_ulysses,
         inner_steps=args.inner_steps,
         grad_accum_steps=args.grad_accum_steps,
         async_checkpoint=args.async_checkpoint,
@@ -263,6 +264,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--mesh",
         default=None,
         help='mesh axes, e.g. "data=8", "data=4,model=2", "data=2,pp=4"',
+    )
+    p.add_argument(
+        "--sp-ulysses",
+        action="store_true",
+        help="Ulysses all-to-all head-scatter sequence parallelism instead "
+        "of the ring (with --parallel sp; num_heads must be a multiple of "
+        "the seq mesh axis size)",
     )
     p.add_argument(
         "--sp-zigzag",

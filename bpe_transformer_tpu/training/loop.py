@@ -60,6 +60,10 @@ class LoopConfig:
     #: With parallel="sp": run the balanced zig-zag (striped) ring schedule
     #: (~2x less causal attention work at large seq meshes).
     sp_zigzag: bool = False
+    #: With parallel="sp": Ulysses all-to-all head scatter instead of the
+    #: ring (num_heads must be a multiple of the seq axis size; see
+    #: parallel/ulysses.py).
+    sp_ulysses: bool = False
     #: Optimizer updates per XLA dispatch (lax.scan over the update body).
     #: >1 amortizes host launch latency for small models — identical math.
     #: Works single-device and under dp/sp/GSPMD meshes (the scan compiles
@@ -302,6 +306,7 @@ def train(
         def build_step(n=stride):
             return make_sp_train_step(
                 model_config, hparams, mesh, zigzag=loop.sp_zigzag,
+                ulysses=loop.sp_ulysses,
                 accum_steps=accum, inner_steps=n,
             )
 

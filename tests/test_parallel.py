@@ -658,3 +658,135 @@ def test_dp_inner_steps_match_sequential_dp_steps():
         p1,
         p2,
     )
+
+
+# ------------------------------------------------- ulysses (all-to-all sp)
+
+
+def test_ulysses_attention_matches_dense():
+    """The all-to-all head scatter reproduces dense causal attention: one
+    all_to_all to head-sharded, full-seq attention, inverse all_to_all."""
+    from functools import partial
+
+    from bpe_transformer_tpu.ops.core import causal_mask, scaled_dot_product_attention
+    from bpe_transformer_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    rng = np.random.default_rng(0)
+    B, H, S, D = 2, 8, 32, 16
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, H, S, D)).astype(np.float32))
+        for _ in range(3)
+    )
+    dense = scaled_dot_product_attention(q, k, v, causal_mask(S))
+
+    spec = PartitionSpec("data", None, "seq")
+    mapped = jax.shard_map(
+        partial(ulysses_attention, axis_name="seq"),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    out = mapped(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "num_heads,kv_heads",
+    [(4, None), (4, 2), (8, 4)],
+    ids=["mha", "gqa_expanded", "gqa_compact"],
+)
+def test_sp_ulysses_step_matches_single_device(num_heads, kv_heads):
+    """A full train step under the Ulysses schedule equals the single-device
+    update (gradients flow through the all_to_alls — their transpose is the
+    inverse all_to_all).  gqa_expanded: kv_heads (2) does not divide the seq
+    axis (4), so K/V ship expanded; gqa_compact: kv_heads (4) does, so the
+    compact slice/re-expand path runs — including its BACKWARD, which relies
+    on the repeat-VJP summing each group so the sliced duplicates' zero
+    cotangents wash out."""
+    from bpe_transformer_tpu.parallel import make_sp_train_step, shard_sp_batch
+
+    cfg = dataclasses.replace(CFG, num_heads=num_heads, num_kv_heads=kv_heads)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    opt_state = adamw_init(params)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(16, cfg.context_length)))
+    y = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(16, cfg.context_length)))
+
+    single = make_train_step(cfg, HP)
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    params2 = init_params(jax.random.PRNGKey(0), cfg)
+    step = make_sp_train_step(cfg, HP, mesh, ulysses=True)
+    xp, yp = shard_sp_batch((x, y), mesh)
+    p2, s2, m2 = step(params2, adamw_init(params2), xp, yp)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        p1,
+        jax.device_get(p2),
+    )
+
+
+def test_sp_ulysses_forward_matches_full_forward():
+    from functools import partial
+
+    from bpe_transformer_tpu.parallel import sp_forward
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    params = init_params(jax.random.PRNGKey(1), CFG)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, CFG.vocab_size, size=(4, CFG.context_length)))
+    dense = forward(params, ids, CFG)
+
+    mapped = jax.shard_map(
+        partial(sp_forward, config=CFG, seq_axis="seq", ulysses=True),
+        mesh=mesh,
+        in_specs=(PartitionSpec(), PartitionSpec("data", "seq")),
+        out_specs=PartitionSpec("data", "seq", None),
+        check_vma=False,
+    )
+    out = mapped(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=3e-5)
+
+
+def test_sp_ulysses_validation():
+    from bpe_transformer_tpu.parallel import make_sp_train_step
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_sp_train_step(CFG, HP, mesh, zigzag=True, ulysses=True)
+    cfg3 = dataclasses.replace(CFG, num_heads=2, d_model=32)
+    with pytest.raises(ValueError, match="must be a multiple"):
+        make_sp_train_step(cfg3, HP, mesh, ulysses=True)
+
+
+def test_sp_ulysses_gqa_compact_kv_path():
+    """When kv_heads also divides the seq axis the K/V all_to_alls ship the
+    COMPACT kv heads (group× less communication); numerics must match the
+    dense forward exactly like the expanded path."""
+    from functools import partial
+
+    from bpe_transformer_tpu.parallel import sp_forward
+
+    cfg = dataclasses.replace(CFG, num_heads=8, d_model=64, num_kv_heads=4)
+    mesh = make_mesh({"data": 2, "seq": 4})
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(4, cfg.context_length)))
+    dense = forward(params, ids, cfg)
+
+    mapped = jax.shard_map(
+        partial(sp_forward, config=cfg, seq_axis="seq", ulysses=True),
+        mesh=mesh,
+        in_specs=(PartitionSpec(), PartitionSpec("data", "seq")),
+        out_specs=PartitionSpec("data", "seq", None),
+        check_vma=False,
+    )
+    out = mapped(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=3e-5)

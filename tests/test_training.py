@@ -564,3 +564,25 @@ def test_loop_pp_inner_steps_with_tail_trains(byte_data, tmp_path):
     )
     assert summary["history"][-1]["loss"] < summary["history"][0]["loss"]
     assert np.isfinite(summary["final_val_loss"])
+
+
+def test_loop_sp_ulysses_trains_and_evals(byte_data, tmp_path):
+    """The training loop drives the Ulysses all-to-all schedule (heads
+    scattered over the seq axis) end-to-end, eval on the dense forward."""
+    loop = LoopConfig(
+        steps=8,
+        batch_size=16,
+        log_every=4,
+        eval_every=8,
+        eval_batches=2,
+        checkpoint_every=1000,
+        parallel="sp",
+        mesh_axes={"data": 2, "seq": 4},
+        sp_ulysses=True,
+    )
+    summary = train(
+        TINY, HP, loop, byte_data, val_data=byte_data,
+        log_fn=lambda *_: None,
+    )
+    assert summary["history"][-1]["loss"] < summary["history"][0]["loss"]
+    assert np.isfinite(summary["final_val_loss"])
