@@ -75,6 +75,17 @@ def test_example_long_context_sp(tmp_path, sample):
 
 
 @pytest.mark.slow
+def test_example_long_context_sp_ulysses(tmp_path, sample):
+    out = run_example(
+        tmp_path, sample, "5_long_context_sp.py",
+        "--steps", "6", "--context", "128", "--vocab-size", "300",
+        "--ulysses",
+    )
+    assert "long-context sp OK" in out
+    assert "Ulysses all-to-all" in out
+
+
+@pytest.mark.slow
 def test_example_moe_expert_parallel(tmp_path, sample):
     out = run_example(
         tmp_path, sample, "6_moe_expert_parallel.py",

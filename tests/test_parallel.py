@@ -790,3 +790,32 @@ def test_sp_ulysses_gqa_compact_kv_path():
     )
     out = mapped(params, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=3e-5)
+
+
+def test_sp_ulysses_grad_accum_matches_full_batch_step():
+    """Ulysses composes with gradient accumulation (the schedule-independent
+    accumulate_grads scan): equals the single-device full-batch update."""
+    from bpe_transformer_tpu.parallel import make_sp_train_step, shard_sp_batch
+
+    accum = 2
+    params, opt_state, x, y = _setup()
+    single = make_train_step(CFG, HP)
+    p1, s1, m1 = single(params, opt_state, x, y)
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    params2, opt_state2, x2, y2 = _setup()
+    micro = x2.shape[0] // accum
+    x2 = x2.reshape(accum, micro, -1)
+    y2 = y2.reshape(accum, micro, -1)
+    step = make_sp_train_step(CFG, HP, mesh, ulysses=True, accum_steps=accum)
+    x2, y2 = shard_sp_batch((x2, y2), mesh, stacked=True)
+    p2, s2, m2 = step(params2, opt_state2, x2, y2)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        p1,
+        p2,
+    )
