@@ -71,7 +71,7 @@ def main() -> int:
     import jax
 
     n_dev = len(jax.devices())
-    if args.ulysses and (n_dev > 128 or 128 % n_dev):
+    if args.ulysses and 128 % n_dev:  # 128 = the demo model's d_model below
         # The demo model uses d_model=128 and (under --ulysses) one head
         # per seq-axis device; an awkward device count would crash deep in
         # ModelConfig instead of here.

@@ -106,3 +106,10 @@ def test_example_kv_cache_decode(tmp_path, sample):
     )
     assert "decode demo OK" in out
     assert "GQA" in out
+
+
+@pytest.mark.slow
+def test_example_pipeline_parallel(tmp_path, sample):
+    out = run_example(tmp_path, sample, "9_pipeline_parallel.py")
+    assert "pipeline parallel OK" in out
+    assert "matches the single-device update" in out
