@@ -1,4 +1,4 @@
-"""Ring-attention schedule comparison: contiguous vs zig-zag (striped).
+"""Sequence-parallel schedule comparison: ring vs zig-zag vs Ulysses.
 
 Under causal masking the contiguous ring computes every visiting K/V block
 on every device and discards masked ones (device n-1 needs all n blocks,
@@ -7,8 +7,16 @@ device 0 one — and SPMD means everyone computes n).  The zig-zag layout
 computes two half-blocks per step, so per-device attention FLOPs drop
 ~2x at large mesh sizes.
 
-Runs both schedules over the virtual CPU mesh (or real devices when
-present) and prints one JSON line with mean step times and the ratio.
+The Ulysses all-to-all schedule (`parallel/ulysses.py`) is timed alongside
+when the head count divides into the mesh (its constraint): it trades the
+ring's n-1 K/V rotations for one head-scatter all_to_all each way and runs
+full-sequence attention per head slice.  NOTE on reading CPU numbers: the
+FLOP balance (ring-vs-zigzag ~2x) is schedule-arithmetic and transfers to
+TPU; collective COSTS do not (host "collectives" are memcpys), so the
+Ulysses column is a compute-balance datum only.
+
+Runs the schedules over the virtual CPU mesh (or real devices when
+present) and prints one JSON line with mean step times and the ratios.
 
 Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
            python benchmarks/bench_ring.py [--seq 4096] [--iters 10]
@@ -31,7 +39,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=4096)
-    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--d", type=int, default=64)
     ap.add_argument("--iters", type=int, default=10)
     args = ap.parse_args()
@@ -79,13 +87,36 @@ def main() -> int:
     t_ring = time_fn(ring, q, k, v, iters=args.iters)
     t_zig = time_fn(zig, qz, kz, vz, iters=args.iters)
     result = {
-        "metric": f"causal ring attention step time (S={S}, {n} shards)",
+        "metric": (
+            f"causal sp attention step time (S={S}, H={args.heads}, "
+            f"D={args.d}, {n} shards)"
+        ),
         "contiguous_ms": round(t_ring["mean_s"] * 1e3, 2),
         "zigzag_ms": round(t_zig["mean_s"] * 1e3, 2),
         "speedup": round(t_ring["mean_s"] / t_zig["mean_s"], 3),
         "platform": jax.devices()[0].platform,
         "n_devices": n,
     }
+
+    if args.heads % n == 0:
+        from bpe_transformer_tpu.parallel.ulysses import ulysses_attention
+
+        uly = jax.jit(
+            jax.shard_map(
+                partial(ulysses_attention, axis_name="seq"),
+                mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+                check_vma=False,
+            )
+        )
+        t_uly = time_fn(uly, q, k, v, iters=args.iters)
+        result["ulysses_ms"] = round(t_uly["mean_s"] * 1e3, 2)
+        result["ring_vs_ulysses"] = round(t_ring["mean_s"] / t_uly["mean_s"], 3)
+    else:
+        result["ulysses_ms"] = None
+        result["note"] = (
+            f"ulysses skipped: heads ({args.heads}) not a multiple of the "
+            f"mesh ({n})"
+        )
     print(json.dumps(result))
     return 0
 
