@@ -518,3 +518,25 @@ def test_decode_attention_rejects_bad_shapes():
         decode_attention(q, kv, kv, 0, interpret=True)
     with pytest.raises(ValueError, match="shape mismatch"):
         decode_attention(jnp.zeros((2, 4, 32)), kv, kv, 0, interpret=True)
+
+
+def test_decode_attention_gpt2_shape():
+    """The queued device cell's geometry (gpt2-small: H=12, d_head=64,
+    ctx=1024, bf16): parity at several causal frontiers, one jitted program."""
+    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+        decode_attention,
+        xla_decode_attention,
+    )
+
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.standard_normal((1, 12, 64)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((1, 12, 1024, 64)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((1, 12, 1024, 64)), jnp.bfloat16)
+    f = jax.jit(lambda q, k, v, p: decode_attention(q, k, v, p, interpret=True))
+    for pos in (63, 512, 1023):
+        out = f(q, k, v, jnp.int32(pos))
+        ref = xla_decode_attention(q, k, v, pos)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2, err_msg=f"pos {pos}",
+        )
