@@ -28,9 +28,10 @@ Grid ``(batch*kv_heads, ctx/block_k)``, key axis innermost; ``pos`` rides
 scalar prefetch (SMEM) so the causal frontier is a traced value — the
 generation loop's ``lax.scan`` carries it — while the program stays a
 single compilation.  Key blocks entirely beyond ``pos`` are predicated
-off (their DMAs still run; at decode's cache sizes the tail blocks are a
-minority of traffic and the predication keeps the kernel a single static
-grid).
+off AND their K/V index maps clamp to the frontier block, so the dead
+tail of the cache is neither computed on nor fetched (the pipeline elides
+the repeated-block DMAs) — early decode steps stream only the live
+prefix.
 
 The kernel is forward-only by design: decoding is inference.  Training
 gradients flow through the training attention paths (flash/ring), never
@@ -176,11 +177,18 @@ def decode_attention(
         num_k_blocks=nk,
     )
     # Scalar-prefetch index maps receive the scalar ref as a trailing arg.
+    # The K/V index CLAMPS to the causal frontier's block: grid steps beyond
+    # ``pos`` are compute-predicated off in the kernel, and re-requesting
+    # the frontier block instead of a dead one lets the pipeline elide the
+    # DMA (same block index -> no refetch) — early decode steps would
+    # otherwise stream the entire dead tail of the cache every token.
     qspec = pl.BlockSpec(
         (1, g_pad, d), lambda b, j, p: (b, 0, 0), memory_space=pltpu.VMEM
     )
     kvspec = pl.BlockSpec(
-        (1, block_k, d), lambda b, j, p: (b, j, 0), memory_space=pltpu.VMEM
+        (1, block_k, d),
+        lambda b, j, p: (b, jnp.minimum(j, p[0] // block_k), 0),
+        memory_space=pltpu.VMEM,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
