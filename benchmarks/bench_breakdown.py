@@ -56,6 +56,14 @@ def main() -> int:
     parser.add_argument("--config", default="gpt2-small-32k")
     parser.add_argument("--batch", type=int, default=32)
     parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument(
+        "--decode",
+        action="store_true",
+        help="decompose the DECODE path instead of training: prefill(+1) "
+        "and per-token scan cost, for each decode_attention_impl — the "
+        "attribution the gpt2 decode-cell timeouts need (compile vs "
+        "prefill vs token loop)",
+    )
     args = parser.parse_args()
 
     # BREAKDOWN_ALLOW_CPU=1 is a functional smoke for the script itself
@@ -90,9 +98,6 @@ def main() -> int:
     )
     device = jax.devices()[0]
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, base.vocab_size, size=(args.batch, base.context_length))
-    x = jnp.asarray(ids)
-    y = jnp.asarray(np.roll(ids, -1, axis=1))
 
     def emit(stage: str, ms: float, **extra) -> None:
         print(
@@ -124,6 +129,58 @@ def main() -> int:
             params, opt_state, metrics = step(params, opt_state, x, y)
         jax.device_get(metrics["loss"])
         return (time.perf_counter() - start) / args.iters * 1e3
+
+    if args.decode:
+        from bench_decode import PROMPT_LEN  # shared geometry: these rows
+        # must stay comparable with the decode.jsonl cells they explain
+
+        from bpe_transformer_tpu.models.decode import generate_cached
+
+        params = init_params(jax.random.PRNGKey(0), base)
+        prompt = jnp.asarray(
+            rng.integers(0, base.vocab_size, size=(args.batch, PROMPT_LEN)),
+            jnp.int32,
+        )
+        key = jax.random.PRNGKey(1)
+        n_long = 33  # per-token cost = (t(33) - t(1)) / 32
+        # Honesty marker for the compile row: the queue's persistent
+        # compile cache means a RETRY measures a warm "compile" — record
+        # how many cache entries existed so the row is self-describing.
+        cache_dir = Path(os.environ.get("JAX_COMPILATION_CACHE_DIR", ""))
+        ccache_entries = (
+            len(list(cache_dir.iterdir())) if cache_dir.is_dir() else 0
+        )
+        for impl in ("xla", "pallas"):
+            cfg_d = dataclasses.replace(base, decode_attention_impl=impl)
+
+            def gen(n, cfg_d=cfg_d):
+                return generate_cached(
+                    params, prompt, key, config=cfg_d,
+                    max_new_tokens=n, temperature=0.0,
+                )
+
+            t0 = time.perf_counter()
+            jax.device_get(gen(1))  # compile + first run
+            emit(
+                "decode_compile_plus_first(new=1)",
+                (time.perf_counter() - t0) * 1e3,
+                dec=impl,
+                ccache_entries_at_start=ccache_entries,
+            )
+            t1 = time_call(lambda: gen(1), iters=args.iters)
+            emit("decode_prefill_plus_1", t1, dec=impl, prompt=PROMPT_LEN)
+            t_long = time_call(lambda: gen(n_long), iters=max(args.iters // 2, 3))
+            emit(
+                "decode_per_token",
+                (t_long - t1) / (n_long - 1),
+                dec=impl,
+                measured_new=n_long,
+            )
+        return 0
+
+    ids = rng.integers(0, base.vocab_size, size=(args.batch, base.context_length))
+    x = jnp.asarray(ids)
+    y = jnp.asarray(np.roll(ids, -1, axis=1))
 
     # 1. The full update as shipped.
     emit("full_step", step_ms(base), attention=base.attention_impl,

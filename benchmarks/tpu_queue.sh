@@ -208,6 +208,10 @@ run_job dec_pallas_gpt2s_1 1200 "$CAP/decode.jsonl" \
 run_job dec_pallas_ts4l_1 600 "$CAP/decode.jsonl" \
   env BENCH_DECODE_NEW_TOKENS=128 BENCH_DECODE_ATTN=pallas BENCH_DECODE_SKIP_UNCACHED=1 \
   python benchmarks/bench_decode.py --config tinystories-4l --batch 1
+# Decode-phase attribution (r5): compile vs prefill vs per-token cost, per
+# decode impl — diagnoses the gpt2 decode-cell timeouts quantitatively.
+run_job breakdown_dec 1500 "$CAP/breakdown.jsonl" \
+  python benchmarks/bench_breakdown.py --config gpt2-small-32k --batch 1 --decode
 
 # 6. Tuning variants: deeper dispatch amortization for the small model and
 # a bigger batch for gpt2-small (own capture file; may OOM -> discarded).
