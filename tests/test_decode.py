@@ -27,6 +27,32 @@ def setup():
     return params, ids
 
 
+def _stepwise_decode_parity(
+    params, ids, cfg, ref, prefill_len, atol=1e-4, lm_head=None,
+    cache_dtype=jnp.float32,
+):
+    """Shared parity scaffold: prefill then token-by-token decode_step,
+    asserting logits against ``ref`` (a (B, S, V) full-forward run) at the
+    prefill boundary and every subsequent position.  Returns the final
+    (logits, cache) for any extra per-test assertions."""
+    cache = init_kv_cache(cfg, ids.shape[0], dtype=cache_dtype)
+    logits, cache = prefill(
+        params, ids[:, :prefill_len], cfg, cache, lm_head=lm_head
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref[:, prefill_len - 1]), atol=atol
+    )
+    for p in range(prefill_len, ids.shape[1]):
+        logits, cache = decode_step(
+            params, ids[:, p], jnp.asarray(p), cache, cfg, lm_head=lm_head
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref[:, p]), atol=atol,
+            err_msg=f"position {p}",
+        )
+    return logits, cache
+
+
 def test_prefill_matches_forward(setup):
     params, ids = setup
     full = forward(params, ids, CFG)  # (B, S, V)
@@ -41,17 +67,7 @@ def test_decode_step_matches_forward(setup):
     """Feeding tokens one by one through the cache reproduces the full
     forward's logits at every position."""
     params, ids = setup
-    full = forward(params, ids, CFG)
-    cache = init_kv_cache(CFG, ids.shape[0])
-    plen = ids.shape[1]
-    logits, cache = prefill(params, ids[:, :4], CFG, cache)
-    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, 3]), atol=1e-4)
-    for p in range(4, plen):
-        logits, cache = decode_step(params, ids[:, p], jnp.asarray(p), cache, CFG)
-        np.testing.assert_allclose(
-            np.asarray(logits), np.asarray(full[:, p]), atol=1e-4,
-            err_msg=f"position {p}",
-        )
+    _stepwise_decode_parity(params, ids, CFG, forward(params, ids, CFG), 4)
 
 
 def test_generate_cached_greedy_matches_uncached(setup):
@@ -105,16 +121,7 @@ def test_cached_decode_parity_block_variants(variant):
     rng = np.random.default_rng(1)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 12)), jnp.int32)
 
-    full = forward(params, ids, cfg)
-    cache = init_kv_cache(cfg, ids.shape[0])
-    logits, cache = prefill(params, ids[:, :4], cfg, cache)
-    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, 3]), atol=1e-4)
-    for p in range(4, ids.shape[1]):
-        logits, cache = decode_step(params, ids[:, p], jnp.asarray(p), cache, cfg)
-        np.testing.assert_allclose(
-            np.asarray(logits), np.asarray(full[:, p]), atol=1e-4,
-            err_msg=f"position {p}",
-        )
+    _stepwise_decode_parity(params, ids, cfg, forward(params, ids, cfg), 4)
 
     # Greedy generation: cached sampler == explicit full-forward argmax loop.
     prompt = [int(t) for t in np.asarray(ids[0, :5])]
@@ -234,19 +241,8 @@ def test_moe_decode_default_capacity_no_drops():
     rng = np.random.default_rng(3)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 12)), jnp.int32)
 
-    ref = forward(params, ids, nodrop)  # drop-free oracle
-
-    cache = init_kv_cache(cfg, ids.shape[0])
-    logits, cache = prefill(params, ids[:, :4], cfg, cache)
-    np.testing.assert_allclose(
-        np.asarray(logits), np.asarray(ref[:, 3]), atol=1e-4
-    )
-    for p in range(4, ids.shape[1]):
-        logits, cache = decode_step(params, ids[:, p], jnp.asarray(p), cache, cfg)
-        np.testing.assert_allclose(
-            np.asarray(logits), np.asarray(ref[:, p]), atol=1e-4,
-            err_msg=f"position {p}",
-        )
+    # Drop-free oracle: the default-capacity cached chain must match it.
+    _stepwise_decode_parity(params, ids, cfg, forward(params, ids, nodrop), 4)
 
 
 def test_moe_decode_step_dropfree_with_degenerate_capacity():
@@ -266,15 +262,7 @@ def test_moe_decode_step_dropfree_with_degenerate_capacity():
     B = 8
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(B, 10)), jnp.int32)
 
-    ref = forward(params, ids, nodrop)
-    cache = init_kv_cache(cfg, B)
-    logits, cache = prefill(params, ids[:, :2], cfg, cache)
-    for p in range(2, ids.shape[1]):
-        logits, cache = decode_step(params, ids[:, p], jnp.asarray(p), cache, cfg)
-        np.testing.assert_allclose(
-            np.asarray(logits), np.asarray(ref[:, p]), atol=1e-4,
-            err_msg=f"position {p}",
-        )
+    _stepwise_decode_parity(params, ids, cfg, forward(params, ids, nodrop), 2)
 
 
 def test_bf16_cached_decode_close_to_bf16_forward():
@@ -294,20 +282,10 @@ def test_bf16_cached_decode_close_to_bf16_forward():
     act = jnp.bfloat16
     head = lm_head_weight(params, cfg).astype(jnp.float32)  # master, f32
     cast = jax.tree_util.tree_map(lambda p: p.astype(act), params)
-    cache = init_kv_cache(cfg, ids.shape[0], dtype=act)
-    logits, cache = prefill(cast, ids[:, :4], cfg, cache, lm_head=head)
-    assert logits.dtype == jnp.float32
-    np.testing.assert_allclose(
-        np.asarray(logits), np.asarray(ref[:, 3]), atol=0.1
+    logits, cache = _stepwise_decode_parity(
+        cast, ids, cfg, ref, 4, atol=0.1, lm_head=head, cache_dtype=act
     )
-    for p in range(4, ids.shape[1]):
-        logits, cache = decode_step(
-            cast, ids[:, p], jnp.asarray(p), cache, cfg, lm_head=head
-        )
-        np.testing.assert_allclose(
-            np.asarray(logits), np.asarray(ref[:, p]), atol=0.1,
-            err_msg=f"position {p}",
-        )
+    assert logits.dtype == jnp.float32
     assert cache[0]["k"].dtype == act
 
 
@@ -338,17 +316,7 @@ def test_pallas_decode_attention_impl_matches_xla(setup):
     params, ids = setup
     cfg_pallas = dataclasses.replace(CFG, decode_attention_impl="pallas")
 
-    full = forward(params, ids, CFG)
-    cache = init_kv_cache(CFG, ids.shape[0])
-    logits, cache = prefill(params, ids[:, :4], cfg_pallas, cache)
-    for p in range(4, ids.shape[1]):
-        logits, cache = decode_step(
-            params, ids[:, p], jnp.asarray(p), cache, cfg_pallas
-        )
-        np.testing.assert_allclose(
-            np.asarray(logits), np.asarray(full[:, p]), atol=1e-4,
-            err_msg=f"position {p}",
-        )
+    _stepwise_decode_parity(params, ids, cfg_pallas, forward(params, ids, CFG), 4)
 
     prompt = ids[:, :5]
     a = generate_cached(
@@ -371,15 +339,7 @@ def test_pallas_decode_attention_impl_gqa():
     params = init_params(jax.random.PRNGKey(1), gqa)
     rng = np.random.default_rng(1)
     ids = jnp.asarray(rng.integers(0, gqa.vocab_size, size=(2, 10)), jnp.int32)
-    full = forward(params, ids, gqa)
-    cache = init_kv_cache(gqa, ids.shape[0])
-    logits, cache = prefill(params, ids[:, :3], gqa, cache)
-    for p in range(3, ids.shape[1]):
-        logits, cache = decode_step(params, ids[:, p], jnp.asarray(p), cache, gqa)
-        np.testing.assert_allclose(
-            np.asarray(logits), np.asarray(full[:, p]), atol=1e-4,
-            err_msg=f"position {p}",
-        )
+    _stepwise_decode_parity(params, ids, gqa, forward(params, ids, gqa), 3)
 
 
 def test_prefill_flash_matches_xla(setup):
@@ -453,3 +413,20 @@ def test_generate_cached_with_tp_sharded_params():
         max_new_tokens=6, temperature=0.0,
     )
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_pallas_decode_attention_impl_moe_block():
+    """The flash-decoding kernel composes with MoE blocks (attention is
+    FFN-independent, but the integration deserves its own pin): per-step
+    logits match the full forward on a routed-FFN config."""
+    cfg = dataclasses.replace(
+        CFG,
+        ffn_type="moe",
+        n_experts=4,
+        capacity_factor=64.0,
+        decode_attention_impl="pallas",
+    )
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 10)), jnp.int32)
+    _stepwise_decode_parity(params, ids, cfg, forward(params, ids, cfg), 3)
