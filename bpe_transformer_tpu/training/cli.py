@@ -64,7 +64,11 @@ def _load_model_config(args, stored: dict | None = None) -> ModelConfig:
         # --model-config still selects them deliberately.
         cfg = ModelConfig.from_dict(stored)
         return dataclasses.replace(
-            cfg, attention_impl="xla", ffn_impl="xla", remat=False
+            cfg,
+            attention_impl="xla",
+            ffn_impl="xla",
+            decode_attention_impl="xla",
+            remat=False,
         )
     return PRESETS[getattr(args, "default_preset", "tinystories-4l")]
 
@@ -178,6 +182,8 @@ def cmd_eval(args) -> int:
 
 
 def cmd_generate(args) -> int:
+    import dataclasses
+
     from bpe_transformer_tpu.checkpointing import load_checkpoint
     from bpe_transformer_tpu.training.sampling import generate_text
 
@@ -185,6 +191,10 @@ def cmd_generate(args) -> int:
     model_config = _load_model_config(
         args, stored=payload.get("extra", {}).get("model_config")
     )
+    if args.decode_attention:
+        model_config = dataclasses.replace(
+            model_config, decode_attention_impl=args.decode_attention
+        )
     tokenizer = _load_tokenizer(args.tokenizer_dir, _specials(args))
     text = generate_text(
         payload["params"],
@@ -327,6 +337,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--special-token", action="append", default=None,
                    help='repeatable; default: ["<|endoftext|>"]')
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--decode-attention",
+        choices=["xla", "pallas"],
+        default=None,
+        help="decode-step cache attention: pallas = the flash-decoding "
+        "kernel (TPU; interpret mode elsewhere); default keeps the "
+        "portable xla path",
+    )
     p.set_defaults(fn=cmd_generate)
 
     return parser

@@ -77,6 +77,17 @@ def generate_ids(
 
     # Sliding-window fallback (prompt + continuation exceed the context
     # window): full forward per token.
+    if config.decode_attention_impl != "xla":
+        import sys
+
+        print(
+            "generate_ids: generation exceeds the context window, taking "
+            "the sliding-window path — decode_attention_impl="
+            f"{config.decode_attention_impl!r} only applies to the "
+            "KV-cached path (shorten max_new_tokens to fit the window to "
+            "use it)",
+            file=sys.stderr,
+        )
     buf = np.zeros(ctx, dtype=np.int32)
     buf[: len(prompt)] = prompt
     length = len(prompt)

@@ -194,6 +194,26 @@ def test_cli_end_to_end(tmp_path, tiny_corpus, capsys):
     )
     assert capsys.readouterr().out.startswith("the quick")
 
+    # --decode-attention pallas: the flash-decoding kernel through the CLI,
+    # greedy so the text must equal the default xla path's exactly.
+    def greedy(*extra):
+        assert (
+            cli_main(
+                [
+                    "generate",
+                    "--checkpoint", str(ckpt_dir / "latest.ckpt"),
+                    "--tokenizer-dir", str(tok_dir),
+                    "--prompt", "the quick",
+                    "--max-new-tokens", "6",
+                    "--temperature", "0.0",
+                    *extra,
+                ]
+            )
+            == 0
+        )
+        return capsys.readouterr().out
+    assert greedy("--decode-attention", "pallas") == greedy()
+
 
 def test_generate_greedy_and_topk(byte_data):
     import jax
