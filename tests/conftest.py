@@ -26,6 +26,20 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def load_script_module(name: str, relpath: str):
+    """Import a top-level script (bench.py, benchmarks/*.py) as a module
+    under a test-private name — the shared loader for script-unit tests so
+    the 5-line spec boilerplate isn't copied per file."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(name, REPO_ROOT / relpath)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
 #: The upstream reference checkout (read-only).  Tests that pin numerics or
 #: token ids against its fixtures/snapshots skip gracefully when absent.
 REFERENCE_ROOT = Path("/root/reference")

@@ -7,23 +7,19 @@ must never be displaced by an unreplayable or less complete capture) are
 load-bearing evidence plumbing, so they get direct tests.
 """
 
-import importlib.util
-import sys
 from pathlib import Path
 
 import pytest
 
-REPO = Path(__file__).resolve().parent.parent
+from conftest import REPO_ROOT, load_script_module
 
 
 @pytest.fixture()
 def bench(monkeypatch, tmp_path):
     # Import bench.py fresh with a scratch capture dir so tests can't touch
     # the committed evidence under benchmarks/captures/.
-    monkeypatch.syspath_prepend(str(REPO / "benchmarks"))
-    spec = importlib.util.spec_from_file_location("bench_under_test", REPO / "bench.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    monkeypatch.syspath_prepend(str(REPO_ROOT / "benchmarks"))
+    mod = load_script_module("bench_under_test", "bench.py")
     mod.CAPTURE_DIR = tmp_path
     mod.ARGS.config = "tinystories-4l"
     mod.ARGS.batch = 32

@@ -4,22 +4,16 @@ keeps the trap disarmed forever on a real multicore host or fires it with a
 fantasy grid on a quota-throttled one, so the affinity ∧ cgroup-quota logic
 gets direct tests."""
 
-import importlib.util
-from pathlib import Path
-
 import pytest
 
-REPO = Path(__file__).resolve().parent.parent
+from conftest import load_script_module
 
 
 @pytest.fixture()
 def tok_bench():
-    spec = importlib.util.spec_from_file_location(
-        "bench_tok_under_test", REPO / "benchmarks" / "bench_tokenization.py"
+    return load_script_module(
+        "bench_tok_under_test", "benchmarks/bench_tokenization.py"
     )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
 
 
 def _fake_cgroup(monkeypatch, mod, content):
