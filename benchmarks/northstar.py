@@ -77,6 +77,13 @@ CORPUS = "/root/reference/tests/fixtures/corpus.en"
 TOKENS_NPZ = REPO / "benchmarks" / "northstar_tokens.npz"
 TORCH_JSON = REPO / "benchmarks" / "northstar_torch.json"
 CAPTURE = REPO / "benchmarks" / "captures" / "northstar.json"
+#: The native-precision variant writes its own artifact: the parity run
+#: (matmul precision=highest, per-step dispatch) is the convergence oracle;
+#: the native run (TPU-default f32 matmuls, EVAL_EVERY steps per scanned
+#: dispatch) is the same protocol at the precision/dispatch the framework
+#: actually trains at, and is the run that demonstrates BOTH north-star
+#: clauses — reference val loss AND >=10x tokens/sec — in one run.
+CAPTURE_NATIVE = REPO / "benchmarks" / "captures" / "northstar_native.json"
 #: Resume checkpoint lives in the repo's gitignored scratch, not /tmp: a
 #: container recycle between tunnel windows must not discard mid-run
 #: progress (VERDICT r4 weak #7).  Legacy /tmp checkpoints are migrated in
@@ -222,7 +229,24 @@ def phase_torch() -> dict:
     return result
 
 
-def phase_jax(allow_cpu: bool) -> int:
+def phase_jax(allow_cpu: bool, variant: str = "parity") -> int:
+    """One accelerator run of the shared protocol.
+
+    ``variant="parity"``: f32 at matmul precision=highest, one dispatch per
+    step — the trajectory tracks the torch-f32 oracle; the convergence claim.
+    ``variant="native"``: TPU-default f32 matmul precision (single-pass bf16
+    MXU) with the EVAL_EVERY steps between evals folded into ONE scanned
+    dispatch (`make_scanned_train_step` — identical update math; the LR
+    schedule rides opt_state.step, so scanning changes nothing numerically
+    beyond the matmul rounding).  Same corpus/split/schedule/init; the run
+    that shows val-loss AND the >=10x clause together, at the precision the
+    framework actually trains at.
+    """
+    if variant not in ("parity", "native"):
+        raise ValueError(f"unknown variant {variant!r}")
+    native = variant == "native"
+    capture_path = CAPTURE_NATIVE if native else CAPTURE
+    ckpt_path = CKPT.with_name(f"native_{CKPT.name}") if native else CKPT
     if not allow_cpu:
         require_accelerator("northstar")
     torch_ref = json.loads(TORCH_JSON.read_text())
@@ -231,6 +255,13 @@ def phase_jax(allow_cpu: bool) -> int:
             f"torch reference ran {torch_ref['steps']} steps but this run "
             f"wants {STEPS}; delete {TORCH_JSON} or match NORTHSTAR_STEPS"
         )
+    if native and STEPS % EVAL_EVERY:
+        raise SystemExit(
+            f"native variant scans {EVAL_EVERY} steps per dispatch; "
+            f"NORTHSTAR_STEPS={STEPS} must be a multiple of it"
+        )
+
+    import contextlib
 
     import jax
     import jax.numpy as jnp
@@ -241,6 +272,7 @@ def phase_jax(allow_cpu: bool) -> int:
     from bpe_transformer_tpu.training.train_step import (
         TrainHParams,
         make_eval_step,
+        make_scanned_train_step,
         make_train_step,
     )
 
@@ -250,21 +282,34 @@ def phase_jax(allow_cpu: bool) -> int:
     cfg = model_config()
     device = jax.devices()[0]
 
-    with jax.default_matmul_precision("highest"):
-        step = make_train_step(cfg, TrainHParams())
+    precision_ctx = (
+        contextlib.nullcontext()
+        if native
+        else jax.default_matmul_precision("highest")
+    )
+    with precision_ctx:
+        if native:
+            step = make_scanned_train_step(cfg, TrainHParams(), EVAL_EVERY)
+        else:
+            step = make_train_step(cfg, TrainHParams())
         ev = make_eval_step(cfg)
 
-        if not CKPT.exists() and LEGACY_CKPT.exists():
+        if not native and not ckpt_path.exists() and LEGACY_CKPT.exists():
             import shutil  # move, not rename: /tmp and the repo can be
                            # different filesystems (rename would EXDEV)
-            CKPT.parent.mkdir(parents=True, exist_ok=True)
-            shutil.move(str(LEGACY_CKPT), str(CKPT))
-            print(f"migrated legacy checkpoint {LEGACY_CKPT} -> {CKPT}", file=sys.stderr)
-        if CKPT.exists():
-            payload = load_checkpoint(CKPT)
+            ckpt_path.parent.mkdir(parents=True, exist_ok=True)
+            shutil.move(str(LEGACY_CKPT), str(ckpt_path))
+            print(f"migrated legacy checkpoint {LEGACY_CKPT} -> {ckpt_path}", file=sys.stderr)
+        if ckpt_path.exists():
+            payload = load_checkpoint(ckpt_path)
             ckpt_platform = payload["extra"].get("platform")
             ckpt_steps = payload["extra"].get("steps")
-            if ckpt_platform != device.platform or ckpt_steps != STEPS:
+            ckpt_variant = payload["extra"].get("variant", "parity")
+            if (
+                ckpt_platform != device.platform
+                or ckpt_steps != STEPS
+                or ckpt_variant != variant
+            ):
                 # An interrupted --allow-cpu smoke must not seed the real
                 # on-chip run (the capture would claim a trajectory trained
                 # mostly on the wrong substrate), and a checkpoint from a
@@ -272,12 +317,13 @@ def phase_jax(allow_cpu: bool) -> int:
                 # stale iteration >= STEPS would skip training entirely and
                 # write an inconsistent artifact); restart from scratch.
                 print(
-                    f"checkpoint is platform={ckpt_platform!r} steps={ckpt_steps!r}; "
-                    f"this run is platform={device.platform!r} steps={STEPS}; "
+                    f"checkpoint is platform={ckpt_platform!r} steps={ckpt_steps!r} "
+                    f"variant={ckpt_variant!r}; this run is "
+                    f"platform={device.platform!r} steps={STEPS} variant={variant!r}; "
                     "discarding and starting fresh",
                     file=sys.stderr,
                 )
-                CKPT.unlink()
+                ckpt_path.unlink()
                 payload = None
         else:
             payload = None
@@ -299,28 +345,61 @@ def phase_jax(allow_cpu: bool) -> int:
             ]
             return sum(losses) / len(losses)
 
-        for i in range(start_step, STEPS):
-            x, y = gather_batch(train_toks, schedule[i])
-            t0 = time.perf_counter()
-            params, opt_state, m = step(params, opt_state, jnp.asarray(x), jnp.asarray(y))
-            loss = float(jax.device_get(m["loss"]))  # execution barrier
-            train_s += time.perf_counter() - t0
-            if (i + 1) % EVAL_EVERY == 0 or i == STEPS - 1:
-                curve.append({"step": i + 1, "train_loss": loss, "val_loss": val_loss()})
-                print(f"jax step {i + 1}: {curve[-1]}", file=sys.stderr)
-                CKPT.parent.mkdir(parents=True, exist_ok=True)
-                save_checkpoint(
-                    CKPT,
-                    params=params,
-                    opt_state=opt_state,
-                    iteration=i + 1,
-                    extra={
-                        "curve": curve,
-                        "train_s": train_s,
-                        "platform": device.platform,
-                        "steps": STEPS,
-                    },
+        def checkpoint(done_step: int) -> None:
+            ckpt_path.parent.mkdir(parents=True, exist_ok=True)
+            save_checkpoint(
+                ckpt_path,
+                params=params,
+                opt_state=opt_state,
+                iteration=done_step,
+                extra={
+                    "curve": curve,
+                    "train_s": train_s,
+                    "platform": device.platform,
+                    "steps": STEPS,
+                    "variant": variant,
+                },
+            )
+
+        if native:
+            # AOT-compile the scanned step OUTSIDE the timed loop (bench.py's
+            # warmup discipline — the torch side pays no compile, so compile
+            # time must not pollute the tokens/sec comparison).  lower() +
+            # compile() never executes, so no donation or update happens.
+            batch_aval = jax.ShapeDtypeStruct((EVAL_EVERY, BATCH, SEQ), jnp.int32)
+            step = step.lower(params, opt_state, batch_aval, batch_aval).compile()
+            # One dispatch per eval block: the EVAL_EVERY pre-drawn batches
+            # are stacked (inner, B, S) and scanned on-device.  A resumed
+            # run restarts at the block boundary its checkpoint recorded.
+            for block_start in range(start_step, STEPS, EVAL_EVERY):
+                xs, ys = zip(
+                    *(
+                        gather_batch(train_toks, schedule[i])
+                        for i in range(block_start, block_start + EVAL_EVERY)
+                    )
                 )
+                xs, ys = np.stack(xs), np.stack(ys)
+                t0 = time.perf_counter()
+                params, opt_state, m = step(
+                    params, opt_state, jnp.asarray(xs), jnp.asarray(ys)
+                )
+                loss = float(jax.device_get(m["loss"]))  # execution barrier
+                train_s += time.perf_counter() - t0
+                done = block_start + EVAL_EVERY
+                curve.append({"step": done, "train_loss": loss, "val_loss": val_loss()})
+                print(f"jax step {done}: {curve[-1]}", file=sys.stderr)
+                checkpoint(done)
+        else:
+            for i in range(start_step, STEPS):
+                x, y = gather_batch(train_toks, schedule[i])
+                t0 = time.perf_counter()
+                params, opt_state, m = step(params, opt_state, jnp.asarray(x), jnp.asarray(y))
+                loss = float(jax.device_get(m["loss"]))  # execution barrier
+                train_s += time.perf_counter() - t0
+                if (i + 1) % EVAL_EVERY == 0 or i == STEPS - 1:
+                    curve.append({"step": i + 1, "train_loss": loss, "val_loss": val_loss()})
+                    print(f"jax step {i + 1}: {curve[-1]}", file=sys.stderr)
+                    checkpoint(i + 1)
 
     jax_tps = STEPS * BATCH * SEQ / train_s
     final_val = curve[-1]["val_loss"]
@@ -331,7 +410,14 @@ def phase_jax(allow_cpu: bool) -> int:
         "steps": STEPS,
         "platform": device.platform,
         "device": str(device),
-        "precision": "f32, matmul precision=highest (parity with the torch-f32 oracle)",
+        "variant": variant,
+        "precision": (
+            "f32, TPU-default matmul precision (single-pass bf16 MXU), "
+            f"{EVAL_EVERY} steps per scanned dispatch"
+            if native
+            else "f32, matmul precision=highest (parity with the torch-f32 oracle)"
+        ),
+        "steps_per_dispatch": EVAL_EVERY if native else 1,
         "curve": curve,
         "final_val_loss": {"jax": final_val, "torch_cpu": torch_ref["final_val_loss"]},
         "reached_reference": final_val <= torch_ref["final_val_loss"] + VAL_TOLERANCE,
@@ -344,21 +430,28 @@ def phase_jax(allow_cpu: bool) -> int:
         "speedup": round(jax_tps / torch_ref["tokens_per_sec"], 2),
         "captured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00", time.gmtime()),
     }
-    CAPTURE.parent.mkdir(parents=True, exist_ok=True)
-    _write_json(CAPTURE, result)
+    capture_path.parent.mkdir(parents=True, exist_ok=True)
+    _write_json(capture_path, result)
     print(json.dumps({k: result[k] for k in (
-        "platform", "final_val_loss", "reached_reference", "speedup")}))
+        "platform", "variant", "final_val_loss", "reached_reference", "speedup")}))
     # The measurement is COMPLETE either way — the artifact records the
     # verdict honestly.  Exit 0 so the queue's done-marker stops re-runs
     # (a deterministic protocol would just reproduce the same result), and
     # clear the exhausted checkpoint so a deliberate re-run starts fresh.
-    CKPT.unlink(missing_ok=True)
+    ckpt_path.unlink(missing_ok=True)
     return 0
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--phase", choices=["data", "torch", "jax"], default=None)
+    ap.add_argument(
+        "--variant", choices=["parity", "native"], default="parity",
+        help="parity: matmul precision=highest, per-step dispatch (tracks "
+        "the torch-f32 oracle).  native: TPU-default precision, "
+        "EVAL_EVERY steps per scanned dispatch — the honest-throughput "
+        "run; writes northstar_native.json",
+    )
     ap.add_argument(
         "--allow-cpu", action="store_true",
         help="let --phase jax run on host CPU (smoke testing only; the "
@@ -372,9 +465,9 @@ def main() -> int:
         phase_torch()
         return 0
     if args.phase == "jax":
-        return phase_jax(args.allow_cpu)
+        return phase_jax(args.allow_cpu, args.variant)
     phase_torch()  # runs data implicitly; both cached
-    return phase_jax(args.allow_cpu)
+    return phase_jax(args.allow_cpu, args.variant)
 
 
 if __name__ == "__main__":

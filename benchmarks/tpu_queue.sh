@@ -140,6 +140,15 @@ run_job - 300 "$OUT/bench_headline.jsonl" env BENCH_DRIVER_FLAG=0 python bench.p
 # ~200 steps of an 8M-param model: minutes of device time, run it early.
 run_job northstar 900 "$OUT/northstar.jsonl" python benchmarks/northstar.py --phase jax
 
+# 1c. Native-precision north-star (round 5): same protocol at TPU-default
+# matmul precision with the 25 steps between evals in ONE scanned dispatch
+# -- the run that shows reference val loss AND >=10x tok/s together
+# (the parity run above clears the loss bar at 7.15x only because
+# precision=highest emulates f32 on the MXU).  Writes
+# benchmarks/captures/northstar_native.json; resumable like 1b.
+run_job northstar_native 600 "$OUT/northstar.jsonl" \
+  python benchmarks/northstar.py --phase jax --variant native
+
 # 2. Compute-bound MFU on the real model sizes (VERDICT #2).
 run_job gpt2s 1200 "$OUT/bench_gpt2s.jsonl" \
   env BENCH_DEADLINE_S=900 BENCH_NO_CPU_FALLBACK=1 python bench.py --config gpt2-small-32k
@@ -161,13 +170,13 @@ run_job ts12l 600 "$OUT/bench_12l.jsonl" \
   env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 python bench.py --config tinystories-12l
 run_job tsmoe 600 "$OUT/bench_moe.jsonl" \
   env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 python bench.py --config tinystories-moe
-# Index-routed dispatch variant (same routing semantics; the dense one-hot
-# dispatch einsums cost ~2x the expert FFN at this shape).  Own capture
-# file (_gather suffix, ADVICE r3): each formulation keeps its own
-# best-of-N; bench_moe_dispatch.py below is the direct head-to-head, and
-# TINYSTORIES_MOE's default flips to gather only if the chip confirms it.
-run_job tsmoe_gather 600 "$OUT/bench_moe.jsonl" \
-  env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 BENCH_MOE_DISPATCH=gather \
+# Dense-dispatch variant (same routing semantics).  The chip confirmed
+# gather 118,025 vs einsum 69,896 tok/s on 2026-08-02, so TINYSTORIES_MOE
+# now DEFAULTS to gather (the plain job above measures it) and einsum is
+# the explicitly-suffixed variant (_einsum capture file), kept for the
+# head-to-head record alongside bench_moe_dispatch.py below.
+run_job tsmoe_einsum 600 "$OUT/bench_moe.jsonl" \
+  env BENCH_DEADLINE_S=420 BENCH_NO_CPU_FALLBACK=1 BENCH_MOE_DISPATCH=einsum \
   python bench.py --config tinystories-moe
 
 # 3. Attention kernel table, one length per invocation (VERDICT #3).

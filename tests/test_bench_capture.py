@@ -50,6 +50,30 @@ def test_capture_path_suffixes_every_guarded_knob(bench, monkeypatch):
     assert "_gather" in name and "_remat" in name
 
 
+def test_capture_path_moe_dispatch_default_tracks_preset(bench, monkeypatch):
+    """TINYSTORIES_MOE defaults to gather (chip-confirmed 2026-08-02), so
+    for the moe config BENCH_MOE_DISPATCH=gather is NOT a deviation and
+    einsum IS — the unsuffixed capture must always hold the run a bare
+    `bench.py --config tinystories-moe` would produce."""
+    bench.ARGS.config = "tinystories-moe"
+    bench.ARGS.batch = bench.BENCH_CONFIGS["tinystories-moe"][1]
+    assert bench._capture_path().name == "tpu_capture_tinystories-moe.json"
+    monkeypatch.setenv("BENCH_MOE_DISPATCH", "gather")
+    assert bench._capture_path().name == "tpu_capture_tinystories-moe.json"
+    monkeypatch.setenv("BENCH_MOE_DISPATCH", "einsum")
+    assert bench._capture_path().name == "tpu_capture_tinystories-moe_einsum.json"
+
+
+def test_preset_moe_dispatch_mirror_in_sync(bench):
+    """bench.py mirrors the presets' moe_dispatch without importing the
+    package (replay must not initialize jax); this is the sync check."""
+    from bpe_transformer_tpu import models
+
+    for name, (attr, *_rest) in bench.BENCH_CONFIGS.items():
+        preset = getattr(models, attr)
+        assert bench._preset_moe_dispatch(name) == preset.moe_dispatch, name
+
+
 def test_capture_path_remat_not_a_deviation_for_gpt2_medium(bench, monkeypatch):
     bench.ARGS.config = "gpt2-medium"
     bench.ARGS.batch = 16

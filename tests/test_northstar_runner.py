@@ -22,6 +22,7 @@ def northstar(monkeypatch, tmp_path):
     mod.EVAL_EVERY = 2
     mod.TORCH_JSON = tmp_path / "torch.json"
     mod.CAPTURE = tmp_path / "northstar.json"
+    mod.CAPTURE_NATIVE = tmp_path / "northstar_native.json"
     mod.CKPT = tmp_path / "scratch" / "ckpt.pkl"
     mod.LEGACY_CKPT = tmp_path / "legacy" / "ckpt.pkl"
     mod.TORCH_JSON.write_text(
@@ -68,6 +69,28 @@ def test_phase_jax_discards_mismatched_checkpoint(northstar):
     assert northstar.phase_jax(allow_cpu=True) == 0
     cap = json.loads(northstar.CAPTURE.read_text())
     assert len(cap["curve"]) == 2  # evals at steps 2 and 4: a FULL fresh run
+
+
+@pytest.mark.slow
+def test_phase_jax_native_variant_matches_parity_math(northstar):
+    """The native variant (scanned dispatch) must produce the SAME update
+    math as the per-step parity loop: on CPU both run at full f32 precision,
+    so the two curves agree to float tolerance.  Also pins the native
+    artifact's self-description (variant, steps_per_dispatch, own capture
+    file, own checkpoint name)."""
+    assert northstar.phase_jax(allow_cpu=True) == 0
+    assert northstar.phase_jax(allow_cpu=True, variant="native") == 0
+    parity = json.loads(northstar.CAPTURE.read_text())
+    native = json.loads(northstar.CAPTURE_NATIVE.read_text())
+    assert native["variant"] == "native"
+    assert native["steps_per_dispatch"] == northstar.EVAL_EVERY
+    assert parity.get("variant", "parity") == "parity"
+    # Same protocol, same init, same batches; CPU runs both at true f32 —
+    # the scan changes dispatch, not numerics.
+    for p_pt, n_pt in zip(parity["curve"], native["curve"]):
+        assert p_pt["step"] == n_pt["step"]
+        assert n_pt["val_loss"] == pytest.approx(p_pt["val_loss"], abs=1e-4)
+    assert not (northstar.CKPT.parent / f"native_{northstar.CKPT.name}").exists()
 
 
 @pytest.mark.slow
