@@ -234,6 +234,16 @@ def _save_capture() -> None:
         datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds")
     )
     payload.pop("note", None)
+    # Self-describing captures: a fresh accelerator measurement embeds its
+    # run manifest (git SHA, jax/device versions, host) so a capture found
+    # weeks later answers "what code produced this?".  Best-effort down to
+    # the import — manifest trouble never loses the measurement.
+    try:
+        from bpe_transformer_tpu.telemetry.manifest import attach_manifest
+
+        attach_manifest(payload, kind="bench", extra={"config": ARGS.config})
+    except Exception as exc:
+        print(f"manifest attach failed: {exc!r}", file=sys.stderr)
     # A fresh accelerator measurement that had no headroom for the torch
     # baseline must not clobber the ratio recorded by an earlier complete
     # capture: the torch-CPU baseline is stable across runs (same host,

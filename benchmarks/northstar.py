@@ -430,6 +430,17 @@ def phase_jax(allow_cpu: bool, variant: str = "parity") -> int:
         "speedup": round(jax_tps / torch_ref["tokens_per_sec"], 2),
         "captured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00", time.gmtime()),
     }
+    # Self-describing artifact: embed the run manifest (git SHA, jax/device
+    # versions, host) — best-effort down to the import, never at the cost
+    # of the measurement.
+    try:
+        from bpe_transformer_tpu.telemetry.manifest import attach_manifest
+
+        attach_manifest(
+            result, kind="northstar", model_config=cfg, extra={"variant": variant}
+        )
+    except Exception as exc:
+        print(f"manifest attach failed: {exc!r}", file=sys.stderr)
     capture_path.parent.mkdir(parents=True, exist_ok=True)
     _write_json(capture_path, result)
     print(json.dumps({k: result[k] for k in (

@@ -31,6 +31,30 @@ def _rows(path: Path):
         return
 
 
+def _manifest_line(m: dict | None) -> str | None:
+    """Compact provenance from a telemetry run-manifest record (the
+    ``kind="manifest"`` header every training/benchmark stream now writes,
+    also embedded as ``"manifest"`` in bench.py/northstar captures)."""
+    if not m:
+        return None
+    parts = [f"git={str(m.get('git_sha'))[:12]}"]
+    if m.get("jax_version"):
+        parts.append(f"jax={m['jax_version']}")
+    devices = m.get("devices") or {}
+    if devices:
+        parts.append(
+            f"{devices.get('count', '?')}x{devices.get('kind', '?')}"
+            f" ({devices.get('platform', '?')})"
+        )
+    if m.get("mesh"):
+        parts.append(f"mesh={m['mesh']}")
+    if m.get("parallel"):
+        parts.append(f"parallel={m['parallel']}")
+    if m.get("host"):
+        parts.append(f"host={m['host']}")
+    return "  ".join(parts)
+
+
 def main() -> int:
     if not CAP.exists():
         print("no captures directory", file=sys.stderr)
@@ -57,6 +81,9 @@ def main() -> int:
             # null (ADVICE r4), and None[:16] would kill the whole summary.
             f"  @{(c.get('captured_at_utc') or '?')[:16]}  [{', '.join(knobs)}]"
         )
+        provenance = _manifest_line(c.get("manifest"))
+        if provenance:
+            print(f"    {provenance}")
 
     ns = CAP / "northstar.json"
     print("== north star ==")
@@ -70,6 +97,9 @@ def main() -> int:
                 f"reached={c.get('reached_reference')}  "
                 f"speedup={c.get('speedup')}x  @{(c.get('captured_at_utc') or '?')[:16]}"
             )
+            provenance = _manifest_line(c.get("manifest"))
+            if provenance:
+                print(f"    {provenance}")
         except (OSError, json.JSONDecodeError, KeyError, TypeError) as exc:
             print(f"  unreadable ({exc!r})")
     else:
@@ -99,7 +129,14 @@ def main() -> int:
     ):
         path = CAP / name
         rows = list(_rows(path))
+        # Unified-telemetry streams open with a run-manifest header (and may
+        # close with a footer): surface the provenance once, keep the data
+        # rows as before.
+        manifests = [r for r in rows if r.get("kind") == "manifest"]
+        rows = [r for r in rows if r.get("kind") not in ("manifest", "footer")]
         print(f"== {name} ({len(rows)} rows) ==")
+        if manifests:
+            print(f"    {_manifest_line(manifests[-1])}")
         # 20, not 12: a full multicore host-tokenization grid is 14+ rows
         # and truncating it would cut the python-engine rows the
         # native-vs-python comparison needs (review r5).

@@ -44,17 +44,31 @@ def _multi_step_body(
     accum_steps: int,
     inner_steps: int,
     reduce_axis: str | None,
+    health: bool = False,
 ) -> tuple[Callable, bool]:
     """(body, stacked): the per-shard update body for the requested
     accumulation/scan mode, and whether batches carry a leading stacked dim
-    (``(accum|inner, micro_batch, seq)`` instead of ``(batch, seq)``)."""
+    (``(accum|inner, micro_batch, seq)`` instead of ``(batch, seq)``).
+
+    ``health`` threads through to the shared update bodies (see
+    ``training.train_step.train_step_fn``): the device-side health stats
+    compile inside the same sharded program, so their reductions reuse the
+    step's collectives and nothing new crosses the host boundary."""
     if accum_steps > 1 and inner_steps > 1:
         raise ValueError("accum_steps and inner_steps cannot both exceed 1")
     if accum_steps > 1:
-        return grad_accum_step_fn(config, hparams, accum_steps, reduce_axis), True
+        return (
+            grad_accum_step_fn(
+                config, hparams, accum_steps, reduce_axis, health=health
+            ),
+            True,
+        )
     if inner_steps > 1:
-        return scanned_step_fn(config, hparams, inner_steps, reduce_axis), True
-    return train_step_fn(config, hparams, reduce_axis), False
+        return (
+            scanned_step_fn(config, hparams, inner_steps, reduce_axis, health=health),
+            True,
+        )
+    return train_step_fn(config, hparams, reduce_axis, health=health), False
 
 
 def make_dp_train_step(
@@ -64,6 +78,7 @@ def make_dp_train_step(
     axis: str = "data",
     accum_steps: int = 1,
     inner_steps: int = 1,
+    health: bool = False,
 ) -> Callable:
     """Data-parallel step with an explicit gradient all-reduce over ``axis``.
 
@@ -78,9 +93,11 @@ def make_dp_train_step(
     with its own all-reduce; batches are ``(inner_steps, batch, seq)``.
     """
     body, stacked = _multi_step_body(
-        config, hparams, accum_steps, inner_steps, reduce_axis=axis
+        config, hparams, accum_steps, inner_steps, reduce_axis=axis, health=health
     )
     batch_spec = P(None, axis) if stacked else P(axis)
+    # out_specs are pytree PREFIXES: the final P() covers the whole metrics
+    # dict, whatever keys (health sub-dicts included) the body emits.
     mapped = jax.shard_map(
         body,
         mesh=mesh,
@@ -99,6 +116,7 @@ def make_gspmd_train_step(
     example_params=None,
     accum_steps: int = 1,
     inner_steps: int = 1,
+    health: bool = False,
 ) -> Callable:
     """Sharding-annotated jit step; XLA derives the collective schedule.
 
@@ -114,7 +132,7 @@ def make_gspmd_train_step(
     if example_params is None:
         raise ValueError("example_params is required to derive shardings")
     body, stacked = _multi_step_body(
-        config, hparams, accum_steps, inner_steps, reduce_axis=None
+        config, hparams, accum_steps, inner_steps, reduce_axis=None, health=health
     )
     p_sh = param_shardings(example_params, mesh, strategy)
     replicated = NamedSharding(mesh, P())
@@ -123,12 +141,14 @@ def make_gspmd_train_step(
     batch_sh = (
         NamedSharding(mesh, data_spec) if "data" in mesh.shape else replicated
     )
-    metrics_sh = {"loss": replicated, "lr": replicated, "grad_norm": replicated}
 
+    # The metrics out-sharding is a pytree PREFIX: one replicated sharding
+    # covers the whole dict regardless of which keys (health sub-dicts
+    # included) the body emits — all metrics are scalars.
     return jax.jit(
         body,
         in_shardings=(p_sh, opt_sh, batch_sh, batch_sh),
-        out_shardings=(p_sh, opt_sh, metrics_sh),
+        out_shardings=(p_sh, opt_sh, replicated),
         donate_argnums=(0, 1),
     )
 

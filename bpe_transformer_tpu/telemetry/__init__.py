@@ -1,0 +1,70 @@
+"""Unified telemetry subsystem: one stream tells the whole story of a run.
+
+Subsumes and extends the old ``utils.metrics`` / ``utils.profiling`` pair
+(both kept as re-export shims).  The pieces:
+
+- `sinks` — ``MetricsLogger``: stdout / JSONL / wandb fan-out, the single
+  write path every record kind shares;
+- `spans` — ``Telemetry``: nested wall-clock spans and point events emitted
+  as structured records alongside step metrics;
+- `manifest` — ``run_manifest``: the self-describing header record (config,
+  mesh, jax/device versions, git SHA, host);
+- `health` — device-side health stats computed INSIDE the jitted train step
+  (non-finite detection, per-layer-group grad/param norms, MoE load
+  balance), fetched with the existing once-per-``log_every`` sync;
+- `watchdog` — hung-step detection against the trailing median step time
+  plus the "dump state + raise or skip" non-finite policy;
+- `timing` — ``StepTimer`` throughput/MFU windows, ``profile_trace``,
+  ``time_fn``;
+- `report` — the jax-free ``bpe-tpu report`` summarizer.
+"""
+
+from bpe_transformer_tpu.telemetry.manifest import git_sha, run_manifest
+from bpe_transformer_tpu.telemetry.report import nonfinite_fields
+from bpe_transformer_tpu.telemetry.sinks import MetricsLogger
+from bpe_transformer_tpu.telemetry.spans import Telemetry
+from bpe_transformer_tpu.telemetry.watchdog import NonFiniteError, Watchdog
+
+#: `health` and `timing` import jax at module load; they resolve lazily
+#: (PEP 562) so the jax-free members above — most importantly the report
+#: tool — stay importable on hosts with no accelerator runtime, matching
+#: the package root's lazy-subpackage design.
+_LAZY_SUBMODULE = {
+    "flatten_health": "health",
+    "group_norms": "health",
+    "health_metrics": "health",
+    "nonfinite_count": "health",
+    "StepTimer": "timing",
+    "profile_trace": "timing",
+    "time_fn": "timing",
+}
+
+
+def __getattr__(name: str):
+    submodule = _LAZY_SUBMODULE.get(name)
+    if submodule is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(
+        importlib.import_module(f"bpe_transformer_tpu.telemetry.{submodule}"), name
+    )
+    globals()[name] = value  # cache: resolve once per process
+    return value
+
+__all__ = [
+    "MetricsLogger",
+    "NonFiniteError",
+    "StepTimer",
+    "Telemetry",
+    "Watchdog",
+    "flatten_health",
+    "git_sha",
+    "group_norms",
+    "health_metrics",
+    "nonfinite_count",
+    "nonfinite_fields",
+    "profile_trace",
+    "run_manifest",
+    "time_fn",
+]

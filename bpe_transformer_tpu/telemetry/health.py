@@ -1,0 +1,109 @@
+"""Device-side health stats, computed INSIDE the jitted train step.
+
+The hot path's observability problem is sync cost: any per-step host
+readback serializes dispatch.  These stats sidestep that by being ordinary
+device scalars appended to the step's ``metrics`` dict — they ride the
+existing once-per-``log_every`` metric fetch, so an opt-in health-enabled
+step costs a handful of extra reductions per step on-device and ZERO extra
+host syncs.  Disabled (the default), the step is byte-identical to before.
+
+What is computed (`health_metrics`):
+
+- non-finite detection: a 0/1 flag for the loss plus element counts over the
+  gradient and (post-update) parameter trees — a NaN/Inf anywhere surfaces
+  at the next log boundary, with enough signal to tell WHERE (loss vs grads
+  vs optimizer state corruption);
+- per-layer-group grad/param L2 norms: leaves are bucketed into ``embed`` /
+  ``attn`` / ``ffn`` / ``norm`` / ``head`` groups (the canonical places
+  training instabilities localize), giving a 5-number norm profile instead
+  of the single global ``grad_norm``;
+- MoE expert-load balance: the router's Switch-style load-balance loss
+  (``n_experts * sum_e f_e * P_e``; exactly 1.0 at perfectly uniform
+  routing) is exported as ``moe_aux`` by the health-enabled train step.
+
+Host-side, :func:`flatten_health` turns the nested device dict into flat
+JSONL-friendly keys (``grad_norm/attn``, ``nonfinite_grads``);
+``telemetry.report.nonfinite_fields`` (jax-free, shared with the report
+tool) picks out what the watchdog should fire on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: Substring -> group, checked in order against the leaf's key path (the
+#: first match wins; "ln" must come after the more specific names so
+#: e.g. a hypothetical "attn_ln" still buckets as attn).
+_GROUP_PATTERNS: tuple[tuple[str, str], ...] = (
+    ("attn", "attn"),
+    ("ffn", "ffn"),
+    ("token_embeddings", "embed"),
+    ("lm_head", "head"),
+    ("ln", "norm"),
+)
+
+
+def group_of(key_path: str) -> str:
+    """Layer-group bucket for a param-tree key path string."""
+    for pattern, group in _GROUP_PATTERNS:
+        if pattern in key_path:
+            return group
+    return "other"
+
+
+def group_norms(tree) -> dict:
+    """Per-layer-group L2 norms of a pytree, as a dict of f32 scalars.
+
+    Accumulates squared sums in f32 (bf16 squares overflow at moderate
+    norms) and groups by :func:`group_of` over the key path — static at
+    trace time, so this adds only reduction ops to the jitted program.
+    """
+    sums: dict = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        group = group_of(jax.tree_util.keystr(path))
+        sq = jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        sums[group] = sums.get(group, 0.0) + sq
+    return {group: jnp.sqrt(total) for group, total in sorted(sums.items())}
+
+
+def nonfinite_count(tree) -> jax.Array:
+    """Total count of non-finite elements across all leaves (i32 scalar)."""
+    total = jnp.zeros((), jnp.int32)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total = total + jnp.sum(~jnp.isfinite(leaf)).astype(jnp.int32)
+    return total
+
+
+def health_metrics(loss, grads, params) -> dict:
+    """The device-side health sub-dict for a train step's metrics.
+
+    ``params`` should be the POST-update tree so optimizer-produced
+    non-finites (e.g. a zero-gradient leaf with ``eps=0``) are caught the
+    same step they appear.
+    """
+    return {
+        "nonfinite_loss": (~jnp.isfinite(loss)).astype(jnp.int32),
+        "nonfinite_grads": nonfinite_count(grads),
+        "nonfinite_params": nonfinite_count(params),
+        "grad_norms": group_norms(grads),
+        "param_norms": group_norms(params),
+    }
+
+
+def flatten_health(health: dict) -> dict:
+    """Host-side: nested (fetched) health metrics -> flat JSONL keys.
+
+    ``{"grad_norms": {"attn": x}}`` becomes ``{"grad_norm/attn": x}``; counts
+    become ints, norms floats.
+    """
+    flat: dict = {}
+    for key in ("nonfinite_loss", "nonfinite_grads", "nonfinite_params"):
+        if key in health:
+            flat[key] = int(health[key])
+    for src, prefix in (("grad_norms", "grad_norm"), ("param_norms", "param_norm")):
+        for group, value in health.get(src, {}).items():
+            flat[f"{prefix}/{group}"] = float(value)
+    if "moe_aux" in health:
+        flat["moe_aux"] = float(health["moe_aux"])
+    return flat

@@ -1,0 +1,306 @@
+"""``bpe-tpu report``: turn a metrics.jsonl into a human-readable summary.
+
+Pure host-side file parsing — no jax import — so it runs anywhere (a laptop
+reading a capture pulled off a TPU pod, CI summarizing a smoke run).  The
+input is the unified telemetry stream one run writes: an optional manifest
+header, step-metric records, span/event records, and a footer.
+
+    bpe-tpu report run/metrics.jsonl
+    python -m bpe_transformer_tpu.telemetry.report run/metrics.jsonl
+
+Sections: run manifest, loss-curve stats, throughput/MFU trajectory, span
+breakdown, health summary, and an anomaly list (non-finite records, loss
+spikes, watchdog/NaN events, a missing or unclean footer).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from pathlib import Path
+
+
+def nonfinite_fields(record: dict) -> list[str]:
+    """The flat-record health fields indicating a non-finite state (empty
+    list = healthy).  Norm/loss fields are also value-checked: a NaN norm
+    means the non-finite value appeared in a record that predates the count
+    fields (or between reductions).  Lives here, not in `telemetry.health`,
+    so the report tool stays importable without jax."""
+    bad = [
+        key
+        for key in ("nonfinite_loss", "nonfinite_grads", "nonfinite_params")
+        if record.get(key)
+    ]
+    bad += [
+        key
+        for key, value in record.items()
+        if (
+            key.startswith(("grad_norm/", "param_norm/"))
+            or key in ("loss", "grad_norm")
+        )
+        and isinstance(value, float)
+        and not math.isfinite(value)
+    ]
+    return bad
+
+
+def load_records(path: str | Path) -> list[dict]:
+    """Parse a JSONL file, skipping blank/corrupt lines (a crash mid-write
+    must not make the evidence unreadable)."""
+    records = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(record, dict):
+                    records.append(record)
+    except OSError:
+        return []
+    return records
+
+
+def _stats(values: list[float]) -> dict:
+    finite = [v for v in values if isinstance(v, (int, float)) and math.isfinite(v)]
+    if not finite:
+        return {}
+    return {
+        "first": finite[0],
+        "last": finite[-1],
+        "min": min(finite),
+        "max": max(finite),
+        "mean": sum(finite) / len(finite),
+    }
+
+
+def _loss_spikes(steps: list[dict], ratio: float = 1.5) -> list[dict]:
+    """Step pairs where the logged loss jumped by more than ``ratio``x —
+    the classic instability signature between two log boundaries."""
+    spikes = []
+    prev = None
+    for record in steps:
+        loss = record.get("loss")
+        if not isinstance(loss, (int, float)):
+            continue
+        if not math.isfinite(loss):
+            prev = None
+            continue
+        if prev is not None and prev["loss"] > 0 and loss > prev["loss"] * ratio:
+            spikes.append(
+                {"step": record.get("step"), "loss": loss, "prev_loss": prev["loss"]}
+            )
+        prev = {"step": record.get("step"), "loss": loss}
+    return spikes
+
+
+def summarize(records: list[dict]) -> dict:
+    """Machine-readable summary of a telemetry stream (the report's data)."""
+    manifests = [r for r in records if r.get("kind") == "manifest"]
+    # LAST manifest wins (matching benchmarks/summarize_captures.py): a
+    # resumed run appends a fresh header to the same file, and the newest
+    # one describes the code/devices that produced the trailing records.
+    manifest = manifests[-1] if manifests else None
+    footer = next((r for r in reversed(records) if r.get("kind") == "footer"), None)
+    spans = [r for r in records if r.get("kind") == "span"]
+    events = [r for r in records if r.get("kind") == "event"]
+    steps = [r for r in records if "kind" not in r and "step" in r and "loss" in r]
+    vals = [r for r in records if "kind" not in r and "val_loss" in r]
+
+    span_breakdown: dict = {}
+    for span in spans:
+        entry = span_breakdown.setdefault(
+            span.get("path", span.get("name", "?")), {"n": 0, "total_s": 0.0, "max_s": 0.0}
+        )
+        dur = span.get("dur_s") or 0.0
+        entry["n"] += 1
+        entry["total_s"] += dur
+        entry["max_s"] = max(entry["max_s"], dur)
+
+    anomalies: list[str] = []
+    for record in steps:
+        bad = nonfinite_fields(record)
+        if bad:
+            anomalies.append(
+                f"non-finite state at step {record.get('step')}: {', '.join(bad)}"
+            )
+    for record in vals:
+        v = record.get("val_loss")
+        if isinstance(v, (int, float)) and not math.isfinite(v):
+            anomalies.append(
+                f"non-finite val_loss at step {record.get('step')}"
+            )
+    for spike in _loss_spikes(steps):
+        anomalies.append(
+            f"loss spike at step {spike['step']}: "
+            f"{spike['prev_loss']:.4g} -> {spike['loss']:.4g}"
+        )
+    for event in events:
+        if event.get("name") in ("nonfinite", "watchdog_hang"):
+            anomalies.append(
+                f"{event['name']} event"
+                + (f" at step {event['step']}" if event.get("step") is not None else "")
+                + (f" (silent {event['silent_s']}s)" if "silent_s" in event else "")
+            )
+    if steps and footer is None:
+        anomalies.append("no footer record — the run did not shut down cleanly")
+    elif footer is not None and footer.get("clean") is False:
+        anomalies.append("footer reports an unclean run")
+
+    health_last = {}
+    for record in steps:
+        for key, value in record.items():
+            if key.startswith(("grad_norm/", "param_norm/")) or key in (
+                "moe_aux",
+                "nonfinite_loss",
+                "nonfinite_grads",
+                "nonfinite_params",
+            ):
+                health_last[key] = value
+
+    return {
+        "manifest": manifest,
+        "n_manifests": len(manifests),
+        "n_records": len(records),
+        "steps": {
+            "n": len(steps),
+            "step_range": [steps[0].get("step"), steps[-1].get("step")] if steps else None,
+            "loss": _stats([r.get("loss") for r in steps]),
+            "grad_norm": _stats([r["grad_norm"] for r in steps if "grad_norm" in r]),
+            "lr": _stats([r["lr"] for r in steps if "lr" in r]),
+        },
+        "val_loss": _stats([r["val_loss"] for r in vals]),
+        "throughput": {
+            "tokens_per_sec": _stats(
+                [r["tokens_per_sec"] for r in steps if "tokens_per_sec" in r]
+            ),
+            "step_wall_s": _stats([r["step_wall_s"] for r in steps if "step_wall_s" in r]),
+            "mfu": _stats([r["mfu"] for r in steps if "mfu" in r]),
+        },
+        "spans": span_breakdown,
+        "health_last": health_last,
+        "events": [e.get("name") for e in events],
+        "footer": footer,
+        "anomalies": anomalies,
+    }
+
+
+def _fmt(value, digits=4) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value:,.{digits}g}"
+    return str(value)
+
+
+def render_report(records: list[dict]) -> str:
+    """The human-readable report text for a parsed telemetry stream."""
+    s = summarize(records)
+    lines: list[str] = []
+
+    manifest = s["manifest"]
+    lines.append("== run manifest ==")
+    if manifest:
+        devices = manifest.get("devices") or {}
+        mesh = manifest.get("mesh")
+        lines.append(
+            f"  kind={manifest.get('run_kind')}  time={manifest.get('time_utc')}"
+            f"  host={manifest.get('host')}  git={str(manifest.get('git_sha'))[:12]}"
+        )
+        lines.append(
+            f"  jax={manifest.get('jax_version', '?')}  "
+            f"devices={devices.get('count', '?')}x{devices.get('kind', '?')}"
+            f" ({devices.get('platform', '?')})"
+            + (f"  mesh={mesh}" if mesh else "")
+            + (f"  parallel={manifest.get('parallel')}" if manifest.get("parallel") else "")
+        )
+        if s["n_manifests"] > 1:
+            lines.append(
+                f"  (latest of {s['n_manifests']} manifests — "
+                "resumed/appended stream; step stats span all segments)"
+            )
+    else:
+        lines.append("  (no manifest record)")
+
+    st = s["steps"]
+    lines.append(f"== steps ({st['n']} records) ==")
+    if st["n"]:
+        loss = st["loss"]
+        lines.append(
+            f"  steps {st['step_range'][0]}..{st['step_range'][1]}  "
+            f"loss {_fmt(loss.get('first'))} -> {_fmt(loss.get('last'))}"
+            f"  (min {_fmt(loss.get('min'))})"
+        )
+        if st["grad_norm"]:
+            lines.append(
+                f"  grad_norm last {_fmt(st['grad_norm'].get('last'))}"
+                f"  max {_fmt(st['grad_norm'].get('max'))}"
+            )
+    if s["val_loss"]:
+        v = s["val_loss"]
+        lines.append(
+            f"  val_loss {_fmt(v.get('first'))} -> {_fmt(v.get('last'))}"
+            f"  (best {_fmt(v.get('min'))})"
+        )
+
+    tp = s["throughput"]
+    if tp["tokens_per_sec"]:
+        t = tp["tokens_per_sec"]
+        lines.append("== throughput ==")
+        lines.append(
+            f"  tokens/sec {_fmt(t.get('first'), 6)} -> {_fmt(t.get('last'), 6)}"
+            f"  (peak {_fmt(t.get('max'), 6)}, mean {_fmt(t.get('mean'), 6)})"
+        )
+        if tp["step_wall_s"]:
+            lines.append(f"  step wall time mean {_fmt(tp['step_wall_s'].get('mean'))}s")
+        if tp["mfu"]:
+            lines.append(
+                f"  mfu {_fmt(tp['mfu'].get('last'))} (peak {_fmt(tp['mfu'].get('max'))})"
+            )
+
+    if s["spans"]:
+        lines.append("== spans ==")
+        for path, entry in sorted(
+            s["spans"].items(), key=lambda kv: -kv[1]["total_s"]
+        ):
+            lines.append(
+                f"  {path:<28s} n={entry['n']:<4d} total {entry['total_s']:.3f}s"
+                f"  max {entry['max_s']:.3f}s"
+            )
+
+    if s["health_last"]:
+        lines.append("== health (last logged) ==")
+        for key in sorted(s["health_last"]):
+            lines.append(f"  {key} = {_fmt(s['health_last'][key])}")
+
+    lines.append(f"== anomalies ({len(s['anomalies'])}) ==")
+    for anomaly in s["anomalies"]:
+        lines.append(f"  ! {anomaly}")
+    if not s["anomalies"]:
+        footer = s["footer"]
+        verdict = "clean footer" if footer and footer.get("clean") else "none detected"
+        lines.append(f"  {verdict}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    if len(args) != 1:
+        print("usage: python -m bpe_transformer_tpu.telemetry.report metrics.jsonl",
+              file=sys.stderr)
+        return 2
+    records = load_records(args[0])
+    if not records:
+        print(f"no readable records in {args[0]}", file=sys.stderr)
+        return 1
+    print(render_report(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,6 +106,19 @@ def cmd_tokenize(args) -> int:
     return 0
 
 
+def _maybe_profile_trace(logdir: str | None):
+    """A ``jax.profiler`` trace context when ``--profile-trace DIR`` was
+    given, else a no-op — so command bodies wrap their hot section
+    unconditionally."""
+    if logdir is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    from bpe_transformer_tpu.telemetry import profile_trace
+
+    return profile_trace(logdir)
+
+
 def cmd_train(args) -> int:
     from bpe_transformer_tpu.data import load_token_file
     from bpe_transformer_tpu.training.loop import LoopConfig, train
@@ -135,6 +148,10 @@ def cmd_train(args) -> int:
         checkpoint_dir=args.checkpoint_dir,
         metrics_jsonl=args.metrics_jsonl,
         wandb_project=args.wandb_project,
+        health_stats=args.health_stats,
+        watchdog=args.watchdog,
+        watchdog_factor=args.watchdog_factor,
+        watchdog_policy=args.watchdog_policy,
         seed=args.seed,
         parallel=args.parallel,
         mesh_axes=mesh_axes,
@@ -147,14 +164,15 @@ def cmd_train(args) -> int:
     )
     train_data = load_token_file(args.data, args.dtype)
     val_data = load_token_file(args.val_data, args.dtype) if args.val_data else None
-    summary = train(
-        model_config,
-        hparams,
-        loop,
-        train_data,
-        val_data,
-        resume_from=args.resume,
-    )
+    with _maybe_profile_trace(args.profile_trace):
+        summary = train(
+            model_config,
+            hparams,
+            loop,
+            train_data,
+            val_data,
+            resume_from=args.resume,
+        )
     print(json.dumps({k: v for k, v in summary.items() if k != "history"}))
     return 0
 
@@ -196,19 +214,28 @@ def cmd_generate(args) -> int:
             model_config, decode_attention_impl=args.decode_attention
         )
     tokenizer = _load_tokenizer(args.tokenizer_dir, _specials(args))
-    text = generate_text(
-        payload["params"],
-        model_config,
-        tokenizer,
-        prompt=args.prompt,
-        max_new_tokens=args.max_new_tokens,
-        temperature=args.temperature,
-        top_k=args.top_k,
-        top_p=args.top_p,
-        seed=args.seed,
-    )
+    with _maybe_profile_trace(args.profile_trace):
+        text = generate_text(
+            payload["params"],
+            model_config,
+            tokenizer,
+            prompt=args.prompt,
+            max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            seed=args.seed,
+        )
     print(text)
     return 0
+
+
+def cmd_report(args) -> int:
+    # Pure host-side file parsing (telemetry.report imports no jax): safe on
+    # a laptop reading a metrics.jsonl pulled off a TPU pod.
+    from bpe_transformer_tpu.telemetry.report import main as report_main
+
+    return report_main([args.metrics])
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -257,6 +284,36 @@ def build_parser() -> argparse.ArgumentParser:
                    help="append step metrics as JSON lines to this file")
     p.add_argument("--wandb-project", default=None,
                    help="log metrics to this wandb project (requires wandb)")
+    p.add_argument(
+        "--health-stats",
+        action="store_true",
+        help="compute device-side health stats inside the jitted step "
+        "(non-finite loss/grad/param detection, per-layer-group grad/param "
+        "norms, MoE expert balance) and log them every --log-every; opt-in "
+        "— the default step is unchanged",
+    )
+    p.add_argument(
+        "--watchdog",
+        action="store_true",
+        help="flag hung steps (no metric sync within --watchdog-factor x "
+        "the trailing median step time) and apply --watchdog-policy to "
+        "non-finite states detected at a log boundary",
+    )
+    p.add_argument("--watchdog-factor", type=float, default=10.0)
+    p.add_argument(
+        "--watchdog-policy",
+        choices=["raise", "skip"],
+        default="raise",
+        help='"raise": dump state to the telemetry stream then stop; '
+        '"skip": record the event and keep training',
+    )
+    p.add_argument(
+        "--profile-trace",
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler trace of the run under DIR "
+        "(view with tensorboard --logdir DIR)",
+    )
     p.add_argument("--resume", default=None)
     p.add_argument(
         "--parallel",
@@ -345,7 +402,21 @@ def build_parser() -> argparse.ArgumentParser:
         "kernel (TPU; interpret mode elsewhere); default keeps the "
         "portable xla path",
     )
+    p.add_argument(
+        "--profile-trace",
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler trace of the generation under DIR",
+    )
     p.set_defaults(fn=cmd_generate)
+
+    p = sub.add_parser(
+        "report",
+        help="summarize a telemetry metrics.jsonl (loss/throughput/MFU "
+        "stats, span breakdown, anomaly list); no accelerator needed",
+    )
+    p.add_argument("metrics", help="path to a metrics.jsonl telemetry stream")
+    p.set_defaults(fn=cmd_report)
 
     return parser
 

@@ -9,6 +9,7 @@ and tokens/sec/chip accounting.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import math
@@ -32,8 +33,15 @@ from bpe_transformer_tpu.training.train_step import (
     make_eval_step,
     make_train_step,
 )
-from bpe_transformer_tpu.utils.metrics import MetricsLogger
-from bpe_transformer_tpu.utils.profiling import StepTimer
+from bpe_transformer_tpu.telemetry import (
+    MetricsLogger,
+    StepTimer,
+    Telemetry,
+    Watchdog,
+    flatten_health,
+    nonfinite_fields,
+    run_manifest,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,10 +53,26 @@ class LoopConfig:
     eval_batches: int = 8
     checkpoint_every: int = 1000
     checkpoint_dir: str | None = None
-    #: Optional observability sinks (utils.metrics): JSONL file of step
+    #: Optional observability sinks (telemetry.sinks): JSONL file of step
     #: records, and a wandb project (gated import — only used when set).
+    #: The JSONL stream is the unified telemetry stream: a run-manifest
+    #: header, step records, span/event records, and a footer.
     metrics_jsonl: str | None = None
     wandb_project: str | None = None
+    #: Compute device-side health stats inside the jitted step (telemetry.
+    #: health: non-finite detection, per-layer-group grad/param norms, MoE
+    #: load balance) and log them at every log_every sync.  Opt-in: the
+    #: default step is byte-identical to before.  Not supported with
+    #: parallel="sp"/"pp" (those strategies build their own update bodies).
+    health_stats: bool = False
+    #: Enable the telemetry watchdog: a background thread flags hung steps
+    #: (no metric sync within watchdog_factor x the trailing median step
+    #: time), and non-finite states detected at a log boundary follow
+    #: watchdog_policy — "raise" (dump state to the telemetry stream, then
+    #: raise NonFiniteError) or "skip" (record the event and keep going).
+    watchdog: bool = False
+    watchdog_factor: float = 10.0
+    watchdog_policy: str = "raise"
     seed: int = 0
     #: None -> single device; "dp" -> shard_map psum; "sp" -> context
     #: parallelism (ring attention over a data x seq mesh); "pp" -> GPipe
@@ -109,6 +133,25 @@ def train(
         shard_params,
         shard_sp_batch,
     )
+
+    # The telemetry narrator exists from the first line so setup work is
+    # spanned; records are buffered until the sinks exist (attach below).
+    telemetry = Telemetry()
+    setup_span = telemetry.start_span("setup")
+
+    if loop.health_stats and loop.parallel in ("sp", "pp"):
+        raise ValueError(
+            f'health_stats is not supported with parallel="{loop.parallel}" '
+            "(sp/pp build their own update bodies); drop --health-stats or "
+            "use a dp/GSPMD strategy"
+        )
+    if loop.watchdog and loop.watchdog_policy not in Watchdog.POLICIES:
+        # Validate BEFORE any sink opens: a bad policy must not leak an open
+        # JSONL handle or an unfinished wandb run.
+        raise ValueError(
+            f"watchdog_policy must be one of {Watchdog.POLICIES}, "
+            f"got {loop.watchdog_policy!r}"
+        )
 
     mesh = None
     if loop.parallel is not None:
@@ -276,6 +319,7 @@ def train(
             lambda b: shard_batch(b, mesh, stacked=stacked_batches),
             lambda b: shard_batch(b, mesh),
         )
+    health = loop.health_stats
     if mesh is None:
         def build_step(n=stride):
             if n > 1:
@@ -283,21 +327,24 @@ def train(
                     make_scanned_train_step,
                 )
 
-                return make_scanned_train_step(model_config, hparams, n)
+                return make_scanned_train_step(model_config, hparams, n, health=health)
             if accum > 1:
                 from bpe_transformer_tpu.training.train_step import (
                     make_grad_accum_train_step,
                 )
 
-                return make_grad_accum_train_step(model_config, hparams, accum)
-            return make_train_step(model_config, hparams)
+                return make_grad_accum_train_step(
+                    model_config, hparams, accum, health=health
+                )
+            return make_train_step(model_config, hparams, health=health)
 
         step_fn = build_step()
         place = place_plain = lambda b: b
     elif loop.parallel == "dp":
         def build_step(n=stride):
             return make_dp_train_step(
-                model_config, hparams, mesh, accum_steps=accum, inner_steps=n
+                model_config, hparams, mesh, accum_steps=accum, inner_steps=n,
+                health=health,
             )
 
         step_fn = build_step()
@@ -341,6 +388,7 @@ def train(
                 example_params=params,
                 accum_steps=accum,
                 inner_steps=n,
+                health=health,
             )
 
         step_fn = build_step()
@@ -363,47 +411,99 @@ def train(
     def run_eval() -> float:
         if val_data is None:
             return float("nan")
-        eval_params = params
-        if loop.parallel == "pp":
-            # Eval reuses the dense single-program forward; pull the stacked
-            # stages back to host, restore the layer-list layout, and upload
-            # ONCE so the batch loop below doesn't re-transfer per batch.
-            from bpe_transformer_tpu.parallel.pp import unstack_pipeline_params
+        handle = telemetry.start_span(
+            "eval", step=iteration, batches=loop.eval_batches
+        )
+        try:
+            eval_params = params
+            if loop.parallel == "pp":
+                # Eval reuses the dense single-program forward; pull the
+                # stacked stages back to host, restore the layer-list
+                # layout, and upload ONCE so the batch loop below doesn't
+                # re-transfer per batch.
+                from bpe_transformer_tpu.parallel.pp import unstack_pipeline_params
 
-            eval_params = jax.device_put(
-                unstack_pipeline_params(jax.device_get(params))
-            )
-        eval_rng = np.random.default_rng(loop.seed + 1)
-        losses = []
-        for _ in range(loop.eval_batches):
-            ex, ey = get_batch(
-                val_data, loop.batch_size, model_config.context_length, eval_rng
-            )
-            ex, ey = (jax.numpy.asarray(ex), jax.numpy.asarray(ey))
-            if loop.parallel == "sp":
-                # Eval runs the DENSE forward, which needs sequences in
-                # global order — place without the zig-zag permutation even
-                # when training uses it.
-                ex, ey = shard_sp_batch((ex, ey), mesh)
-            elif loop.parallel != "pp":
-                # Eval batches are plain (B, S) — never the stacked
-                # grad-accum/inner-steps layout the train `place` expects.
-                ex, ey = place_plain((ex, ey))
-            losses.append(float(eval_step(eval_params, ex, ey)))
-        return float(np.mean(losses))
+                eval_params = jax.device_put(
+                    unstack_pipeline_params(jax.device_get(params))
+                )
+            eval_rng = np.random.default_rng(loop.seed + 1)
+            losses = []
+            for _ in range(loop.eval_batches):
+                ex, ey = get_batch(
+                    val_data, loop.batch_size, model_config.context_length, eval_rng
+                )
+                ex, ey = (jax.numpy.asarray(ex), jax.numpy.asarray(ey))
+                if loop.parallel == "sp":
+                    # Eval runs the DENSE forward, which needs sequences in
+                    # global order — place without the zig-zag permutation
+                    # even when training uses it.
+                    ex, ey = shard_sp_batch((ex, ey), mesh)
+                elif loop.parallel != "pp":
+                    # Eval batches are plain (B, S) — never the stacked
+                    # grad-accum/inner-steps layout the train `place`
+                    # expects.
+                    ex, ey = place_plain((ex, ey))
+                losses.append(float(eval_step(eval_params, ex, ey)))
+            return float(np.mean(losses))
+        finally:
+            # Eval time is not step time: discount it from the throughput
+            # window so tokens/sec and step_wall_s describe training steps.
+            timer.exclude(handle.end())
 
     history: list[dict] = []
-    timer = StepTimer(n_chips=n_chips)
+    from bpe_transformer_tpu.utils.flops import train_step_flops
+
+    timer = StepTimer(
+        n_chips=n_chips,
+        flops_per_token=train_step_flops(model_config, loop.batch_size)
+        / tokens_per_step,
+    )
     sinks = MetricsLogger(
         jsonl_path=loop.metrics_jsonl, wandb_project=loop.wandb_project
     )
+    # Attach the sinks and write the run-manifest header FIRST, so every
+    # JSONL this loop produces is self-describing (config, mesh, versions,
+    # git SHA) before any metric lands in it.
+    telemetry.attach(sinks.log)
+    telemetry.emit(
+        run_manifest(
+            kind="train",
+            model_config=model_config,
+            loop_config=loop,
+            mesh=mesh,
+            parallel=loop.parallel,
+            extra={"start_iteration": start_iteration, "n_chips": n_chips},
+        )
+    )
+    wd = None
+    if loop.watchdog:
+        wd = Watchdog(
+            factor=loop.watchdog_factor,
+            steps_per_beat=loop.log_every,
+            policy=loop.watchdog_policy,
+            telemetry=telemetry,
+        )
+        wd.start()
+
+    def wd_pause():
+        """Suspend hang detection around a known long phase (compile, eval,
+        synchronous checkpoint save); no-op without a watchdog."""
+        return wd.pause() if wd is not None else contextlib.nullcontext()
     last_loss = float("nan")
     val_loss = float("nan")
+    first_dispatch = True
+    prev_sync_iteration = start_iteration
+    excluded_steps = 0
+    clean_exit = False
 
     # finally-close so an interrupt/OOM mid-run still flushes the JSONL
     # handle and finishes the wandb run.
+    iteration = start_iteration
     try:
-        iteration = start_iteration
+        setup_span.end()
+        # Discard the window accumulated since StepTimer construction —
+        # sink/manifest/watchdog setup is not step time.
+        timer.snapshot()
         while iteration < loop.steps:
             # Per-iteration seeding (not one stream advanced per step) so a
             # resumed run samples the SAME batch at the same iteration as an
@@ -421,6 +521,11 @@ def train(
                 ]
                 if n != stride:  # tail shorter than the compiled scan length
                     step_fn = build_step(n)
+                    # The rebuilt step pays a fresh jit compile on dispatch:
+                    # route it through the same span/exclusion/pause path as
+                    # the first step so it can't pollute throughput or trip
+                    # the watchdog.
+                    first_dispatch = True
                 if n == 1:
                     # A 1-step tail is a plain step (build_step(1)): feed the
                     # unstacked (B, S) layout it expects.
@@ -442,40 +547,99 @@ def train(
                     x = x.reshape(accum, micro, -1)
                     y = y.reshape(accum, micro, -1)
                 x, y = place((jax.numpy.asarray(x), jax.numpy.asarray(y)))
-            params, opt_state, metrics = step_fn(params, opt_state, x, y)
-            timer.update(tokens_per_step * n)
+            if first_dispatch:
+                # The first dispatch of a (re)built step pays the jit
+                # compile; span it (with a sync fence so the span measures
+                # compile + first step, not just async dispatch), keep it
+                # out of the throughput window — logged tokens/sec should
+                # be steady state, not compile-dominated — and pause the
+                # watchdog (a tail recompile happens with an armed
+                # step-time median a long compile would trip).
+                handle = telemetry.start_span("compile_first_step", step=iteration)
+                with wd_pause():
+                    params, opt_state, metrics = step_fn(params, opt_state, x, y)
+                    jax.block_until_ready(metrics["loss"])
+                timer.exclude(handle.end())
+                # Warmup step(s): neither their tokens nor their step count
+                # enter the window — excluding only the time would credit
+                # tokens against ~zero elapsed and over-report throughput.
+                excluded_steps += n
+                first_dispatch = False
+            else:
+                params, opt_state, metrics = step_fn(params, opt_state, x, y)
+                timer.update(tokens_per_step * n)
             iteration += n
 
             is_last = iteration == loop.steps
             if iteration % loop.log_every == 0 or is_last:
-                last_loss = float(metrics["loss"])  # device sync point
+                fetched = jax.device_get(metrics)  # the device sync point
+                last_loss = float(fetched["loss"])
                 rates = timer.snapshot()
+                real_steps = iteration - prev_sync_iteration - excluded_steps
+                step_wall_s = rates["window_seconds"] / max(real_steps, 1)
+                prev_sync_iteration = iteration
+                excluded_steps = 0
                 record = {
                     "step": iteration,
                     "loss": last_loss,
-                    "lr": float(metrics["lr"]),
-                    "grad_norm": float(metrics["grad_norm"]),
+                    "lr": float(fetched["lr"]),
+                    "grad_norm": float(fetched["grad_norm"]),
                     "tokens_per_sec": rates["tokens_per_sec"],
                     "tokens_per_sec_per_chip": rates["tokens_per_sec_per_chip"],
+                    "step_wall_s": step_wall_s,
+                    "window_seconds": rates["window_seconds"],
                 }
+                if "mfu" in rates:
+                    record["mfu"] = rates["mfu"]
+                if loop.health_stats:
+                    record.update(flatten_health(fetched["health"]))
                 history.append(record)
-                sinks.log(record)
+                # Through the narrator, not sinks.log directly: emit() holds
+                # the telemetry lock (the watchdog thread writes hang events
+                # through the same JSONL handle) and counts the record for
+                # the footer's record_counts.
+                telemetry.emit(record)
                 log_fn(
                     f"step {record['step']:>6d}  loss {record['loss']:.4f}  "
                     f"lr {record['lr']:.2e}  gnorm {record['grad_norm']:.3f}  "
                     f"tok/s {record['tokens_per_sec']:,.0f}"
                 )
+                if wd is not None:
+                    # A window of only warmup steps has no meaningful step
+                    # time; beat without a sample rather than seeding the
+                    # median with a near-zero artifact.
+                    wd.beat(step_wall_s if real_steps > 0 else None)
+                bad_fields = nonfinite_fields(record)
+                if bad_fields:
+                    # Dump-then-policy: the event (with the full record)
+                    # reaches the JSONL before "raise" tears the loop down;
+                    # without a watchdog the anomaly is recorded and the
+                    # loop continues (legacy behavior, now visible).
+                    if wd is not None:
+                        wd.on_nonfinite(record, bad_fields)
+                    else:
+                        telemetry.event(
+                            "nonfinite", step=iteration, fields=bad_fields
+                        )
 
             if val_data is not None and (
                 iteration % loop.eval_every == 0 or is_last
             ):
-                val_loss = run_eval()
-                sinks.log({"step": iteration, "val_loss": val_loss})
+                # Eval (its first call pays a jit compile) is legitimate
+                # silence — detection suspends for the duration and the
+                # deadline re-arms on exit, without polluting the step-time
+                # history.
+                with wd_pause():
+                    val_loss = run_eval()
+                telemetry.emit({"step": iteration, "val_loss": val_loss})
                 log_fn(f"step {iteration:>6d}  val_loss {val_loss:.4f}")
 
             if loop.checkpoint_dir is not None and (
                 iteration % loop.checkpoint_every == 0 or is_last
             ):
+                ckpt_handle = telemetry.start_span(
+                    "checkpoint", step=iteration, async_save=async_saver is not None
+                )
                 ckpt_path = Path(loop.checkpoint_dir) / f"step_{iteration:08d}.ckpt"
                 latest = Path(loop.checkpoint_dir) / "latest.ckpt"
                 state_kwargs = dict(
@@ -508,24 +672,33 @@ def train(
                         # + pickle twice.
                         shutil.copyfile(ckpt_path, latest)
 
-                if async_saver is not None:
-                    # Device→host snapshot happens now; serialization + IO
-                    # overlap with the next training steps.
-                    async_saver.save(
-                        ckpt_path,
-                        sharded=sharded_ckpt,
-                        on_complete=update_latest,
-                        **state_kwargs,
-                    )
-                elif sharded_ckpt:
-                    # GSPMD-sharded states stream shard-by-shard into a
-                    # checkpoint DIRECTORY — the full tree is never staged
-                    # on host in one buffer (FSDP-scale requirement).
-                    save_checkpoint_sharded(ckpt_path, **state_kwargs)
-                    update_latest()
-                else:
-                    save_checkpoint(ckpt_path, **state_kwargs)
-                    update_latest()
+                # A synchronous multi-GB save is legitimate silence;
+                # detection suspends and the deadline re-arms on exit.
+                with wd_pause():
+                    if async_saver is not None:
+                        # Device→host snapshot happens now; serialization +
+                        # IO overlap with the next training steps.
+                        async_saver.save(
+                            ckpt_path,
+                            sharded=sharded_ckpt,
+                            on_complete=update_latest,
+                            **state_kwargs,
+                        )
+                    elif sharded_ckpt:
+                        # GSPMD-sharded states stream shard-by-shard into a
+                        # checkpoint DIRECTORY — the full tree is never
+                        # staged on host in one buffer (FSDP-scale
+                        # requirement).
+                        save_checkpoint_sharded(ckpt_path, **state_kwargs)
+                        update_latest()
+                    else:
+                        save_checkpoint(ckpt_path, **state_kwargs)
+                        update_latest()
+                # The span covers the synchronous portion (async saves
+                # return after the device->host snapshot); discount it from
+                # the throughput window — save time is not step time.
+                timer.exclude(ckpt_handle.end())
+        clean_exit = True
 
     finally:
         try:
@@ -534,6 +707,19 @@ def train(
                 # final checkpoint (and surface any background write error).
                 async_saver.close()
         finally:
+            if wd is not None:
+                wd.stop()
+            # The footer closes the stream either way: clean=False marks a
+            # crash/interrupt, and the watchdog verdict (hang/non-finite
+            # counts) makes "watchdog-clean" checkable from the JSONL alone.
+            telemetry.footer(
+                steps=iteration,
+                clean=clean_exit,
+                watchdog_hang_events=wd.hang_events if wd is not None else 0,
+                watchdog_nonfinite_events=(
+                    wd.nonfinite_events if wd is not None else 0
+                ),
+            )
             # Even if the background write failed, flush the metric sinks —
             # the recorded history matters most when the run just crashed.
             sinks.close()

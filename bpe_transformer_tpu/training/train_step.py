@@ -36,7 +36,11 @@ class TrainHParams:
     grad_clip_norm: float = 1.0
 
 
-def make_loss_fn(config: ModelConfig) -> Callable:
+def make_loss_fn(config: ModelConfig, with_aux: bool = False) -> Callable:
+    """``with_aux=True`` returns ``(loss, aux)`` instead of the scalar loss,
+    where ``aux`` is the raw MoE load-balance loss (0 for dense FFNs) —
+    the health-enabled train step exports it as an expert-balance stat
+    (exactly 1.0 at perfectly uniform routing)."""
     is_moe = config.ffn_type == "moe"
 
     if config.loss_chunk_size:
@@ -53,19 +57,26 @@ def make_loss_fn(config: ModelConfig) -> Callable:
             )
             if is_moe:
                 loss = loss + config.router_aux_weight * aux
+            if with_aux:
+                return loss, aux
             return loss
 
     elif is_moe:
 
         def loss_fn(params, x, y):
             logits, aux = forward(params, x, config, return_aux=True)
-            return cross_entropy(logits, y) + config.router_aux_weight * aux
+            loss = cross_entropy(logits, y) + config.router_aux_weight * aux
+            if with_aux:
+                return loss, aux
+            return loss
 
     else:
 
         def loss_fn(params, x, y):
-            logits = forward(params, x, config)
-            return cross_entropy(logits, y)
+            loss = cross_entropy(forward(params, x, config), y)
+            if with_aux:
+                return loss, jnp.zeros((), jnp.float32)
+            return loss
 
     return loss_fn
 
@@ -74,19 +85,39 @@ def train_step_fn(
     config: ModelConfig,
     hparams: TrainHParams,
     reduce_axis: str | None = None,
+    health: bool = False,
 ) -> Callable:
     """The un-jitted update body ``(params, opt_state, x, y) ->
     (params, opt_state, metrics)`` shared by every execution mode.
 
     ``reduce_axis`` names a mapped mesh axis to pmean loss/grads over —
-    that single hook is all data parallelism adds to the update."""
-    loss_fn = make_loss_fn(config)
+    that single hook is all data parallelism adds to the update.
+
+    ``health=True`` (opt-in; the default step is unchanged) appends the
+    device-side health stats from `telemetry.health` to ``metrics``:
+    non-finite loss/grad/param detection, per-layer-group grad/param norms,
+    and (MoE) the raw expert load-balance loss as ``moe_aux``.  All extra
+    cost is a few reductions inside the same jitted program — the stats
+    ride the loop's existing once-per-``log_every`` metric fetch."""
+    is_moe = config.ffn_type == "moe"
+    with_aux = health and is_moe
+    loss_fn = make_loss_fn(config, with_aux=with_aux)
 
     def step(params, opt_state: AdamWState, x, y):
-        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        if with_aux:
+            (loss, moe_aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, x, y
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            moe_aux = None
         if reduce_axis is not None:
             grads = jax.lax.pmean(grads, reduce_axis)
             loss = jax.lax.pmean(loss, reduce_axis)
+            if moe_aux is not None:
+                # The exported expert-balance stat must describe GLOBAL
+                # routing, not shard 0's micro-batch.
+                moe_aux = jax.lax.pmean(moe_aux, reduce_axis)
         grads, grad_norm = clip_by_global_norm(grads, hparams.grad_clip_norm)
         lr = cosine_schedule_jax(
             opt_state.step,
@@ -109,15 +140,25 @@ def train_step_fn(
             "lr": lr.astype(jnp.float32),
             "grad_norm": grad_norm,
         }
+        if health:
+            from bpe_transformer_tpu.telemetry.health import health_metrics
+
+            # Post-update params: optimizer-produced non-finites are caught
+            # the same step they appear (before they can be checkpointed).
+            metrics["health"] = health_metrics(loss, grads, params)
+            if moe_aux is not None:
+                metrics["health"]["moe_aux"] = moe_aux.astype(jnp.float32)
         return params, opt_state, metrics
 
     return step
 
 
-def make_train_step(config: ModelConfig, hparams: TrainHParams) -> Callable:
+def make_train_step(
+    config: ModelConfig, hparams: TrainHParams, health: bool = False
+) -> Callable:
     """Single-device jitted train step with buffer donation (params and opt
     state update in place in HBM)."""
-    return jax.jit(train_step_fn(config, hparams), donate_argnums=(0, 1))
+    return jax.jit(train_step_fn(config, hparams, health=health), donate_argnums=(0, 1))
 
 
 def accumulate_grads(grad_fn, params, xs, ys, accum_steps: int, context: str = ""):
@@ -162,6 +203,7 @@ def grad_accum_step_fn(
     hparams: TrainHParams,
     accum_steps: int,
     reduce_axis: str | None = None,
+    health: bool = False,
 ) -> Callable:
     """Un-jitted accumulation body: one optimizer update from
     ``accum_steps`` microbatch gradients.
@@ -176,6 +218,10 @@ def grad_accum_step_fn(
     ``reduce_axis`` pmean-reduces the accumulated grads/loss over a mapped
     mesh axis (the shard_map dp path) — ONE collective per update, after
     the local accumulation, not one per microbatch.
+
+    ``health=True`` appends `telemetry.health` stats to ``metrics`` (as in
+    :func:`train_step_fn`; the MoE ``moe_aux`` export is plain-step-only —
+    the accumulation scan carries loss+grads, not per-microbatch aux).
 
     Signature: ``(params, opt_state, xs, ys) -> (params, opt_state,
     metrics)`` with ``xs/ys: (accum_steps, micro_batch, seq)``.
@@ -214,17 +260,22 @@ def grad_accum_step_fn(
             "lr": lr.astype(jnp.float32),
             "grad_norm": grad_norm,
         }
+        if health:
+            from bpe_transformer_tpu.telemetry.health import health_metrics
+
+            metrics["health"] = health_metrics(loss, grads, params)
         return params, opt_state, metrics
 
     return step
 
 
 def make_grad_accum_train_step(
-    config: ModelConfig, hparams: TrainHParams, accum_steps: int
+    config: ModelConfig, hparams: TrainHParams, accum_steps: int, health: bool = False
 ) -> Callable:
     """Single-device jitted wrapper of :func:`grad_accum_step_fn`."""
     return jax.jit(
-        grad_accum_step_fn(config, hparams, accum_steps), donate_argnums=(0, 1)
+        grad_accum_step_fn(config, hparams, accum_steps, health=health),
+        donate_argnums=(0, 1),
     )
 
 
@@ -234,6 +285,7 @@ def scanned_step_fn(
     inner_steps: int,
     reduce_axis: str | None = None,
     body: Callable | None = None,
+    health: bool = False,
 ) -> Callable:
     """Un-jitted body: ``inner_steps`` optimizer updates via ``lax.scan``.
 
@@ -255,7 +307,7 @@ def scanned_step_fn(
     if inner_steps < 1:
         raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
     if body is None:
-        body = train_step_fn(config, hparams, reduce_axis)
+        body = train_step_fn(config, hparams, reduce_axis, health=health)
 
     def multi(params, opt_state: AdamWState, xs, ys):
         def scan_body(carry, batch):
@@ -273,11 +325,12 @@ def scanned_step_fn(
 
 
 def make_scanned_train_step(
-    config: ModelConfig, hparams: TrainHParams, inner_steps: int
+    config: ModelConfig, hparams: TrainHParams, inner_steps: int, health: bool = False
 ) -> Callable:
     """Single-device jitted wrapper of :func:`scanned_step_fn`."""
     return jax.jit(
-        scanned_step_fn(config, hparams, inner_steps), donate_argnums=(0, 1)
+        scanned_step_fn(config, hparams, inner_steps, health=health),
+        donate_argnums=(0, 1),
     )
 
 

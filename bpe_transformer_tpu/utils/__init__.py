@@ -1,6 +1,6 @@
+from bpe_transformer_tpu.telemetry.sinks import MetricsLogger
+from bpe_transformer_tpu.telemetry.timing import StepTimer, profile_trace, time_fn
 from bpe_transformer_tpu.utils.debug import check_finite, nan_checks
-from bpe_transformer_tpu.utils.metrics import MetricsLogger
-from bpe_transformer_tpu.utils.profiling import StepTimer, profile_trace, time_fn
 
 __all__ = [
     "MetricsLogger",

@@ -1,81 +1,6 @@
-"""Metrics / logging / observability.
+"""Back-compat shim: the metrics sinks moved into the unified telemetry
+subsystem (``bpe_transformer_tpu.telemetry.sinks``)."""
 
-The reference declares ``wandb`` and ``tqdm`` but never imports either, and
-has zero logging calls in library code (SURVEY §5, reference
-`pyproject.toml:17,19`). This module makes the implied observability real:
-structured step records to stdout and a JSONL file, with an optional wandb
-sink behind a gated import (the package is not assumed installed).
-"""
+from bpe_transformer_tpu.telemetry.sinks import MetricsLogger
 
-from __future__ import annotations
-
-import json
-from pathlib import Path
-from typing import IO
-
-
-class MetricsLogger:
-    """Fan a stream of step-metric dicts out to stdout / JSONL / wandb.
-
-    >>> logger = MetricsLogger(jsonl_path="run/metrics.jsonl")
-    >>> logger.log({"step": 1, "loss": 3.2})
-    >>> logger.close()
-
-    Every sink is optional; with none configured ``log`` is a no-op, so the
-    training loop can call it unconditionally.
-    """
-
-    def __init__(
-        self,
-        stdout: bool = False,
-        jsonl_path: str | Path | None = None,
-        wandb_project: str | None = None,
-        wandb_config: dict | None = None,
-        log_fn=print,
-    ):
-        self._log_fn = log_fn if stdout else None
-        # Validate / init the wandb sink before opening the JSONL file so a
-        # missing wandb package doesn't leak an open handle or stray file.
-        self._wandb = None
-        if wandb_project is not None:
-            try:
-                import wandb
-            except ImportError as e:
-                raise ImportError(
-                    "wandb_project was set but the wandb package is not "
-                    "installed; install it or drop the flag"
-                ) from e
-            self._wandb = wandb.init(project=wandb_project, config=wandb_config)
-        self._jsonl: IO[str] | None = None
-        if jsonl_path is not None:
-            path = Path(jsonl_path)
-            path.parent.mkdir(parents=True, exist_ok=True)
-            self._jsonl = open(path, "a")
-
-    def log(self, record: dict) -> None:
-        if self._log_fn is not None:
-            parts = [
-                f"{k} {v:.6g}" if isinstance(v, float) else f"{k} {v}"
-                for k, v in record.items()
-            ]
-            self._log_fn("  ".join(parts))
-        if self._jsonl is not None:
-            self._jsonl.write(json.dumps(record) + "\n")
-            self._jsonl.flush()
-        if self._wandb is not None:
-            step = record.get("step")
-            self._wandb.log(record, step=step)
-
-    def close(self) -> None:
-        if self._jsonl is not None:
-            self._jsonl.close()
-            self._jsonl = None
-        if self._wandb is not None:
-            self._wandb.finish()
-            self._wandb = None
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
+__all__ = ["MetricsLogger"]

@@ -113,3 +113,32 @@ def test_example_pipeline_parallel(tmp_path, sample):
     out = run_example(tmp_path, sample, "9_pipeline_parallel.py")
     assert "pipeline parallel OK" in out
     assert "matches the single-device update" in out
+
+
+def test_cli_report_on_fixture_jsonl(tmp_path):
+    """`bpe-tpu report` smoke: summarize the committed tiny telemetry
+    stream (manifest + spans + steps + clean footer) from the CLI."""
+    fixture = REPO / "tests" / "fixtures" / "telemetry_tiny.jsonl"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bpe_transformer_tpu.training.cli",
+            "report",
+            str(fixture),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=tmp_path,
+        # The package may not be pip-installed in the test environment:
+        # resolve it from the repo checkout.
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO)),
+        timeout=300,
+    )
+    assert proc.returncode == 0, f"report failed:\n{proc.stdout}\n{proc.stderr}"
+    out = proc.stdout
+    assert "== run manifest ==" in out and "mesh={'data': 4}" in out
+    assert "steps 10..20" in out
+    assert "tokens/sec" in out
+    assert "compile_first_step" in out
+    assert "anomalies (0)" in out and "clean footer" in out

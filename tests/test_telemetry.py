@@ -1,0 +1,617 @@
+"""Unified telemetry subsystem: spans, watchdog, manifests, health stats,
+and the `bpe-tpu report` summarizer — all CPU-testable.
+
+The fast tier-1 anchor for the observability layer: everything here runs in
+seconds under JAX_PLATFORMS=cpu (the integration tests train a byte-level
+2-layer model for a handful of steps).
+"""
+
+import json
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bpe_transformer_tpu.models import ModelConfig
+from bpe_transformer_tpu.telemetry import (
+    NonFiniteError,
+    Telemetry,
+    Watchdog,
+    flatten_health,
+    git_sha,
+    group_norms,
+    health_metrics,
+    nonfinite_count,
+    nonfinite_fields,
+    run_manifest,
+)
+from bpe_transformer_tpu.telemetry.health import group_of
+from bpe_transformer_tpu.telemetry.report import (
+    load_records,
+    render_report,
+    summarize,
+)
+
+TINY = ModelConfig(
+    vocab_size=128,
+    context_length=16,
+    d_model=32,
+    num_layers=2,
+    num_heads=2,
+    d_ff=64,
+)
+
+
+# --------------------------------------------------------------- span/event
+
+
+def test_spans_nest_and_emit_structured_records():
+    records = []
+    t = Telemetry(sink=records.append)
+    with t.span("setup"):
+        with t.span("resume", path_hint="x"):
+            pass
+        t.event("checkpoint_loaded", step=5)
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["span", "event", "span"]  # inner span closes first
+    inner, event, outer = records
+    assert inner["path"] == "setup/resume" and inner["name"] == "resume"
+    assert inner["path_hint"] == "x"  # attrs pass through
+    assert outer["path"] == "setup"
+    assert outer["dur_s"] >= inner["dur_s"] >= 0
+    assert event["name"] == "checkpoint_loaded" and event["step"] == 5
+    assert event["t"] >= 0
+
+
+def test_span_handle_end_is_idempotent_and_returns_duration():
+    records = []
+    t = Telemetry(sink=records.append)
+    handle = t.start_span("compile")
+    dur = handle.end(cache_hit=False)
+    assert dur >= 0
+    assert handle.end() == 0.0  # second close: no duplicate record
+    assert len(records) == 1
+    assert records[0]["cache_hit"] is False
+
+
+def test_buffering_flushes_on_attach_and_bare_telemetry_is_noop():
+    t = Telemetry()  # no sink: records buffer
+    t.event("early", n=1)
+    with t.span("setup"):
+        pass
+    records = []
+    t.attach(records.append)
+    assert [r["name"] for r in records] == ["early", "setup"]
+    t.event("late")  # post-attach records flow straight through
+    assert records[-1]["name"] == "late"
+    Telemetry().event("dropped")  # never attached: silently dropped
+
+
+def test_footer_reports_record_counts():
+    records = []
+    t = Telemetry(sink=records.append)
+    t.event("nonfinite")
+    t.event("nonfinite")
+    t.footer(steps=100, clean=True)
+    footer = records[-1]
+    assert footer["kind"] == "footer"
+    assert footer["clean"] is True and footer["steps"] == 100
+    assert footer["record_counts"]["event:nonfinite"] == 2
+
+
+# ----------------------------------------------------------------- watchdog
+
+
+def _fake_clock(now):
+    return lambda: now[0]
+
+
+def test_watchdog_flags_hang_once_per_gap_and_rearms_on_beat():
+    now = [0.0]
+    records = []
+    hangs = []
+    wd = Watchdog(
+        factor=4.0,
+        min_history=3,
+        min_timeout_s=0.0,
+        telemetry=Telemetry(sink=records.append),
+        on_hang=hangs.append,
+        clock=_fake_clock(now),
+    )
+    assert wd.check() is False  # no history yet: cannot judge
+    for _ in range(3):
+        wd.beat(1.0)
+    assert wd.hang_timeout_s() == pytest.approx(4.0)
+    now[0] = 3.0
+    assert wd.check() is False  # within deadline
+    now[0] = 10.0
+    assert wd.check() is True
+    assert wd.check() is False  # once per silent gap
+    assert wd.hang_events == 1
+    assert hangs and hangs[0] == pytest.approx(10.0)
+    event = records[-1]
+    assert event["name"] == "watchdog_hang"
+    assert event["silent_s"] == pytest.approx(10.0)
+    wd.beat(1.0)  # new beat re-arms detection
+    now[0] = 30.0
+    assert wd.check() is True
+    assert wd.hang_events == 2
+
+
+def test_watchdog_median_resists_one_slow_step_and_floors_timeout():
+    now = [0.0]
+    wd = Watchdog(factor=2.0, min_history=3, min_timeout_s=5.0, clock=_fake_clock(now))
+    for step_s in (0.01, 0.01, 0.01, 100.0):
+        wd.beat(step_s)
+    # Median 0.01 -> 2x median is 0.02, floored to min_timeout_s.
+    assert wd.hang_timeout_s() == pytest.approx(5.0)
+
+
+def test_watchdog_pause_suspends_detection_and_rearms():
+    now = [0.0]
+    wd = Watchdog(factor=2.0, min_history=3, min_timeout_s=0.0, clock=_fake_clock(now))
+    for _ in range(3):
+        wd.beat(1.0)
+    with wd.pause():
+        now[0] = 100.0  # way past the 2s deadline: legitimate long phase
+        assert wd.check() is False
+    assert wd.hang_events == 0
+    # Exit re-armed the deadline from the pause's end, not the last beat.
+    now[0] = 101.0
+    assert wd.check() is False
+    now[0] = 110.0
+    assert wd.check() is True
+
+
+def test_watchdog_nonfinite_policy_raise_dumps_then_raises():
+    records = []
+    wd = Watchdog(policy="raise", telemetry=Telemetry(sink=records.append))
+    bad = {"step": 7, "loss": float("nan")}
+    with pytest.raises(NonFiniteError, match="step 7"):
+        wd.on_nonfinite(bad, ["loss"])
+    # The evidence reached the stream BEFORE the raise.
+    assert records[-1]["name"] == "nonfinite"
+    assert records[-1]["record"]["step"] == 7
+    assert wd.nonfinite_events == 1
+
+
+def test_watchdog_nonfinite_policy_skip_records_and_continues():
+    records = []
+    wd = Watchdog(policy="skip", telemetry=Telemetry(sink=records.append))
+    wd.on_nonfinite({"step": 3}, ["grad_norm/attn"])
+    assert wd.nonfinite_events == 1
+    assert records[-1]["fields"] == ["grad_norm/attn"]
+
+
+def test_watchdog_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="policy"):
+        Watchdog(policy="explode")
+
+
+def test_watchdog_thread_lifecycle():
+    wd = Watchdog(poll_interval_s=0.01)
+    with wd:
+        assert wd._thread is not None
+    assert wd._thread is None  # stop() joined it
+    wd.stop()  # idempotent
+
+
+# ----------------------------------------------------------------- manifest
+
+
+def test_run_manifest_is_json_serializable_and_self_describing():
+    m = run_manifest(
+        kind="train",
+        model_config=TINY,
+        loop_config={"steps": 10},
+        parallel="dp",
+        extra={"n_chips": 8},
+    )
+    json.dumps(m)  # must round-trip as one JSON record
+    assert m["kind"] == "manifest" and m["run_kind"] == "train"
+    assert m["model_config"]["d_model"] == 32
+    assert m["loop_config"] == {"steps": 10}
+    assert m["parallel"] == "dp" and m["n_chips"] == 8
+    assert m["jax_version"]  # backend reachable in tests
+    assert m["devices"]["platform"] == "cpu"
+    assert m["host"] and m["python"]
+
+
+def test_git_sha_inside_and_outside_a_checkout(tmp_path):
+    sha = git_sha()
+    assert sha is None or len(sha.split("-")[0]) == 40
+    assert git_sha(cwd=tmp_path) is None  # not a checkout: None, no raise
+
+
+def test_attach_manifest_never_loses_the_payload(monkeypatch):
+    from bpe_transformer_tpu.telemetry import manifest as manifest_mod
+
+    payload = manifest_mod.attach_manifest({"tok_s": 1.0}, kind="bench")
+    assert payload["manifest"]["run_kind"] == "bench"
+
+    def boom(**kw):
+        raise RuntimeError("no backend")
+
+    monkeypatch.setattr(manifest_mod, "run_manifest", boom)
+    payload = manifest_mod.attach_manifest({"tok_s": 1.0}, kind="bench")
+    assert payload == {"tok_s": 1.0}  # un-annotated, not raised
+
+
+# ------------------------------------------------------- device-side health
+
+
+def test_group_of_buckets_canonical_layer_groups():
+    assert group_of("['layers'][0]['attn']['wq']") == "attn"
+    assert group_of("['layers'][0]['ffn']['w1']") == "ffn"
+    assert group_of("['token_embeddings']") == "embed"
+    assert group_of("['lm_head']") == "head"
+    assert group_of("['layers'][0]['ln1']") == "norm"
+    assert group_of("['something_else']") == "other"
+
+
+def test_group_norms_and_nonfinite_count():
+    tree = {
+        "attn": {"w": jnp.full((4,), 3.0)},
+        "ffn": {"w": jnp.array([4.0, float("inf")])},
+    }
+    norms = group_norms(tree)
+    assert norms["attn"] == pytest.approx(6.0)  # sqrt(4 * 9)
+    assert int(nonfinite_count(tree)) == 1
+    # bf16 leaves accumulate in f32: no overflow at moderate norms.
+    big = {"attn": jnp.full((1024,), 300.0, dtype=jnp.bfloat16)}
+    assert math.isfinite(float(group_norms(big)["attn"]))
+
+
+def test_flatten_health_produces_flat_jsonl_keys():
+    health = health_metrics(
+        jnp.float32(2.5),
+        {"attn": jnp.ones(3)},
+        {"attn": jnp.ones(3), "lm_head": jnp.full(2, float("nan"))},
+    )
+    flat = flatten_health({**health, "moe_aux": jnp.float32(1.25)})
+    assert flat["nonfinite_loss"] == 0
+    assert flat["nonfinite_params"] == 2
+    assert flat["grad_norm/attn"] == pytest.approx(math.sqrt(3.0))
+    assert math.isnan(flat["param_norm/head"])
+    assert flat["moe_aux"] == pytest.approx(1.25)
+
+
+def test_health_enabled_train_step_exports_group_norms():
+    import jax
+
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.training.train_step import (
+        TrainHParams,
+        make_train_step,
+    )
+
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    opt_state = adamw_init(params)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, TINY.vocab_size, size=(4, TINY.context_length))
+    x, y = jnp.asarray(ids), jnp.asarray(np.roll(ids, -1, axis=1))
+
+    # Default step: metrics unchanged (no health key, no extra cost).
+    _, _, metrics = make_train_step(TINY, TrainHParams())(params, opt_state, x, y)
+    assert "health" not in metrics
+
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    step = make_train_step(TINY, TrainHParams(), health=True)
+    _, _, metrics = step(params, adamw_init(params), x, y)
+    flat = flatten_health(jax.device_get(metrics["health"]))
+    assert flat["nonfinite_loss"] == 0
+    assert flat["nonfinite_grads"] == 0 and flat["nonfinite_params"] == 0
+    for group in ("attn", "ffn", "embed", "head", "norm"):
+        assert flat[f"grad_norm/{group}"] >= 0
+        assert flat[f"param_norm/{group}"] > 0
+
+
+def test_health_enabled_moe_step_exports_expert_balance():
+    import dataclasses
+
+    import jax
+
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.training.train_step import (
+        TrainHParams,
+        make_train_step,
+    )
+
+    moe = dataclasses.replace(TINY, ffn_type="moe", n_experts=4)
+    params = init_params(jax.random.PRNGKey(0), moe)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, moe.vocab_size, size=(4, moe.context_length))
+    x, y = jnp.asarray(ids), jnp.asarray(np.roll(ids, -1, axis=1))
+    step = make_train_step(moe, TrainHParams(), health=True)
+    _, _, metrics = step(params, adamw_init(params), x, y)
+    moe_aux = float(metrics["health"]["moe_aux"])
+    # Switch-style load-balance loss: 1.0 at uniform routing, and bounded
+    # by n_experts (all traffic on one expert).
+    assert 0.5 <= moe_aux <= moe.n_experts + 0.5
+
+
+# ------------------------------------------------------------------- report
+
+
+def test_nonfinite_fields_flags_counts_and_nonfinite_values():
+    assert nonfinite_fields({"loss": 2.0, "grad_norm/attn": 1.0}) == []
+    assert nonfinite_fields({"nonfinite_grads": 3}) == ["nonfinite_grads"]
+    assert nonfinite_fields({"loss": float("nan")}) == ["loss"]
+    # The global grad_norm every run logs is value-checked even without
+    # --health-stats: an Inf grad norm must trip the watchdog policy.
+    assert nonfinite_fields({"grad_norm": float("inf")}) == ["grad_norm"]
+    assert nonfinite_fields({"param_norm/ffn": float("inf")}) == ["param_norm/ffn"]
+
+
+def test_load_records_skips_corrupt_lines(tmp_path):
+    path = tmp_path / "m.jsonl"
+    path.write_text('{"step": 1}\nnot json\n\n{"step": 2}\n{"truncat')
+    assert load_records(path) == [{"step": 1}, {"step": 2}]
+    assert load_records(tmp_path / "missing.jsonl") == []
+
+
+def _stream(tmp_path, records):
+    path = tmp_path / "metrics.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    return path
+
+
+def test_summarize_detects_anomalies(tmp_path):
+    records = [
+        {"kind": "manifest", "run_kind": "train", "git_sha": "abc"},
+        {"step": 1, "loss": 3.0, "tokens_per_sec": 100.0},
+        {"step": 2, "loss": 9.0},  # 3x spike
+        {"step": 3, "loss": float("nan"), "nonfinite_grads": 2},
+        {"step": 3, "val_loss": float("nan")},
+        {"kind": "event", "name": "nonfinite", "t": 1.0, "step": 3},
+        {"kind": "span", "name": "setup", "path": "setup", "t": 0.0, "dur_s": 1.5},
+        # no footer: the run crashed
+    ]
+    s = summarize(load_records(_stream(tmp_path, records)))
+    assert s["manifest"]["git_sha"] == "abc"
+    assert s["steps"]["n"] == 3 and s["steps"]["step_range"] == [1, 3]
+    assert s["spans"]["setup"]["total_s"] == pytest.approx(1.5)
+    text = " | ".join(s["anomalies"])
+    assert "non-finite state at step 3" in text
+    assert "non-finite val_loss at step 3" in text
+    assert "loss spike at step 2" in text
+    assert "nonfinite event at step 3" in text
+    assert "no footer" in text
+
+
+def test_report_renders_clean_run(tmp_path):
+    records = [
+        run_manifest(kind="train", model_config=TINY),
+        {"kind": "span", "name": "setup", "path": "setup", "t": 0.0, "dur_s": 0.8},
+        {"step": 10, "loss": 3.0, "lr": 1e-4, "grad_norm": 0.5,
+         "tokens_per_sec": 1000.0, "step_wall_s": 0.01, "mfu": 0.2,
+         "grad_norm/attn": 0.3},
+        {"step": 20, "loss": 2.5, "lr": 1e-4, "grad_norm": 0.4,
+         "tokens_per_sec": 1200.0, "step_wall_s": 0.009, "mfu": 0.25,
+         "grad_norm/attn": 0.2},
+        {"step": 20, "val_loss": 2.6},
+        {"kind": "footer", "t": 2.0, "clean": True, "record_counts": {}},
+    ]
+    text = render_report(load_records(_stream(tmp_path, records)))
+    assert "== run manifest ==" in text and "kind=train" in text
+    assert "steps 10..20" in text and "loss 3 -> 2.5" in text
+    assert "val_loss" in text
+    assert "tokens/sec" in text and "mfu" in text
+    assert "setup" in text
+    assert "grad_norm/attn" in text
+    assert "anomalies (0)" in text and "clean footer" in text
+
+
+def test_report_uses_latest_manifest_on_resumed_stream(tmp_path):
+    records = [
+        {"kind": "manifest", "run_kind": "train", "git_sha": "old0000"},
+        {"step": 1, "loss": 3.0},
+        {"kind": "footer", "t": 1.0, "clean": True, "record_counts": {}},
+        {"kind": "manifest", "run_kind": "train", "git_sha": "new1111"},
+        {"step": 2, "loss": 2.5},
+        {"kind": "footer", "t": 2.0, "clean": True, "record_counts": {}},
+    ]
+    s = summarize(load_records(_stream(tmp_path, records)))
+    # Latest manifest wins (matches summarize_captures.py); the render
+    # flags that the stream holds multiple segments.
+    assert s["manifest"]["git_sha"] == "new1111" and s["n_manifests"] == 2
+    assert "latest of 2 manifests" in render_report(load_records(_stream(tmp_path, records)))
+
+
+def test_report_cli_exit_codes(tmp_path, capsys):
+    from bpe_transformer_tpu.telemetry.report import main as report_main
+
+    assert report_main([]) == 2  # usage
+    assert report_main([str(tmp_path / "missing.jsonl")]) == 1
+    path = _stream(tmp_path, [{"step": 1, "loss": 2.0}])
+    assert report_main([str(path)]) == 0
+    assert "steps 1..1" in capsys.readouterr().out
+
+
+def test_report_importable_without_jax(tmp_path):
+    """The report tool must run on hosts with no accelerator runtime (a
+    laptop summarizing a capture pulled off a pod): importing it — and the
+    jax-free telemetry members — must not import jax."""
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    script = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"  # any `import jax` now raises
+        "from bpe_transformer_tpu.telemetry.report import summarize\n"
+        "from bpe_transformer_tpu.telemetry import (\n"
+        "    MetricsLogger, Telemetry, Watchdog, nonfinite_fields, run_manifest)\n"
+        "assert 'jax_version' not in run_manifest(kind='offline')\n"
+        "print('ok')\n"
+    )
+    repo = Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [_sys.executable, "-c", script],
+        capture_output=True, text=True,
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": str(repo)},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "ok"
+
+
+# -------------------------------------------------- loop integration (CPU)
+
+
+HP = dict(
+    max_learning_rate=1e-3,
+    min_learning_rate=1e-4,
+    warmup_iters=2,
+    cosine_cycle_iters=50,
+)
+
+
+@pytest.fixture(scope="module")
+def byte_data():
+    text = b"the quick brown fox. " * 2000
+    return np.frombuffer(text, dtype=np.uint8).astype(np.uint16)
+
+
+def test_train_emits_unified_stream_and_report_reads_it(tmp_path, byte_data):
+    """The acceptance run: health stats + spans + watchdog on a short CPU
+    training run produce one self-describing JSONL — manifest header, span
+    records, per-layer-group grad norms, watchdog-clean footer — that
+    `bpe-tpu report` summarizes."""
+    from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+
+    jsonl = tmp_path / "metrics.jsonl"
+    loop = LoopConfig(
+        steps=8,
+        batch_size=8,
+        log_every=4,
+        eval_every=8,
+        eval_batches=1,
+        checkpoint_every=100,
+        metrics_jsonl=str(jsonl),
+        health_stats=True,
+        watchdog=True,
+    )
+    summary = train(
+        TINY, TrainHParams(**HP), loop, byte_data, byte_data,
+        log_fn=lambda *_: None,
+    )
+    assert np.isfinite(summary["final_train_loss"])
+    records = load_records(jsonl)
+
+    manifest = records[0]
+    assert manifest["kind"] == "manifest" and manifest["run_kind"] == "train"
+    assert manifest["model_config"]["d_model"] == TINY.d_model
+    assert manifest["loop_config"]["health_stats"] is True
+
+    spans = {r["path"] for r in records if r.get("kind") == "span"}
+    assert {"setup", "compile_first_step"} <= spans
+    assert any(p.startswith("eval") for p in spans)
+
+    steps = [r for r in records if "kind" not in r and "loss" in r]
+    assert [r["step"] for r in steps] == [4, 8]
+    for r in steps:
+        assert r["nonfinite_loss"] == 0
+        assert r["grad_norm/attn"] > 0 and r["param_norm/ffn"] > 0
+        assert r["tokens_per_sec"] > 0 and r["step_wall_s"] > 0
+
+    footer = records[-1]
+    assert footer["kind"] == "footer" and footer["clean"] is True
+    assert footer["watchdog_hang_events"] == 0
+    assert footer["watchdog_nonfinite_events"] == 0
+    # Step and val records flow through the narrator too, so the footer's
+    # record_counts cross-checks the WHOLE stream (truncation detection):
+    # 2 step records + 1 val record, all under the default "metric:" key.
+    assert footer["record_counts"]["metric:"] == 3
+
+    text = render_report(records)
+    assert "anomalies (0)" in text and "grad_norm/attn" in text
+
+
+def test_nan_injection_fires_watchdog_raise_policy(tmp_path, byte_data):
+    """Synthetic NaN: an absurd LR overflows the params within a step or
+    two; the health stats surface it at the next log boundary and the
+    watchdog's "raise" policy dumps the record then stops the run."""
+    from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+
+    jsonl = tmp_path / "metrics.jsonl"
+    loop = LoopConfig(
+        steps=12,
+        batch_size=8,
+        log_every=2,
+        eval_every=100,
+        checkpoint_every=100,
+        metrics_jsonl=str(jsonl),
+        health_stats=True,
+        watchdog=True,
+        watchdog_policy="raise",
+    )
+    hot = TrainHParams(
+        max_learning_rate=1e30, min_learning_rate=1e30,
+        warmup_iters=0, cosine_cycle_iters=50,
+    )
+    with pytest.raises(NonFiniteError):
+        train(TINY, hot, loop, byte_data, log_fn=lambda *_: None)
+    records = load_records(jsonl)
+    events = [r for r in records if r.get("kind") == "event"]
+    assert any(e["name"] == "nonfinite" for e in events)
+    # The dump carries the offending record, and the footer is unclean.
+    dump = next(e for e in events if e["name"] == "nonfinite")
+    assert dump["fields"] and dump["record"]["step"] == dump["step"]
+    footer = records[-1]
+    assert footer["kind"] == "footer" and footer["clean"] is False
+    assert footer["watchdog_nonfinite_events"] == 1
+    # The report surfaces the whole story from the file alone.
+    text = render_report(records)
+    assert "nonfinite event" in text and "unclean" in text
+
+
+def test_nan_injection_skip_policy_keeps_training(tmp_path, byte_data):
+    from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+
+    jsonl = tmp_path / "metrics.jsonl"
+    loop = LoopConfig(
+        steps=6,
+        batch_size=8,
+        log_every=2,
+        eval_every=100,
+        checkpoint_every=100,
+        metrics_jsonl=str(jsonl),
+        health_stats=True,
+        watchdog=True,
+        watchdog_policy="skip",
+    )
+    hot = TrainHParams(
+        max_learning_rate=1e30, min_learning_rate=1e30,
+        warmup_iters=0, cosine_cycle_iters=50,
+    )
+    train(TINY, hot, loop, byte_data, log_fn=lambda *_: None)  # must not raise
+    records = load_records(jsonl)
+    footer = records[-1]
+    assert footer["kind"] == "footer" and footer["clean"] is True
+    assert footer["watchdog_nonfinite_events"] >= 1
+
+
+def test_health_stats_rejected_for_sp_and_pp():
+    from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+
+    loop = LoopConfig(steps=2, batch_size=8, parallel="sp", health_stats=True)
+    with pytest.raises(ValueError, match="health_stats"):
+        train(TINY, TrainHParams(**HP), loop, np.zeros(10_000, np.uint16))
+
+
+def test_bad_watchdog_policy_rejected_before_sinks_open(tmp_path):
+    """An invalid policy must fail fast — before the metrics JSONL (or a
+    wandb run) is opened, so nothing leaks."""
+    from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+
+    jsonl = tmp_path / "metrics.jsonl"
+    loop = LoopConfig(
+        steps=2, batch_size=8, metrics_jsonl=str(jsonl),
+        watchdog=True, watchdog_policy="warn",
+    )
+    with pytest.raises(ValueError, match="watchdog_policy"):
+        train(TINY, TrainHParams(**HP), loop, np.zeros(10_000, np.uint16))
+    assert not jsonl.exists()

@@ -62,8 +62,84 @@ def test_metrics_logger_jsonl_and_stdout(tmp_path):
     assert lines and "loss 2.5" in lines[0]
 
 
+def test_step_timer_empty_window_is_safe():
+    timer = StepTimer()
+    snap = timer.snapshot()  # no update() calls: zero tokens, no div-zero
+    assert snap["window_tokens"] == 0
+    assert snap["tokens_per_sec"] == 0.0
+    assert "mfu" not in snap  # no flops_per_token given
+
+
+def test_step_timer_exclude_discounts_non_step_time():
+    timer = StepTimer()
+    timer.update(100)
+    # Excluding more than the elapsed window clamps at the epsilon floor —
+    # proof the exclusion is subtracted from the window's elapsed time.
+    timer.exclude(1000.0)
+    assert timer.snapshot()["window_seconds"] == pytest.approx(1e-9)
+    # snapshot() resets the exclusion along with the window (asserted on
+    # the counter itself: a leaked exclusion would clamp window_seconds to
+    # the same epsilon floor, making a time-based assertion vacuous).
+    assert timer._window_excluded == 0.0
+
+
+def test_step_timer_mfu_absent_on_unknown_device():
+    # CPU test host: peak FLOPs unknown, so mfu is omitted (not garbage).
+    timer = StepTimer(flops_per_token=1e6)
+    timer.update(1000)
+    assert "mfu" not in timer.snapshot()
+
+
 def test_metrics_logger_noop_without_sinks():
     MetricsLogger().log({"step": 1})  # must not raise
+
+
+def test_metrics_logger_wandb_absent_raises_before_opening_jsonl(
+    tmp_path, monkeypatch
+):
+    import sys
+
+    monkeypatch.setitem(sys.modules, "wandb", None)  # force ImportError
+    path = tmp_path / "m.jsonl"
+    with pytest.raises(ImportError, match="wandb"):
+        MetricsLogger(jsonl_path=path, wandb_project="p")
+    # The wandb check ran first: no stray half-opened JSONL file.
+    assert not path.exists()
+
+
+def test_metrics_logger_wandb_sink_skips_structured_records(tmp_path, monkeypatch):
+    import sys
+    import types
+
+    logged = []
+    stub = types.SimpleNamespace(
+        init=lambda **kw: types.SimpleNamespace(
+            log=lambda record, step=None: logged.append((record, step)),
+            finish=lambda: None,
+        )
+    )
+    monkeypatch.setitem(sys.modules, "wandb", stub)
+    logger = MetricsLogger(jsonl_path=tmp_path / "m.jsonl", wandb_project="p")
+    logger.log({"kind": "manifest", "git_sha": "abc"})  # structured: skipped
+    logger.log({"step": 1, "loss": 2.0})
+    logger.log({"kind": "footer", "clean": True})
+    logger.close()
+    # Only the flat step record reached wandb (a kind-record logged with
+    # step=None would advance wandb's auto-step and drop early steps); the
+    # JSONL still carries all three.
+    assert logged == [({"step": 1, "loss": 2.0}, 1)]
+    assert len((tmp_path / "m.jsonl").read_text().splitlines()) == 3
+
+
+def test_metrics_logger_log_after_close_is_noop(tmp_path):
+    path = tmp_path / "m.jsonl"
+    logger = MetricsLogger(jsonl_path=path)
+    logger.log({"step": 1})
+    logger.close()
+    logger.log({"step": 2})  # crash-path flush after close: silent no-op
+    logger.close()  # close is idempotent
+    records = [json.loads(line) for line in path.read_text().splitlines()]
+    assert records == [{"step": 1}]
 
 
 def test_nan_checks_catches_nan_at_the_producing_op():
