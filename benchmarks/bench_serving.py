@@ -1,0 +1,157 @@
+"""Serving-engine throughput/latency vs request concurrency.
+
+The continuous-batching question in numbers: how much chip does a slot
+pool recover as in-flight requests stack up?  For each concurrency level
+the engine serves a fixed request load (ragged prompt lengths, shared
+token budget) and reports aggregate generated tokens/sec plus p50/p95
+request latency — the tradeoff curve capacity planning reads.
+
+Run on a TPU host:  python benchmarks/bench_serving.py
+Prints one JSON line per (config, concurrency) cell.
+
+`--config tinystories-4l|gpt2-small-32k`, `--concurrency N` (repeatable),
+`--requests M`, `--new-tokens K` restrict the grid so long runs can be
+split across invocations (tunnel-outage hygiene).  Warmup (compilation of
+the prefill buckets + tick) happens before timing, so cells measure
+steady-state serving, not XLA.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from _accel import require_accelerator  # noqa: E402  (benchmarks/_accel.py)
+
+import numpy as np
+
+import jax
+
+CONFIGS = {
+    "tinystories-4l": "TINYSTORIES_4L",
+    "gpt2-small-32k": "GPT2_SMALL_32K",
+}
+
+
+def _pctl(values, q):
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1, max(0, round(q * len(ordered)) - 1))]
+
+
+def run_cell(params, config, *, concurrency, n_requests, new_tokens, seed=0):
+    from bpe_transformer_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(seed)
+    ctx = config.context_length
+    # Ragged prompts across the bucket range, biased short (serving-shaped).
+    lengths = rng.integers(8, min(ctx - new_tokens, 4 * 64), size=n_requests)
+    prompts = [
+        [int(t) for t in rng.integers(0, config.vocab_size, size=n)]
+        for n in lengths
+    ]
+
+    with ServingEngine(
+        params, config, slots=concurrency, max_queue=n_requests + 1
+    ) as serving:
+        # Warmup: one request per distinct bucket + the tick program, so
+        # timed cells measure steady-state serving rather than XLA.
+        for b in serving.engine.buckets:
+            serving.generate([1] * min(b, ctx - 2), max_new_tokens=2,
+                             temperature=0.0, timeout=600)
+
+        # Submit everything up front; the scheduler feeds free slots.
+        from bpe_transformer_tpu.serving import Request
+
+        t0 = time.perf_counter()
+        handles = [
+            serving.submit(
+                Request(
+                    prompt_ids=tuple(p), max_new_tokens=new_tokens,
+                    temperature=1.0, top_k=50, seed=i,
+                )
+            )
+            for i, p in enumerate(prompts)
+        ]
+        results = [h.result(timeout=1800) for h in handles]
+        wall = time.perf_counter() - t0
+        latencies = [
+            r.queue_wait_s + r.prefill_s + r.decode_s for r in results
+        ]
+        tokens = sum(len(r.token_ids) for r in results)
+        compiled = serving.engine.compiled_programs()
+
+    return {
+        "wall_s": round(wall, 3),
+        "gen_tok_per_s": round(tokens / wall, 1),
+        "latency_p50_s": round(_pctl(latencies, 0.50), 4),
+        "latency_p95_s": round(_pctl(latencies, 0.95), 4),
+        "compiled_programs": compiled,
+        "requests": n_requests,
+        "new_tokens": new_tokens,
+    }
+
+
+def main() -> int:
+    require_accelerator(Path(__file__).stem)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", choices=sorted(CONFIGS), default="tinystories-4l")
+    parser.add_argument("--concurrency", type=int, action="append", default=None,
+                        help="slot-pool sizes to sweep (repeatable)")
+    parser.add_argument("--requests", type=int, default=None,
+                        help="requests per cell (default 4x concurrency)")
+    parser.add_argument("--new-tokens", type=int, default=64)
+    args = parser.parse_args()
+
+    import dataclasses
+
+    import bpe_transformer_tpu.models as models
+    from bpe_transformer_tpu.models import init_params
+
+    on_accel = jax.default_backend() != "cpu"
+    config = dataclasses.replace(
+        getattr(models, CONFIGS[args.config]),
+        attention_impl="xla",
+        decode_attention_impl="xla",
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    levels = args.concurrency or ([1, 4, 8] if on_accel else [1, 2])
+    new_tokens = args.new_tokens if on_accel else min(args.new_tokens, 8)
+
+    measured_any = False
+    for concurrency in levels:
+        n_requests = args.requests or 4 * concurrency
+        try:
+            cell = run_cell(
+                params, config,
+                concurrency=concurrency,
+                n_requests=n_requests,
+                new_tokens=new_tokens,
+            )
+        except Exception as exc:  # noqa: BLE001 - report the cell as absent
+            print(f"concurrency={concurrency} failed: {exc!r}"[:300],
+                  file=sys.stderr)
+            continue
+        measured_any = True
+        print(
+            json.dumps(
+                {
+                    "metric": f"serving_tokens_per_sec ({args.config}, "
+                    f"slots={concurrency}, req={n_requests}, "
+                    f"new={new_tokens}, {config.activation_dtype})",
+                    **cell,
+                    "device": str(jax.devices()[0]),
+                    "platform": jax.devices()[0].platform,
+                }
+            ),
+            flush=True,
+        )
+    return 0 if measured_any else 4
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -260,6 +260,21 @@ run_job moedisp 600 "$CAP/moe_dispatch.jsonl" \
 run_job breakdown12l 600 "$CAP/breakdown.jsonl" \
   python benchmarks/bench_breakdown.py --config tinystories-12l
 
+# Serving engine (PR-2): continuous-batching tokens/sec + p50/p95 request
+# latency vs slot-pool concurrency.  The curve capacity planning reads —
+# how much chip the slot pool recovers as in-flight requests stack up.
+# Each cell warms its prefill buckets first, so rows time steady-state
+# serving; compiled_programs in every row pins the bounded-compile claim
+# on real hardware.
+for conc in 1 4 8; do
+  run_job "serve_ts4l_$conc" 900 "$CAP/serving.jsonl" \
+    python benchmarks/bench_serving.py --config tinystories-4l \
+    --concurrency "$conc"
+done
+run_job serve_gpt2s_4 1800 "$CAP/serving.jsonl" \
+  python benchmarks/bench_serving.py --config gpt2-small-32k \
+  --concurrency 4 --requests 8
+
 # Multi-worker host tokenization (VERDICT r4 #7) is deliberately NOT a
 # queue job: it needs no TPU, and running it here would hold queue.lock
 # through a ~15-min CPU-only bench while a tunnel window closes.  The

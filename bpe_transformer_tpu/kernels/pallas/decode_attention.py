@@ -21,7 +21,8 @@ reads the COMPACT cache, preserving decode's GQA bandwidth win):
                                                 RoPE already applied
 * ``k_cache``  (batch, kv_heads, ctx, d_head) — written positions <= pos
 * ``v_cache``  (batch, kv_heads, ctx, d_head)
-* ``pos``      scalar int32 (traced)          — attend to cache[0..pos]
+* ``pos``      scalar int32 (traced)          — attend to cache[0..pos];
+               or (batch,) for per-sequence frontiers (serving slot pool)
 * returns      (batch, num_heads, d_head)
 
 Grid ``(batch*kv_heads, ctx/block_k)``, key axis innermost; ``pos`` rides
@@ -55,7 +56,7 @@ SUBLANES = 8
 
 def _decode_kernel(
     pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, scale: float, block_k: int, num_k_blocks: int,
+    *, scale: float, block_k: int, num_k_blocks: int, kv_heads: int,
 ):
     j = pl.program_id(1)
 
@@ -65,7 +66,10 @@ def _decode_kernel(
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    pos = pos_ref[0]
+    # One frontier per batch row (grid axis 0 walks batch-major over
+    # batch*kv_heads): a scalar pos is pre-broadcast to (batch,) by the
+    # caller, so the per-sequence ragged case costs nothing extra.
+    pos = pos_ref[pl.program_id(0) // kv_heads]
     # Blocks whose first key index is beyond the causal frontier contribute
     # nothing (pos >= 0 always leaves block 0 live, so l > 0 at finalize).
     @pl.when(j * block_k <= pos)
@@ -168,13 +172,18 @@ def decode_attention(
         else jnp.pad(c.reshape(bkv, ctx, d), ((0, 0), (0, ctx_pad - ctx), (0, 0)))
     )
     kp, vp = prep(k_cache), prep(v_cache)
-    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    # Scalar and per-batch frontiers share one program: broadcast to
+    # (batch,) so the prefetch array's shape never varies.
+    pos_arr = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32).reshape(-1), (batch,)
+    )
 
     kernel = functools.partial(
         _decode_kernel,
         scale=1.0 / (d**0.5),  # true head dim, not the lane-padded one
         block_k=block_k,
         num_k_blocks=nk,
+        kv_heads=kv_heads,
     )
     # Scalar-prefetch index maps receive the scalar ref as a trailing arg.
     # The K/V index CLAMPS to the causal frontier's block: grid steps beyond
@@ -187,7 +196,7 @@ def decode_attention(
     )
     kvspec = pl.BlockSpec(
         (1, block_k, d),
-        lambda b, j, p: (b, jnp.minimum(j, p[0] // block_k), 0),
+        lambda b, j, p: (b, jnp.minimum(j, p[b // kv_heads] // block_k), 0),
         memory_space=pltpu.VMEM,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -224,8 +233,16 @@ def xla_decode_attention(q, k_cache, v_cache, pos):
     # matching the kernel's f32 score accumulation.
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     scores = jnp.einsum("bkgqd,bkcd->bkgqc", qg, k_cache) * scale
-    visible = jnp.arange(ctx) <= pos
-    scores = jnp.where(visible[None, None, None, None, :], scores, -jnp.inf)
+    # pos is a scalar (whole batch at one depth) or (batch,) — per-sequence
+    # causal frontiers for the serving engine's ragged slot pool.
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:
+        visible = (jnp.arange(ctx) <= pos)[None, None, None, None, :]
+    else:
+        visible = (jnp.arange(ctx)[None, :] <= pos[:, None])[
+            :, None, None, None, :
+        ]
+    scores = jnp.where(visible, scores, -jnp.inf)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     att = jnp.einsum("bkgqc,bkcd->bkgqd", probs, v_cache)
     return att.reshape(batch, num_heads, d)

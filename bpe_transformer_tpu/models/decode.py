@@ -134,6 +134,7 @@ def prefill(
     config: ModelConfig,
     cache: KVCache,
     lm_head: Array | None = None,
+    last_pos: Array | None = None,
 ) -> tuple[Array, KVCache]:
     """Run the prompt through the model, filling the cache.
 
@@ -142,6 +143,13 @@ def prefill(
     the head weight — generate_cached passes a weight pre-cast to the
     compute dtype once, outside the token loop (head_logits accumulates in
     f32 either way, so logits stay float32-clean).
+
+    ``last_pos`` (batch,) selects WHICH position's logits to return per
+    sequence (default: the last).  The serving engine pads ragged prompts up
+    to a shared bucket length so one program serves every prompt in the
+    bucket; causal masking keeps positions ``<= last_pos`` untouched by the
+    padding, and the padded cache rows are overwritten by decode before any
+    step can attend to them.
     """
     batch, plen = token_ids.shape
     positions = jnp.arange(plen)
@@ -191,8 +199,25 @@ def prefill(
     # head_logits: activation-dtype matmul, f32 accumulation — the
     # head read (decode's per-token bandwidth bottleneck alongside the
     # cache) happens at the compute width, logits stay f32-clean.
-    logits = head_logits(x[:, -1], head)
+    if last_pos is None:
+        last = x[:, -1]
+    else:
+        idx = jnp.reshape(last_pos, (-1, 1, 1))
+        last = jnp.take_along_axis(x, idx, axis=1)[:, 0]
+    logits = head_logits(last, head)
     return logits, new_cache
+
+
+def _cache_write(buf: Array, new: Array, pos: Array) -> Array:
+    """Write ``new`` (B, H, s, dh) into ``buf`` at sequence position ``pos``
+    — scalar ``pos`` writes the whole batch at one offset (the classic
+    generation loop); a ``(B,)`` vector writes each sequence at its own
+    position (the serving engine's slots sit at ragged depths)."""
+    if jnp.ndim(pos) == 0:
+        return lax.dynamic_update_slice(buf, new, (0, 0, pos, 0))
+    return jax.vmap(
+        lambda b, n, p: lax.dynamic_update_slice(b, n, (0, p, 0))
+    )(buf, new, pos)
 
 
 def decode_step(
@@ -202,15 +227,22 @@ def decode_step(
     cache: KVCache,
     config: ModelConfig,
     lm_head: Array | None = None,
+    active: Array | None = None,
 ) -> tuple[Array, KVCache]:
     """One cached decode step.
 
-    ``token``: (batch,) ids of the token AT position ``pos`` (scalar);
-    returns logits ``(batch, vocab)`` for position ``pos`` and the updated
-    cache.  ``lm_head`` as in :func:`prefill`.
+    ``token``: (batch,) ids of the token AT position ``pos`` — a scalar
+    (whole batch at one depth, the classic generation loop) or a ``(batch,)``
+    vector (each sequence at its own depth, the serving engine's slot pool);
+    returns logits ``(batch, vocab)`` for each token's position and the
+    updated cache.  ``lm_head`` as in :func:`prefill`.
+
+    ``active`` (batch,) bool gates the cache write per sequence: inactive
+    slots keep their cache rows untouched (their logits are still computed —
+    the program shape is batch-static — but the caller discards them).
     """
     x = embedding(params["token_embeddings"], token[:, None])  # (B, 1, d)
-    positions = pos[None]  # (1,)
+    positions = pos[None] if jnp.ndim(pos) == 0 else pos[:, None]  # (1,)|(B,1)
 
     new_cache = []
     for block_params, layer_cache in zip(params["layers"], cache):
@@ -218,8 +250,12 @@ def decode_step(
         def attend(h, block_params=block_params, layer_cache=layer_cache):
             q, k, v = _project_qkv(h, block_params["attn"], config)
             q, k = _rope_qk(q, k, positions, config)
-            k_cache = lax.dynamic_update_slice(layer_cache["k"], k, (0, 0, pos, 0))
-            v_cache = lax.dynamic_update_slice(layer_cache["v"], v, (0, 0, pos, 0))
+            k_cache = _cache_write(layer_cache["k"], k, pos)
+            v_cache = _cache_write(layer_cache["v"], v, pos)
+            if active is not None:
+                keep = active[:, None, None, None]
+                k_cache = jnp.where(keep, k_cache, layer_cache["k"])
+                v_cache = jnp.where(keep, v_cache, layer_cache["v"])
             new_cache.append({"k": k_cache, "v": v_cache})
             # Both impls read the COMPACT GQA cache — the per-token hot path
             # reads only num_kv_heads * ctx bytes; expanding heads here
@@ -281,7 +317,9 @@ def _sample_from_logits(
 
 @partial(
     jax.jit,
-    static_argnames=("config", "max_new_tokens", "temperature", "top_k", "top_p"),
+    static_argnames=(
+        "config", "max_new_tokens", "temperature", "top_k", "top_p", "stop_id"
+    ),
 )
 def generate_cached(
     params: Params,
@@ -293,11 +331,17 @@ def generate_cached(
     temperature: float = 1.0,
     top_k: int | None = None,
     top_p: float | None = None,
+    stop_id: int | None = None,
 ) -> Array:
     """Sample ``(batch, max_new_tokens)`` continuations in one XLA program.
 
     ``prompt_ids``: (batch, prompt_len) with ``prompt_len + max_new_tokens
     <= context_length`` (the cache is sized to the context window).
+
+    ``stop_id``: once a sequence samples this id, every subsequent token is
+    pinned to ``stop_id`` inside the scan (the program shape stays static —
+    stopping cannot shrink the scan), so the host can truncate at the FIRST
+    occurrence and agree exactly with the early-exiting sliding-window path.
     """
     batch, plen = prompt_ids.shape
     if plen + max_new_tokens > config.context_length:
@@ -318,19 +362,27 @@ def generate_cached(
     logits, cache = prefill(params, prompt_ids, config, cache, lm_head=lm_head)
     key, sub = jax.random.split(key)
     first = _sample_from_logits(logits, sub, temperature, top_k, top_p)
+    # -1 never matches a sampled id (ids are >= 0), so stop_id=None keeps
+    # the pinning select a no-op without a second trace path.
+    sid = -1 if stop_id is None else stop_id
+    done = first == sid
 
     def step(carry, _):
-        token, pos, cache, key = carry
+        token, pos, cache, key, done = carry
         logits, cache = decode_step(
             params, token, pos, cache, config, lm_head=lm_head
         )
         key, sub = jax.random.split(key)
         nxt = _sample_from_logits(logits, sub, temperature, top_k, top_p)
-        return (nxt, pos + 1, cache, key), nxt
+        nxt = jnp.where(done, sid, nxt)
+        return (nxt, pos + 1, cache, key, done | (nxt == sid)), nxt
 
     if max_new_tokens == 1:
         return first[:, None]
-    (_, _, _, _), rest = lax.scan(
-        step, (first, jnp.asarray(plen), cache, key), None, length=max_new_tokens - 1
+    _, rest = lax.scan(
+        step,
+        (first, jnp.asarray(plen), cache, key, done),
+        None,
+        length=max_new_tokens - 1,
     )
     return jnp.concatenate([first[:, None], rest.T], axis=1)

@@ -1,0 +1,38 @@
+"""Continuous-batching inference serving.
+
+The ROADMAP's "serve heavy traffic" leg: a fixed-capacity slot pool of
+batched KV caches (`engine`), a FIFO admission queue with backpressure,
+deadlines, and max-wait batching (`scheduler`), and the request/transport
+layer — blocking + streaming generation, offline batch files, a stdlib
+HTTP endpoint — behind ``bpe-tpu serve`` (`server`).
+
+Everything runs under ``JAX_PLATFORMS=cpu`` with tiny configs, so the full
+engine is tier-1-testable; on TPU the same programs serve at chip speed.
+"""
+
+from bpe_transformer_tpu.serving.engine import (
+    SlotPoolEngine,
+    TickEvent,
+    default_prefill_buckets,
+)
+from bpe_transformer_tpu.serving.scheduler import FifoScheduler, QueueFullError
+from bpe_transformer_tpu.serving.server import (
+    Request,
+    RequestHandle,
+    Result,
+    ServingEngine,
+    make_http_server,
+)
+
+__all__ = [
+    "FifoScheduler",
+    "QueueFullError",
+    "Request",
+    "RequestHandle",
+    "Result",
+    "ServingEngine",
+    "SlotPoolEngine",
+    "TickEvent",
+    "default_prefill_buckets",
+    "make_http_server",
+]

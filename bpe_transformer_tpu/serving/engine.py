@@ -1,0 +1,381 @@
+"""Slot-pool continuous-batching engine: many in-flight generations, one
+compiled decode program.
+
+Production TPU serving lives or dies on chip saturation: a single-request
+decode step is a tiny matvec that leaves the MXU idle, and recompiling per
+prompt shape stalls the pipeline for seconds at a time.  This engine fixes
+both with a **fixed-capacity slot pool**:
+
+* the KV cache is one batched pytree — ``slots x context_length`` per layer
+  (`models/decode.init_kv_cache`) — and every engine tick runs ONE jitted
+  ``decode_step`` across all slots at their own positions (the per-slot
+  ``pos`` vector + ``active`` mask generalization of `models/decode.py`),
+  sampling each slot with independent RNG/temperature/top-k/top-p **at
+  runtime** (no sampling knob is a static argument, so knob changes never
+  recompile);
+* prefill pads each prompt up to a **power-of-two length bucket** and runs
+  a per-bucket program that writes the slot's cache rows and samples the
+  first token — the engine compiles at most ``len(buckets) + 1`` XLA
+  programs total (one per bucket + the tick), asserted by
+  :meth:`SlotPoolEngine.compiled_programs`;
+* slots retire on stop-id / max-tokens and are immediately re-admittable:
+  a fresh prefill overwrites the slot's whole cache row, so no cross-request
+  state survives.
+
+The engine is single-threaded by design (the serving layer's worker loop
+owns it); queueing, deadlines, and transport live in `serving.scheduler`
+and `serving.server`.
+
+MoE note: expert capacity inside a tick is batch-shaped (all slots' tokens
+route together), so under capacity pressure slots are not perfectly
+independent — the same caveat as batched `generate_cached`, and a no-op for
+drop-free configs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bpe_transformer_tpu.models.config import ModelConfig
+from bpe_transformer_tpu.models.decode import decode_step, init_kv_cache, prefill
+from bpe_transformer_tpu.models.transformer import lm_head_weight
+
+#: Runtime encodings for "knob disabled" — the sampler is branch-free so
+#: every slot shares one program regardless of which knobs are in play.
+TOP_K_DISABLED = 0
+TOP_P_DISABLED = 2.0
+
+
+def default_prefill_buckets(
+    context_length: int, min_bucket: int = 16
+) -> tuple[int, ...]:
+    """Power-of-two prompt-length buckets up to (and always including) the
+    context length — the bounded set of prefill program shapes."""
+    buckets: list[int] = []
+    b = min_bucket
+    while b < context_length:
+        buckets.append(b)
+        b *= 2
+    buckets.append(context_length)
+    return tuple(buckets)
+
+
+def sample_tokens(logits, keys, temps, top_ks, top_ps):
+    """Per-row sampling with RUNTIME knobs: ``temps`` (0 = greedy),
+    ``top_ks`` (0 = disabled), ``top_ps`` (>= 1 effectively disabled).
+
+    Mirrors `models/decode._sample_from_logits` semantics per row — scale by
+    temperature, top-k threshold with ties kept, then nucleus filtering on
+    the top-k-renormalized distribution — but with every knob a traced
+    ``(batch,)`` vector, so one compiled program serves any knob mix.  The
+    cost is a full O(V log V) sort instead of ``lax.top_k`` — the price of
+    runtime ``k``; at serving batch sizes the decode forward dominates.
+    """
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+
+    # top-k: keep everything >= the k-th largest (ties included, matching
+    # the static sampler); k <= 0 disables by using the minimum as cutoff.
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    k_idx = jnp.where(top_ks > 0, jnp.clip(top_ks, 1, vocab), vocab) - 1
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    masked = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # top-p over the top-k-masked distribution (softmax renormalizes the
+    # survivors, as the static sampler does by masking before nucleus).
+    sorted_m = jnp.sort(masked, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_m, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_ps[:, None]  # mass BEFORE each token
+    keep = keep.at[:, 0].set(True)  # the argmax always survives
+    cutoff = jnp.min(jnp.where(keep, sorted_m, jnp.inf), axis=-1)
+    masked = jnp.where(masked < cutoff[:, None], -jnp.inf, masked)
+
+    sampled = jax.vmap(jax.random.categorical)(keys, masked)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+def _prefill_program(
+    params, lm_head, cache, padded, length, slot, key, temp, top_k, top_p,
+    *, config: ModelConfig,
+):
+    """One bucket-shaped prefill: fill slot ``slot``'s cache rows from the
+    padded prompt, return the first sampled token.  ``length``/``slot`` and
+    every sampling knob are traced, so the program count is exactly the
+    bucket count."""
+    fresh = init_kv_cache(config, 1, dtype=cache[0]["k"].dtype)
+    logits, filled = prefill(
+        params, padded, config, fresh, lm_head=lm_head,
+        last_pos=jnp.reshape(length - 1, (1,)),
+    )
+    # Replace the slot's ENTIRE cache row (zeros beyond the bucket): no
+    # stale state from the previous occupant survives re-admission.
+    new_cache = [
+        {
+            "k": lax.dynamic_update_slice(c["k"], f["k"], (slot, 0, 0, 0)),
+            "v": lax.dynamic_update_slice(c["v"], f["v"], (slot, 0, 0, 0)),
+        }
+        for c, f in zip(cache, filled)
+    ]
+    key, sub = jax.random.split(key)
+    tok = sample_tokens(
+        logits, sub[None], temp[None], top_k[None], top_p[None]
+    )[0]
+    return tok, key, new_cache
+
+
+def _tick_program(
+    params, lm_head, cache, tokens, positions, active, keys, temps,
+    top_ks, top_ps, *, config: ModelConfig,
+):
+    """One engine tick: batched decode step at per-slot positions, per-slot
+    runtime sampling, inactive slots frozen (cache write masked, position
+    held, token passed through)."""
+    logits, cache = decode_step(
+        params, tokens, positions, cache, config, lm_head=lm_head,
+        active=active,
+    )
+    split = jax.vmap(jax.random.split)(keys)
+    keys_next, subs = split[:, 0], split[:, 1]
+    nxt = sample_tokens(logits, subs, temps, top_ks, top_ps)
+    nxt = jnp.where(active, nxt, tokens)
+    keys_next = jnp.where(active[:, None], keys_next, keys)
+    positions = jnp.where(active, positions + 1, positions)
+    return nxt, positions, keys_next, cache
+
+
+@dataclasses.dataclass
+class SlotInfo:
+    """Host-side bookkeeping for one occupied slot."""
+
+    prompt_len: int
+    bucket: int
+    max_new_tokens: int  # effective: clamped to the context window
+    stop_id: int | None
+    generated: int = 0  # includes the prefill-sampled first token
+
+
+@dataclasses.dataclass(frozen=True)
+class TickEvent:
+    """One slot's output from a tick (or admission): the sampled token and,
+    when the slot retired, why (``"stop"`` | ``"length"``)."""
+
+    slot: int
+    token: int
+    finished: str | None = None
+
+
+class SlotPoolEngine:
+    """Fixed-capacity continuous-batching engine over a batched KV cache.
+
+    Single-threaded: exactly one caller (the serving worker loop) may call
+    :meth:`admit` / :meth:`tick` / :meth:`release`.
+    """
+
+    def __init__(
+        self,
+        params,
+        config: ModelConfig,
+        *,
+        slots: int = 8,
+        prefill_buckets: tuple[int, ...] | None = None,
+        min_bucket: int = 16,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.config = config
+        self.n_slots = slots
+        ctx = config.context_length
+        if prefill_buckets is None:
+            prefill_buckets = default_prefill_buckets(ctx, min_bucket)
+        buckets = tuple(sorted(set(prefill_buckets)))
+        if not buckets or buckets[-1] > ctx:
+            raise ValueError(
+                f"prefill buckets {buckets} must be non-empty and <= "
+                f"context_length={ctx}"
+            )
+        if buckets[-1] < ctx:
+            buckets = buckets + (ctx,)
+        self.buckets = buckets
+
+        # Params/head cast once to the compute dtype (mirrors
+        # generate_cached); the cache lives at the same width.
+        act_dtype = jnp.dtype(config.activation_dtype)
+        self._lm_head = lm_head_weight(params, config).astype(act_dtype)
+        if act_dtype != jnp.float32:
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(act_dtype), params
+            )
+        self._params = params
+        self._cache = init_kv_cache(config, slots, dtype=act_dtype)
+
+        # Per-slot sampling/position state is host-side numpy: tiny (N,)
+        # vectors shipped with each dispatch; only the cache stays resident.
+        self._tokens = np.zeros(slots, np.int32)
+        self._positions = np.zeros(slots, np.int32)
+        self._active = np.zeros(slots, bool)
+        self._keys = np.zeros((slots, 2), np.uint32)
+        self._temps = np.zeros(slots, np.float32)
+        self._top_ks = np.full(slots, TOP_K_DISABLED, np.int32)
+        self._top_ps = np.full(slots, TOP_P_DISABLED, np.float32)
+        self._slots: list[SlotInfo | None] = [None] * slots
+
+        # Per-engine jit closures (NOT module-level): each engine owns its
+        # compile cache, so compiled_programs() is an exact per-engine
+        # compile counter — the bounded-compilation guarantee is testable.
+        self._prefill_jit = jax.jit(
+            functools.partial(_prefill_program, config=config)
+        )
+        self._tick_jit = jax.jit(
+            functools.partial(_tick_program, config=config)
+        )
+
+        self.ticks = 0
+        self.tokens_emitted = 0
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def active_count(self) -> int:
+        return int(self._active.sum())
+
+    @property
+    def free_slots(self) -> int:
+        return self.n_slots - self.active_count
+
+    def compiled_programs(self) -> int:
+        """XLA programs compiled by this engine so far — bounded by
+        ``len(self.buckets) + 1`` (one prefill per bucket + one tick)."""
+        return self._prefill_jit._cache_size() + self._tick_jit._cache_size()
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """The smallest bucket holding ``prompt_len`` (prompts are padded up
+        to it so prefill shapes come from a bounded set)."""
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt of {prompt_len} tokens exceeds the largest bucket "
+            f"{self.buckets[-1]}"
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def admit(
+        self,
+        prompt_ids,
+        *,
+        max_new_tokens: int,
+        temperature: float = 1.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        seed: int = 0,
+        stop_id: int | None = None,
+    ) -> TickEvent:
+        """Prefill a free slot with ``prompt_ids`` and sample the first
+        token.  Returns the admission :class:`TickEvent` (slot, first token,
+        and a finish reason when one token already completes the request).
+        Raises ``RuntimeError`` when no slot is free and ``ValueError`` for
+        prompts the context window cannot serve."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        plen = prompt.shape[0]
+        ctx = self.config.context_length
+        if plen < 1:
+            raise ValueError("prompt must contain at least one token")
+        if plen > ctx - 1:
+            raise ValueError(
+                f"prompt of {plen} tokens leaves no room to generate in a "
+                f"context of {ctx}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        free = np.flatnonzero(~self._active)
+        if free.size == 0:
+            raise RuntimeError("no free slot")
+        slot = int(free[0])
+
+        bucket = self.bucket_for(plen)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = prompt
+        temp_enc = np.float32(temperature)
+        top_k_enc = np.int32(TOP_K_DISABLED if top_k is None else top_k)
+        top_p_enc = np.float32(TOP_P_DISABLED if top_p is None else top_p)
+
+        tok, key, self._cache = self._prefill_jit(
+            self._params, self._lm_head, self._cache, padded,
+            np.int32(plen), np.int32(slot), jax.random.PRNGKey(seed),
+            temp_enc, top_k_enc, top_p_enc,
+        )
+        token = int(tok)
+        self._tokens[slot] = token
+        self._positions[slot] = plen
+        self._keys[slot] = np.asarray(key)
+        self._temps[slot] = temp_enc
+        self._top_ks[slot] = top_k_enc
+        self._top_ps[slot] = top_p_enc
+        info = SlotInfo(
+            prompt_len=plen,
+            bucket=bucket,
+            max_new_tokens=min(max_new_tokens, ctx - plen),
+            stop_id=stop_id,
+            generated=1,
+        )
+        self._slots[slot] = info
+        self._active[slot] = True
+        self.tokens_emitted += 1
+
+        finished = self._finish_reason(info, token)
+        if finished:
+            self.release(slot)
+        return TickEvent(slot=slot, token=token, finished=finished)
+
+    def tick(self) -> list[TickEvent]:
+        """One batched decode step across every occupied slot: returns each
+        active slot's sampled token, retiring slots that hit their stop id
+        or token budget."""
+        if not self._active.any():
+            return []
+        tokens, positions, keys, self._cache = self._tick_jit(
+            self._params, self._lm_head, self._cache, self._tokens,
+            self._positions, self._active, self._keys, self._temps,
+            self._top_ks, self._top_ps,
+        )
+        tokens = np.asarray(tokens)
+        self._tokens = tokens.copy()
+        self._positions = np.asarray(positions).copy()
+        self._keys = np.asarray(keys).copy()
+        self.ticks += 1
+
+        events: list[TickEvent] = []
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            info = self._slots[slot]
+            token = int(tokens[slot])
+            info.generated += 1
+            self.tokens_emitted += 1
+            finished = self._finish_reason(info, token)
+            if finished:
+                self.release(slot)
+            events.append(TickEvent(slot=slot, token=token, finished=finished))
+        return events
+
+    def release(self, slot: int) -> None:
+        """Free a slot (normal retirement or cancellation).  The cache row
+        is left as-is — the next admission's prefill overwrites it whole."""
+        self._active[slot] = False
+        self._slots[slot] = None
+
+    @staticmethod
+    def _finish_reason(info: SlotInfo, token: int) -> str | None:
+        if info.stop_id is not None and token == info.stop_id:
+            return "stop"
+        if info.generated >= info.max_new_tokens:
+            return "length"
+        return None

@@ -1,0 +1,670 @@
+"""Serving front end: `Request`/`Result` types, the blocking + streaming
+`ServingEngine`, an offline batch mode, and the stdlib HTTP JSON endpoint
+behind ``bpe-tpu serve``.
+
+Layering (one thread owns the chip):
+
+* transports (HTTP handler threads, `generate()` callers, the batch runner)
+  only touch the `FifoScheduler` and per-request completion events;
+* ONE worker thread runs the engine loop — admit queued requests into free
+  slots (prefill), run a decode tick across every occupied slot, deliver
+  sampled tokens to the per-request streams, retire finished slots — so the
+  `SlotPoolEngine` itself never needs a lock;
+* backpressure surfaces where it belongs: a full queue raises
+  `QueueFullError` at submit time (HTTP 503), never blocking a transport.
+
+Telemetry (PR-1 stream schema): per-request ``serve/queue_wait``,
+``serve/prefill``, ``serve/decode`` span records, periodic
+``{"kind": "engine"}`` records (active slots, queue depth, tokens/sec), and
+the shared manifest/footer — all through one `telemetry.Telemetry`, so
+``bpe-tpu report`` summarizes a serving run from the same JSONL it already
+reads for training runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import queue
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Iterator
+
+from bpe_transformer_tpu.serving.engine import SlotPoolEngine, TickEvent
+from bpe_transformer_tpu.serving.scheduler import FifoScheduler, QueueFullError
+
+__all__ = [
+    "Request",
+    "Result",
+    "RequestHandle",
+    "ServingEngine",
+    "QueueFullError",
+    "make_http_server",
+]
+
+_STREAM_END = object()
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request (token-id based; transports tokenize)."""
+
+    prompt_ids: tuple[int, ...]
+    max_new_tokens: int = 128
+    temperature: float = 1.0
+    top_k: int | None = None
+    top_p: float | None = None
+    seed: int = 0
+    stop_id: int | None = None
+    #: Seconds the request may wait IN THE QUEUE before it is failed fast
+    #: with ``finish_reason="deadline"`` (None: wait indefinitely).
+    deadline_s: float | None = None
+    request_id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Result:
+    """A finished request: generated ids + why it stopped + phase timings."""
+
+    request_id: str
+    token_ids: tuple[int, ...]
+    finish_reason: str  # stop | length | deadline | cancelled | error
+    queue_wait_s: float = 0.0
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
+
+    def timings(self) -> dict:
+        return {
+            "queue_wait_s": round(self.queue_wait_s, 6),
+            "prefill_s": round(self.prefill_s, 6),
+            "decode_s": round(self.decode_s, 6),
+        }
+
+
+class _Entry:
+    """Worker-side state for one submitted request."""
+
+    __slots__ = (
+        "request", "tokens", "stream", "done", "result", "slot",
+        "t_submit", "t_decode_start", "queue_wait_s", "prefill_s",
+        "cancel_requested",
+    )
+
+    def __init__(self, request: Request, t_submit: float):
+        self.request = request
+        self.tokens: list[int] = []
+        self.stream: queue.Queue = queue.Queue()
+        self.done = threading.Event()
+        self.result: Result | None = None
+        self.slot: int | None = None
+        self.t_submit = t_submit
+        self.t_decode_start = t_submit
+        self.queue_wait_s = 0.0
+        self.prefill_s = 0.0
+        self.cancel_requested = False
+
+
+class RequestHandle:
+    """Caller-side view of an in-flight request."""
+
+    def __init__(self, serving: "ServingEngine", entry: _Entry):
+        self._serving = serving
+        self._entry = entry
+
+    @property
+    def request_id(self) -> str:
+        return self._entry.request.request_id
+
+    def result(self, timeout: float | None = None) -> Result:
+        """Block until the request finishes; raises TimeoutError."""
+        if not self._entry.done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not done within {timeout}s"
+            )
+        return self._entry.result
+
+    def tokens(self) -> Iterator[int]:
+        """Stream token ids as the engine emits them (ends at completion)."""
+        while True:
+            item = self._entry.stream.get()
+            if item is _STREAM_END:
+                return
+            yield item
+
+    def cancel(self) -> None:
+        self._serving.cancel(self.request_id)
+
+
+class ServingEngine:
+    """Continuous-batching serving: scheduler + slot pool + worker thread.
+
+    Use as a context manager (or call :meth:`start`/:meth:`close`)::
+
+        with ServingEngine(params, config, slots=8) as serving:
+            result = serving.generate([1, 2, 3], max_new_tokens=16)
+    """
+
+    def __init__(
+        self,
+        params,
+        config,
+        *,
+        tokenizer=None,
+        slots: int = 8,
+        max_queue: int = 64,
+        max_wait_s: float = 0.0,
+        prefill_buckets: tuple[int, ...] | None = None,
+        min_bucket: int = 16,
+        default_stop_id: int | None = None,
+        default_max_new_tokens: int = 128,
+        telemetry=None,
+        engine_record_every_s: float = 1.0,
+        idle_poll_s: float = 0.02,
+        clock=time.monotonic,
+    ):
+        self.engine = SlotPoolEngine(
+            params, config, slots=slots,
+            prefill_buckets=prefill_buckets, min_bucket=min_bucket,
+        )
+        self.scheduler = FifoScheduler(
+            max_queue=max_queue, max_wait_s=max_wait_s, clock=clock
+        )
+        self.tokenizer = tokenizer
+        self.default_stop_id = default_stop_id
+        self.default_max_new_tokens = default_max_new_tokens
+        self._telemetry = telemetry
+        self._record_every_s = engine_record_every_s
+        self._idle_poll_s = idle_poll_s
+        self._clock = clock
+        self._t0 = clock()
+        self._last_record_t = self._t0
+        self._last_record_tokens = 0
+        self._entries: dict[str, _Entry] = {}
+        self._entries_lock = threading.Lock()
+        self._slot_entries: dict[int, _Entry] = {}
+        self._requests_finished = 0
+        self._thread: threading.Thread | None = None
+        self._running = False
+        self._worker_error: BaseException | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "ServingEngine":
+        if self._thread is not None:
+            return self
+        self._running = True
+        self._t0 = self._clock()
+        self._last_record_t = self._t0
+        self._thread = threading.Thread(
+            target=self._run, name="serving-engine", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop the worker; in-flight and queued requests finish as
+        ``cancelled``."""
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        drain = self.scheduler.pop_ready(self.scheduler.max_queue)
+        for qe in drain.admit + drain.expired + drain.cancelled:
+            self._finish(qe.item, "cancelled")
+        for slot in list(self._slot_entries):
+            entry = self._slot_entries.pop(slot)
+            self.engine.release(slot)
+            self._finish(entry, "cancelled")
+        if self._telemetry is not None:
+            self._telemetry.footer(
+                clean=self._worker_error is None,
+                requests=self._requests_finished,
+                ticks=self.engine.ticks,
+                tokens=self.engine.tokens_emitted,
+            )
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------- transport side
+
+    def submit(self, request: Request) -> RequestHandle:
+        """Validate + enqueue; raises `QueueFullError` (backpressure) or
+        ``ValueError`` (prompt the context window cannot serve)."""
+        if self._worker_error is not None:
+            raise RuntimeError(
+                "serving engine worker died"
+            ) from self._worker_error
+        if not self._running:
+            raise RuntimeError("serving engine is not running (use start())")
+        plen = len(request.prompt_ids)
+        ctx = self.engine.config.context_length
+        if plen < 1:
+            raise ValueError("prompt must contain at least one token")
+        if plen > ctx - 1:
+            raise ValueError(
+                f"prompt of {plen} tokens leaves no room to generate in a "
+                f"context of {ctx}"
+            )
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {request.max_new_tokens}"
+            )
+        entry = _Entry(request, self._clock())
+        with self._entries_lock:
+            self._entries[request.request_id] = entry
+        try:
+            self.scheduler.submit(
+                entry,
+                request_id=request.request_id,
+                deadline_s=request.deadline_s,
+            )
+        except BaseException:
+            # Any enqueue failure (backpressure, a bad deadline value, ...)
+            # must unregister the entry — a leaked entry holds a Queue and
+            # an Event forever.
+            with self._entries_lock:
+                self._entries.pop(request.request_id, None)
+            raise
+        return RequestHandle(self, entry)
+
+    def generate(
+        self,
+        prompt_ids,
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float = 1.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        seed: int = 0,
+        stop_id: int | None = None,
+        deadline_s: float | None = None,
+        timeout: float | None = None,
+    ) -> Result:
+        """Blocking one-call generation."""
+        handle = self.submit(
+            Request(
+                prompt_ids=tuple(int(t) for t in prompt_ids),
+                max_new_tokens=(
+                    self.default_max_new_tokens
+                    if max_new_tokens is None
+                    else max_new_tokens
+                ),
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+                seed=seed,
+                stop_id=self.default_stop_id if stop_id is None else stop_id,
+                deadline_s=deadline_s,
+            )
+        )
+        return handle.result(timeout)
+
+    def stream(self, request: Request) -> Iterator[int]:
+        """Submit and yield token ids as they are generated."""
+        return self.submit(request).tokens()
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a queued or in-flight request."""
+        if self.scheduler.cancel(request_id):
+            return True
+        with self._entries_lock:
+            entry = self._entries.get(request_id)
+        if entry is not None and not entry.done.is_set():
+            entry.cancel_requested = True
+            return True
+        return False
+
+    def stats(self) -> dict:
+        return {
+            "slots": self.engine.n_slots,
+            "active_slots": self.engine.active_count,
+            "queue_depth": self.scheduler.depth,
+            "ticks": self.engine.ticks,
+            "tokens_emitted": self.engine.tokens_emitted,
+            "requests_finished": self._requests_finished,
+            "compiled_programs": self.engine.compiled_programs(),
+            "prefill_buckets": list(self.engine.buckets),
+        }
+
+    # ------------------------------------------------------------ batch mode
+
+    def run_batch(self, prompts: list, **knobs) -> list[Result]:
+        """Offline batch: submit every prompt (waiting out backpressure
+        instead of failing) and return results in input order."""
+        handles: list[RequestHandle] = []
+        for prompt in prompts:
+            while True:
+                try:
+                    handles.append(
+                        self.submit(
+                            Request(
+                                prompt_ids=tuple(int(t) for t in prompt),
+                                **{
+                                    "max_new_tokens": self.default_max_new_tokens,
+                                    "stop_id": self.default_stop_id,
+                                    **knobs,
+                                },
+                            )
+                        )
+                    )
+                    break
+                except QueueFullError:
+                    time.sleep(0.005)  # the worker is draining the queue
+        return [h.result() for h in handles]
+
+    def serve_batch_file(
+        self, prompts_path, output_path, **knobs
+    ) -> list[Result]:
+        """Offline file mode: one prompt per input line -> one JSONL result
+        line per prompt (input order), tokenizing/detokenizing with the
+        attached tokenizer."""
+        if self.tokenizer is None:
+            raise ValueError("batch file mode needs a tokenizer")
+        lines = [
+            ln
+            for ln in Path(prompts_path).read_text(
+                encoding="utf-8"
+            ).splitlines()
+            if ln.strip()
+        ]
+        prompts = [self.tokenizer.encode(ln) for ln in lines]
+        results = self.run_batch(prompts, **knobs)
+        with open(output_path, "w", encoding="utf-8") as f:
+            for text, result in zip(lines, results):
+                ids = list(result.token_ids)
+                if result.finish_reason == "stop":
+                    ids = ids[:-1]  # don't render the stop token itself
+                f.write(
+                    json.dumps(
+                        {
+                            "prompt": text,
+                            "completion": self.tokenizer.decode(ids),
+                            "finish_reason": result.finish_reason,
+                            "n_tokens": len(result.token_ids),
+                            **result.timings(),
+                        }
+                    )
+                    + "\n"
+                )
+        return results
+
+    # ---------------------------------------------------------- worker loop
+
+    def _run(self) -> None:
+        try:
+            while self._running:
+                if not self._step():
+                    self.scheduler.wait_for_work(self._idle_poll_s)
+        except BaseException as exc:  # noqa: BLE001 — fail loudly, unblock callers
+            self._worker_error = exc
+            self._running = False
+            if self._telemetry is not None:
+                self._telemetry.event("serve_worker_error", error=repr(exc))
+            for slot in list(self._slot_entries):
+                entry = self._slot_entries.pop(slot)
+                self.engine.release(slot)
+                self._finish(entry, "error")
+            # Every other registered request must unblock too — queued ones
+            # AND ones popped for admission when the step raised: their
+            # callers are parked on done.wait() and nothing else will run
+            # the queue again.  (_finish is idempotent, so sweeping the
+            # registry after the explicit drains is safe.)
+            drain = self.scheduler.pop_ready(self.scheduler.max_queue)
+            for qe in drain.admit + drain.expired + drain.cancelled:
+                self._finish(qe.item, "error")
+            with self._entries_lock:
+                leftover = list(self._entries.values())
+            for entry in leftover:
+                self._finish(entry, "error")
+
+    def _step(self) -> bool:
+        """One engine-loop iteration: cancellations, admissions (prefill),
+        then a decode tick.  Returns whether any work happened."""
+        worked = False
+
+        # In-flight cancellations retire their slots before the next tick.
+        for slot, entry in list(self._slot_entries.items()):
+            if entry.cancel_requested:
+                del self._slot_entries[slot]
+                self.engine.release(slot)
+                self._finish(entry, "cancelled")
+                worked = True
+
+        pop = self.scheduler.pop_ready(
+            self.engine.free_slots, engine_idle=self.engine.active_count == 0
+        )
+        for qe in pop.cancelled:
+            self._finish(qe.item, "cancelled")
+            worked = True
+        for qe in pop.expired:
+            self._finish(qe.item, "deadline")
+            worked = True
+        for qe in pop.admit:
+            self._admit(qe.item)
+            worked = True
+
+        if self.engine.active_count:
+            t0 = self._clock()
+            events = self.engine.tick()
+            self._deliver(events, self._clock() - t0)
+            worked = True
+        self._maybe_emit_engine_record()
+        return worked
+
+    def _admit(self, entry: _Entry) -> None:
+        request = entry.request
+        t0 = self._clock()
+        entry.queue_wait_s = t0 - entry.t_submit
+        event = self.engine.admit(
+            request.prompt_ids,
+            max_new_tokens=request.max_new_tokens,
+            temperature=request.temperature,
+            top_k=request.top_k,
+            top_p=request.top_p,
+            seed=request.seed,
+            stop_id=request.stop_id,
+        )
+        now = self._clock()
+        entry.prefill_s = now - t0
+        entry.t_decode_start = now
+        entry.slot = event.slot
+        self._span("queue_wait", entry.t_submit, entry.queue_wait_s, request)
+        self._span("prefill", t0, entry.prefill_s, request)
+        entry.tokens.append(event.token)
+        entry.stream.put(event.token)
+        if event.finished:
+            self._finish(entry, event.finished)
+        else:
+            self._slot_entries[event.slot] = entry
+
+    def _deliver(self, events: list[TickEvent], tick_s: float) -> None:
+        for event in events:
+            entry = self._slot_entries.get(event.slot)
+            if entry is None:
+                continue  # released between admit and tick (cancellation)
+            entry.tokens.append(event.token)
+            entry.stream.put(event.token)
+            if event.finished:
+                del self._slot_entries[event.slot]
+                self._finish(entry, event.finished)
+
+    def _finish(self, entry: _Entry, reason: str) -> None:
+        if entry.done.is_set():
+            return
+        now = self._clock()
+        decode_s = (
+            now - entry.t_decode_start if entry.slot is not None else 0.0
+        )
+        if entry.slot is not None:
+            self._span("decode", entry.t_decode_start, decode_s, entry.request)
+        elif reason in ("deadline", "cancelled"):
+            # Never admitted: the whole life was queue wait.
+            entry.queue_wait_s = now - entry.t_submit
+            self._span("queue_wait", entry.t_submit, entry.queue_wait_s,
+                       entry.request)
+        entry.result = Result(
+            request_id=entry.request.request_id,
+            token_ids=tuple(entry.tokens),
+            finish_reason=reason,
+            queue_wait_s=entry.queue_wait_s,
+            prefill_s=entry.prefill_s,
+            decode_s=decode_s,
+        )
+        self._requests_finished += 1
+        with self._entries_lock:
+            self._entries.pop(entry.request.request_id, None)
+        entry.stream.put(_STREAM_END)
+        entry.done.set()
+
+    # ------------------------------------------------------------ telemetry
+
+    def _span(self, name: str, start: float, dur: float, request: Request):
+        """Emit one request-phase span record.  Spans are emitted directly
+        (not via Telemetry's nesting stack — concurrent requests interleave,
+        so LIFO nesting does not apply)."""
+        if self._telemetry is None:
+            return
+        self._telemetry.emit(
+            {
+                "kind": "span",
+                "name": name,
+                "path": f"serve/{name}",
+                "t": round(start - self._t0, 6),
+                "dur_s": round(dur, 6),
+                "request_id": request.request_id,
+            }
+        )
+
+    def _maybe_emit_engine_record(self) -> None:
+        if self._telemetry is None:
+            return
+        now = self._clock()
+        elapsed = now - self._last_record_t
+        if elapsed < self._record_every_s:
+            return
+        tokens = self.engine.tokens_emitted
+        # A fully idle engine stays silent (no tokens since the last record
+        # and nothing in flight) — an idle server must not grow its JSONL.
+        if (
+            tokens == self._last_record_tokens
+            and not self.engine.active_count
+            and not self.scheduler.depth
+        ):
+            self._last_record_t = now
+            return
+        self._telemetry.emit(
+            {
+                "kind": "engine",
+                "t": round(now - self._t0, 6),
+                "active_slots": self.engine.active_count,
+                "queue_depth": self.scheduler.depth,
+                "tokens_per_sec": round(
+                    (tokens - self._last_record_tokens) / max(elapsed, 1e-9), 3
+                ),
+                "tokens_total": tokens,
+                "ticks": self.engine.ticks,
+                "requests_finished": self._requests_finished,
+                "compiled_programs": self.engine.compiled_programs(),
+            }
+        )
+        self._last_record_t = now
+        self._last_record_tokens = tokens
+
+
+# ------------------------------------------------------------------ HTTP
+
+def make_http_server(
+    serving: ServingEngine, host: str = "127.0.0.1", port: int = 8000
+):
+    """A `ThreadingHTTPServer` exposing the serving engine as JSON-over-HTTP
+    (stdlib only — no web framework dependency):
+
+    * ``POST /generate`` — body ``{"prompt": str | "prompt_ids": [int],
+      "max_new_tokens"?, "temperature"?, "top_k"?, "top_p"?, "seed"?,
+      "stop_id"?, "deadline_s"?}`` -> ``{"completion"?, "token_ids",
+      "finish_reason", "timings", "request_id"}``; 400 on bad input, 503
+      when the admission queue is full (backpressure).
+    * ``GET /healthz`` — engine/queue stats.
+
+    ``port=0`` binds an ephemeral port (tests); the caller owns
+    ``serve_forever()`` / ``shutdown()``.
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        # Bounded request read + quiet logs: serving telemetry is the
+        # observable surface, not stderr.
+        def log_message(self, *args):  # noqa: D102
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            if self.path != "/healthz":
+                return self._reply(404, {"error": "unknown path"})
+            self._reply(200, {"ok": True, **serving.stats()})
+
+        def do_POST(self):  # noqa: N802 (stdlib API)
+            if self.path != "/generate":
+                return self._reply(404, {"error": "unknown path"})
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                prompt_ids = body.get("prompt_ids")
+                if prompt_ids is None:
+                    prompt = body.get("prompt")
+                    if prompt is None:
+                        raise ValueError("need 'prompt' or 'prompt_ids'")
+                    if serving.tokenizer is None:
+                        raise ValueError(
+                            "'prompt' needs a tokenizer; send 'prompt_ids'"
+                        )
+                    prompt_ids = serving.tokenizer.encode(prompt)
+                result = serving.generate(
+                    prompt_ids,
+                    max_new_tokens=body.get("max_new_tokens"),
+                    temperature=float(body.get("temperature", 1.0)),
+                    top_k=body.get("top_k"),
+                    top_p=body.get("top_p"),
+                    seed=int(body.get("seed", 0)),
+                    stop_id=body.get("stop_id"),
+                    deadline_s=body.get("deadline_s"),
+                )
+            except QueueFullError as exc:
+                return self._reply(503, {"error": str(exc)})
+            except (ValueError, TypeError, json.JSONDecodeError) as exc:
+                return self._reply(400, {"error": str(exc)})
+            except RuntimeError as exc:
+                # Engine not running / worker died: a JSON 503 beats the
+                # stdlib handler's closed socket.
+                return self._reply(503, {"error": str(exc)})
+            payload = {
+                "request_id": result.request_id,
+                "token_ids": list(result.token_ids),
+                "finish_reason": result.finish_reason,
+                "timings": result.timings(),
+            }
+            if serving.tokenizer is not None:
+                ids = list(result.token_ids)
+                if result.finish_reason == "stop":
+                    ids = ids[:-1]  # the stop token itself isn't prose
+                payload["completion"] = serving.tokenizer.decode(ids)
+            self._reply(200, payload)
+
+    return ThreadingHTTPServer((host, port), Handler)

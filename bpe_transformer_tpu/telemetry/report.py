@@ -8,9 +8,11 @@ header, step-metric records, span/event records, and a footer.
     bpe-tpu report run/metrics.jsonl
     python -m bpe_transformer_tpu.telemetry.report run/metrics.jsonl
 
-Sections: run manifest, loss-curve stats, throughput/MFU trajectory, span
-breakdown, health summary, and an anomaly list (non-finite records, loss
-spikes, watchdog/NaN events, a missing or unclean footer).
+Sections: run manifest, loss-curve stats, throughput/MFU trajectory, a
+serving summary (engine records + per-request queue_wait/prefill/decode
+span percentiles, for ``bpe-tpu serve`` streams), span breakdown, health
+summary, and an anomaly list (non-finite records, loss spikes,
+watchdog/NaN/serving events, a missing or unclean footer).
 """
 
 from __future__ import annotations
@@ -79,6 +81,17 @@ def _stats(values: list[float]) -> dict:
     }
 
 
+def _pctl(values: list[float], q: float) -> float | None:
+    """Nearest-rank percentile (q in [0, 1]) of the finite values."""
+    finite = sorted(
+        v for v in values if isinstance(v, (int, float)) and math.isfinite(v)
+    )
+    if not finite:
+        return None
+    rank = min(len(finite) - 1, max(0, math.ceil(q * len(finite)) - 1))
+    return finite[rank]
+
+
 def _loss_spikes(steps: list[dict], ratio: float = 1.5) -> list[dict]:
     """Step pairs where the logged loss jumped by more than ``ratio``x —
     the classic instability signature between two log boundaries."""
@@ -109,6 +122,7 @@ def summarize(records: list[dict]) -> dict:
     footer = next((r for r in reversed(records) if r.get("kind") == "footer"), None)
     spans = [r for r in records if r.get("kind") == "span"]
     events = [r for r in records if r.get("kind") == "event"]
+    engines = [r for r in records if r.get("kind") == "engine"]
     steps = [r for r in records if "kind" not in r and "step" in r and "loss" in r]
     vals = [r for r in records if "kind" not in r and "val_loss" in r]
 
@@ -141,16 +155,65 @@ def summarize(records: list[dict]) -> dict:
             f"{spike['prev_loss']:.4g} -> {spike['loss']:.4g}"
         )
     for event in events:
-        if event.get("name") in ("nonfinite", "watchdog_hang"):
+        if event.get("name") in ("nonfinite", "watchdog_hang", "serve_worker_error"):
             anomalies.append(
                 f"{event['name']} event"
                 + (f" at step {event['step']}" if event.get("step") is not None else "")
                 + (f" (silent {event['silent_s']}s)" if "silent_s" in event else "")
+                + (f": {event['error']}" if "error" in event else "")
             )
-    if steps and footer is None:
+    if (steps or engines) and footer is None:
         anomalies.append("no footer record — the run did not shut down cleanly")
     elif footer is not None and footer.get("clean") is False:
         anomalies.append("footer reports an unclean run")
+
+    # Serving-engine summary: periodic {"kind": "engine"} records plus the
+    # per-request serve/queue_wait|prefill|decode spans the serving layer
+    # emits (serving/server.py).
+    serving = None
+    serve_spans = [
+        s for s in spans if str(s.get("path", "")).startswith("serve/")
+    ]
+    if engines or serve_spans:
+        phase_durs = {
+            phase: [
+                s.get("dur_s")
+                for s in serve_spans
+                if s.get("path") == f"serve/{phase}"
+            ]
+            for phase in ("queue_wait", "prefill", "decode")
+        }
+        requests = (
+            footer.get("requests")
+            if footer is not None and isinstance(footer.get("requests"), int)
+            else len(phase_durs["decode"]) or len(phase_durs["queue_wait"])
+        )
+        serving = {
+            "n_engine_records": len(engines),
+            "requests": requests,
+            "tokens_per_sec": _stats(
+                [r.get("tokens_per_sec") for r in engines]
+            ),
+            "active_slots": _stats([r.get("active_slots") for r in engines]),
+            "queue_depth": _stats([r.get("queue_depth") for r in engines]),
+            "compiled_programs": max(
+                (
+                    r["compiled_programs"]
+                    for r in engines
+                    if isinstance(r.get("compiled_programs"), int)
+                ),
+                default=None,
+            ),
+            "phases": {
+                phase: {
+                    "n": len([d for d in durs if isinstance(d, (int, float))]),
+                    "p50_s": _pctl(durs, 0.50),
+                    "p95_s": _pctl(durs, 0.95),
+                    "max_s": _pctl(durs, 1.0),
+                }
+                for phase, durs in phase_durs.items()
+            },
+        }
 
     health_last = {}
     for record in steps:
@@ -182,6 +245,7 @@ def summarize(records: list[dict]) -> dict:
             "step_wall_s": _stats([r["step_wall_s"] for r in steps if "step_wall_s" in r]),
             "mfu": _stats([r["mfu"] for r in steps if "mfu" in r]),
         },
+        "serving": serving,
         "spans": span_breakdown,
         "health_last": health_last,
         "events": [e.get("name") for e in events],
@@ -262,6 +326,42 @@ def render_report(records: list[dict]) -> str:
             lines.append(
                 f"  mfu {_fmt(tp['mfu'].get('last'))} (peak {_fmt(tp['mfu'].get('max'))})"
             )
+
+    sv = s["serving"]
+    if sv:
+        lines.append("== serving ==")
+        lines.append(
+            f"  requests {sv['requests']}"
+            + (
+                f"  compiled_programs {sv['compiled_programs']}"
+                if sv["compiled_programs"] is not None
+                else ""
+            )
+            + f"  engine records {sv['n_engine_records']}"
+        )
+        if sv["tokens_per_sec"]:
+            t = sv["tokens_per_sec"]
+            lines.append(
+                f"  tokens/sec mean {_fmt(t.get('mean'), 6)}"
+                f"  (peak {_fmt(t.get('max'), 6)})"
+            )
+        if sv["active_slots"]:
+            lines.append(
+                f"  active slots mean {_fmt(sv['active_slots'].get('mean'))}"
+                f"  max {_fmt(sv['active_slots'].get('max'))}"
+                + (
+                    f"  queue depth max {_fmt(sv['queue_depth'].get('max'))}"
+                    if sv["queue_depth"]
+                    else ""
+                )
+            )
+        for phase in ("queue_wait", "prefill", "decode"):
+            ph = sv["phases"][phase]
+            if ph["n"]:
+                lines.append(
+                    f"  {phase:<11s} n={ph['n']:<4d} p50 {_fmt(ph['p50_s'])}s"
+                    f"  p95 {_fmt(ph['p95_s'])}s  max {_fmt(ph['max_s'])}s"
+                )
 
     if s["spans"]:
         lines.append("== spans ==")

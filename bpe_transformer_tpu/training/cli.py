@@ -10,6 +10,8 @@ CLI/argparse anywhere"); this is the framework's real entry point:
                   --steps 5000 --batch-size 64 --checkpoint-dir ckpt/
     bpe-tpu generate --checkpoint ckpt/latest.ckpt --tokenizer-dir tok/ \
                      --prompt "Once upon a time"
+    bpe-tpu serve    --checkpoint ckpt/latest.ckpt --tokenizer-dir tok/ \
+                     --slots 8 --port 8000 --metrics-jsonl serve.jsonl
 """
 
 from __future__ import annotations
@@ -177,17 +179,32 @@ def cmd_train(args) -> int:
     return 0
 
 
-def cmd_eval(args) -> int:
-    import jax.numpy as jnp
-
+def _load_inference_state(args, *, need_tokenizer: bool):
+    """The checkpoint-restore + config-resolution (+ tokenizer-load)
+    sequence every inference command shares (eval / generate / serve):
+    returns ``(payload, model_config, tokenizer)`` with the architecture
+    taken from the checkpoint's stored config unless overridden (see
+    `_load_model_config`).  ``tokenizer`` is None when not requested —
+    eval scores token files directly."""
     from bpe_transformer_tpu.checkpointing import load_checkpoint
-    from bpe_transformer_tpu.data import get_batch, load_token_file
-    from bpe_transformer_tpu.training.train_step import make_eval_step
 
     payload = load_checkpoint(args.checkpoint)
     model_config = _load_model_config(
         args, stored=payload.get("extra", {}).get("model_config")
     )
+    tokenizer = None
+    if need_tokenizer:
+        tokenizer = _load_tokenizer(args.tokenizer_dir, _specials(args))
+    return payload, model_config, tokenizer
+
+
+def cmd_eval(args) -> int:
+    import jax.numpy as jnp
+
+    from bpe_transformer_tpu.data import get_batch, load_token_file
+    from bpe_transformer_tpu.training.train_step import make_eval_step
+
+    payload, model_config, _ = _load_inference_state(args, need_tokenizer=False)
     eval_step = make_eval_step(model_config)
     data = load_token_file(args.data, args.dtype)
     rng = np.random.default_rng(args.seed)
@@ -202,18 +219,15 @@ def cmd_eval(args) -> int:
 def cmd_generate(args) -> int:
     import dataclasses
 
-    from bpe_transformer_tpu.checkpointing import load_checkpoint
     from bpe_transformer_tpu.training.sampling import generate_text
 
-    payload = load_checkpoint(args.checkpoint)
-    model_config = _load_model_config(
-        args, stored=payload.get("extra", {}).get("model_config")
+    payload, model_config, tokenizer = _load_inference_state(
+        args, need_tokenizer=True
     )
     if args.decode_attention:
         model_config = dataclasses.replace(
             model_config, decode_attention_impl=args.decode_attention
         )
-    tokenizer = _load_tokenizer(args.tokenizer_dir, _specials(args))
     with _maybe_profile_trace(args.profile_trace):
         text = generate_text(
             payload["params"],
@@ -228,6 +242,98 @@ def cmd_generate(args) -> int:
         )
     print(text)
     return 0
+
+
+def cmd_serve(args) -> int:
+    """Continuous-batching inference: offline batch mode when
+    ``--prompts-file`` is given, else the HTTP JSON endpoint."""
+    from bpe_transformer_tpu.serving import ServingEngine, make_http_server
+    from bpe_transformer_tpu.telemetry import (
+        MetricsLogger,
+        Telemetry,
+        run_manifest,
+    )
+
+    if args.prompts_file and not args.output:
+        print("serve: --prompts-file needs --output", file=sys.stderr)
+        return 2
+    payload, model_config, tokenizer = _load_inference_state(
+        args, need_tokenizer=True
+    )
+    stop_id = None
+    if tokenizer.special_tokens:
+        stop_id = tokenizer.encode(tokenizer.special_tokens[0])[0]
+
+    logger = MetricsLogger(jsonl_path=args.metrics_jsonl)
+    telemetry = Telemetry(sink=logger.log) if args.metrics_jsonl else None
+    if telemetry is not None:
+        telemetry.emit(run_manifest(kind="serve", model_config=model_config))
+
+    serving = ServingEngine(
+        payload["params"],
+        model_config,
+        tokenizer=tokenizer,
+        slots=args.slots,
+        max_queue=args.max_queue,
+        max_wait_s=args.max_wait,
+        default_stop_id=stop_id,
+        default_max_new_tokens=args.max_new_tokens,
+        telemetry=telemetry,
+    )
+    try:
+        with serving:
+            if args.prompts_file:
+                results = serving.serve_batch_file(
+                    args.prompts_file,
+                    args.output,
+                    max_new_tokens=args.max_new_tokens,
+                    temperature=args.temperature,
+                    top_k=args.top_k,
+                    top_p=args.top_p,
+                    seed=args.seed,
+                )
+                reasons: dict[str, int] = {}
+                for r in results:
+                    reasons[r.finish_reason] = reasons.get(r.finish_reason, 0) + 1
+                print(
+                    json.dumps(
+                        {
+                            "prompts": len(results),
+                            "finish_reasons": reasons,
+                            "output": args.output,
+                            **serving.stats(),
+                        }
+                    )
+                )
+                return 0
+            server = make_http_server(serving, host=args.host, port=args.port)
+            host, port = server.server_address[:2]
+            # A service is stopped with SIGTERM (kill, container runtimes):
+            # route it through the same clean-shutdown path as Ctrl-C so the
+            # telemetry stream always ends with a footer (a stream without
+            # one reads as a crash in `bpe-tpu report`).
+            import signal
+
+            def _sigterm(signum, frame):
+                raise KeyboardInterrupt
+
+            signal.signal(signal.SIGTERM, _sigterm)
+            print(
+                f"serving on http://{host}:{port}  "
+                f"(slots={args.slots}, queue={args.max_queue}; "
+                "POST /generate, GET /healthz; Ctrl-C/SIGTERM to stop)",
+                flush=True,
+            )
+            try:
+                server.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                server.shutdown()
+                server.server_close()
+            return 0
+    finally:
+        logger.close()
 
 
 def cmd_report(args) -> int:
@@ -409,6 +515,46 @@ def build_parser() -> argparse.ArgumentParser:
         help="capture a jax.profiler trace of the generation under DIR",
     )
     p.set_defaults(fn=cmd_generate)
+
+    p = sub.add_parser(
+        "serve",
+        help="continuous-batching inference: HTTP JSON endpoint, or offline "
+        "batch mode with --prompts-file/--output",
+    )
+    p.add_argument("--checkpoint", required=True)
+    p.add_argument("--tokenizer-dir", required=True)
+    # default None: prefer the config stored inside the checkpoint.
+    p.add_argument("--preset", default=None, choices=sorted(PRESETS))
+    p.add_argument("--model-config", default=None)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000,
+                   help="HTTP port (0: ephemeral)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="concurrent in-flight generations (KV-cache pool "
+                   "capacity)")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="admission queue capacity; beyond it requests are "
+                   "rejected with 503 (backpressure)")
+    p.add_argument("--max-wait", type=float, default=0.0,
+                   help="seconds an idle engine may hold admissions to "
+                   "batch prefills (bounded extra latency)")
+    p.add_argument("--max-new-tokens", type=int, default=128,
+                   help="default per-request generation budget")
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prompts-file", default=None,
+                   help="offline batch mode: one prompt per line in, "
+                   "completions JSONL out (--output); no HTTP server")
+    p.add_argument("--output", default=None,
+                   help="JSONL results path for --prompts-file")
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="append serving telemetry (request spans, engine "
+                   "records) to this file; summarize with bpe-tpu report")
+    p.add_argument("--special-token", action="append", default=None,
+                   help='repeatable; default: ["<|endoftext|>"]')
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
         "report",

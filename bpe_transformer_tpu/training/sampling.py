@@ -69,7 +69,11 @@ def generate_ids(
             temperature=temperature,
             top_k=top_k,
             top_p=top_p,
+            stop_id=stop_id,
         )
+        # Post-stop tokens are pinned to stop_id inside the scan, so
+        # truncating at the first occurrence reproduces the sliding-window
+        # path's early exit exactly.
         out = [int(t) for t in np.asarray(ids[0])]
         if stop_id is not None and stop_id in out:
             out = out[: out.index(stop_id) + 1]

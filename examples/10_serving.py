@@ -1,0 +1,116 @@
+"""Continuous-batching serving: the offline batch mode on CPU.
+
+The ROADMAP's "serve heavy traffic" leg (`bpe_transformer_tpu/serving/`):
+a fixed pool of KV-cache slots decodes many requests through ONE jitted
+step per tick, prefill pads prompts into power-of-two length buckets so
+the engine compiles a bounded set of programs, and a FIFO scheduler feeds
+free slots as requests arrive.  This demo walks the offline batch mode —
+prompts file in, completions JSONL out — and checks the two properties
+that make the engine trustworthy:
+
+* **parity**: at temperature=0 every batched completion is byte-identical
+  to a sequential `sampling.generate_ids` run of the same prompt;
+* **bounded compilation**: after serving ragged prompt lengths, the
+  compile counter stays at (prefill buckets used) + 1 — no per-request
+  recompiles.
+
+A byte-level model (vocab 256 + one stop token) keeps the demo
+self-contained; the weights are random — the point is the serving
+machinery, not the prose.
+
+Usage:
+    python examples/10_serving.py [--input PATH] [--new-tokens N]
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+import argparse
+import json
+
+DEFAULT_INPUT = Path("/root/reference/tests/fixtures/tinystories_sample.txt")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--input", type=Path, default=DEFAULT_INPUT)
+    parser.add_argument("--new-tokens", type=int, default=12)
+    args = parser.parse_args()
+
+    import jax
+
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.models.config import ModelConfig
+    from bpe_transformer_tpu.serving import ServingEngine
+    from bpe_transformer_tpu.tokenization import BPETokenizer
+    from bpe_transformer_tpu.training.sampling import generate_ids
+
+    config = ModelConfig(
+        vocab_size=257,  # bytes + the stop token
+        context_length=128,
+        d_model=64,
+        num_layers=2,
+        num_heads=4,
+        d_ff=128,
+    )
+    params = init_params(jax.random.PRNGKey(0), config)
+    tokenizer = BPETokenizer(
+        vocab={i: bytes([i]) for i in range(256)},
+        merges=[],
+        special_tokens=["<|endoftext|>"],  # id 256: the serving stop id
+    )
+
+    # Ragged prompts from the input text -> a prompts file, one per line.
+    text = args.input.read_text(encoding="utf-8", errors="ignore")
+    lines = [ln.strip() for ln in text.splitlines() if ln.strip()]
+    prompts = [lines[i % len(lines)][: 6 + 13 * i] for i in range(6)]
+    prompts_path = Path("serving_prompts.txt")
+    prompts_path.write_text("\n".join(prompts) + "\n", encoding="utf-8")
+
+    out_path = Path("serving_completions.jsonl")
+    with ServingEngine(
+        params,
+        config,
+        tokenizer=tokenizer,
+        slots=3,  # fewer slots than prompts: retirement + re-admission
+        min_bucket=16,
+        default_stop_id=256,
+    ) as serving:
+        results = serving.serve_batch_file(
+            prompts_path, out_path,
+            max_new_tokens=args.new_tokens, temperature=0.0,
+        )
+        stats = serving.stats()
+
+    rows = [json.loads(ln) for ln in out_path.read_text().splitlines()]
+    print(
+        f"served {len(rows)} prompts through {stats['slots']} slots: "
+        f"{stats['tokens_emitted']} tokens in {stats['ticks']} ticks, "
+        f"buckets={stats['prefill_buckets']}, "
+        f"compiled {stats['compiled_programs']} programs "
+        f"(bound: {len(stats['prefill_buckets']) + 1})"
+    )
+    assert stats["compiled_programs"] <= len(stats["prefill_buckets"]) + 1
+
+    # Batched-vs-sequential parity at temperature 0.
+    for prompt, result in zip(prompts, results):
+        expected = generate_ids(
+            params, config, tokenizer.encode(prompt),
+            max_new_tokens=args.new_tokens, temperature=0.0, stop_id=256,
+        )
+        assert list(result.token_ids) == expected, "parity violated"
+    print(
+        "every batched completion matches its sequential generate_ids run "
+        "(temperature=0, byte-identical)"
+    )
+    print(f"first completion: {rows[0]['completion']!r}")
+    print("serving demo OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -372,6 +372,171 @@ def test_prefill_flash_matches_xla(setup):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_sample_from_logits_edge_cases():
+    """Sampler edge cases: temperature=0 (greedy argmax), top_k=1, the
+    top_p mass boundary, and combined top_k+top_p filtering."""
+    from bpe_transformer_tpu.models.decode import _sample_from_logits
+
+    probs = [0.6, 0.25, 0.1, 0.04, 0.01]
+    logits = jnp.log(jnp.asarray([probs], jnp.float32))
+
+    # temperature=0: exact greedy, RNG-independent.
+    for seed in range(4):
+        tok = _sample_from_logits(
+            logits, jax.random.PRNGKey(seed), temperature=0.0, top_k=None
+        )
+        assert int(tok[0]) == 0
+
+    # top_k=1: only the argmax survives at ANY temperature.
+    for seed in range(8):
+        tok = _sample_from_logits(
+            logits, jax.random.PRNGKey(seed), temperature=2.0, top_k=1
+        )
+        assert int(tok[0]) == 0
+
+    def support(top_k, top_p, n=40):
+        seen = set()
+        for seed in range(n):
+            tok = _sample_from_logits(
+                logits, jax.random.PRNGKey(seed), temperature=1.0,
+                top_k=top_k, top_p=top_p,
+            )
+            seen.add(int(tok[0]))
+        return seen
+
+    # top_p mass boundary: "mass BEFORE the token < p" means p exactly at
+    # the leading probability excludes the runner-up; a hair above admits
+    # it (the cumulative 0.6 is no longer < 0.6, but IS < 0.61).
+    assert support(None, 0.6) == {0}
+    assert support(None, 0.61) == {0, 1}
+
+    # Combined: top_p acts on the top_k-RENORMALIZED distribution.  With
+    # top_k=2 the two survivors renormalize to ~{0.706, 0.294}; p=0.4 cuts
+    # the runner-up there, p=0.99 keeps exactly the top-k pair.
+    assert support(2, 0.4) == {0}
+    assert support(2, 0.99) == {0, 1}
+
+
+def test_generate_cached_stop_id_pins_and_truncates(setup):
+    """Satellite: the KV-cached fast path honors stop_id — post-stop tokens
+    are pinned to stop_id inside the scan, and generate_ids' host-side
+    truncation makes cached and sliding-window generation agree on stopped
+    sequences."""
+    from bpe_transformer_tpu.training.sampling import generate_ids
+
+    params, ids = setup
+    prompt = [int(t) for t in np.asarray(ids[0, :5])]
+    free_run = generate_ids(params, CFG, prompt, max_new_tokens=10, temperature=0.0)
+    sid = free_run[4]
+    first = free_run.index(sid)
+
+    # The raw cached program: stop at the first occurrence, then pinned.
+    out = generate_cached(
+        params,
+        jnp.asarray([prompt], jnp.int32),
+        jax.random.PRNGKey(0),
+        config=CFG,
+        max_new_tokens=10,
+        temperature=0.0,
+        stop_id=int(sid),
+    )
+    out = [int(t) for t in np.asarray(out[0])]
+    assert out[first] == sid
+    assert out[: first + 1] == free_run[: first + 1]
+    assert all(t == sid for t in out[first:]), "post-stop tokens not pinned"
+
+    # generate_ids (fast path) truncates to ... + [stop_id], agreeing with
+    # the sliding-window path's early exit semantics.
+    stopped = generate_ids(
+        params, CFG, prompt, max_new_tokens=10, temperature=0.0,
+        stop_id=int(sid),
+    )
+    assert stopped == free_run[: first + 1]
+
+    # And with a stop_id that never fires, output is unchanged.
+    never = generate_ids(
+        params, CFG, prompt, max_new_tokens=10, temperature=0.0,
+        stop_id=CFG.vocab_size + 7,
+    )
+    assert never == free_run
+
+
+def test_decode_step_vector_positions_match_scalar(setup):
+    """The per-slot generalization: a (B,) position vector with an active
+    mask reproduces the scalar-pos logits for each row at its own depth,
+    and inactive rows leave their cache untouched."""
+    params, ids = setup
+    full = forward(params, ids, CFG)
+
+    # Two sequences prefixed to DIFFERENT lengths inside one batched cache.
+    plens = [4, 7]
+    cache = init_kv_cache(CFG, 2)
+    for row, plen in enumerate(plens):
+        row_cache = init_kv_cache(CFG, 1)
+        _, row_cache = prefill(params, ids[row : row + 1, :plen], CFG, row_cache)
+        cache = [
+            {
+                "k": layer["k"].at[row].set(filled["k"][0]),
+                "v": layer["v"].at[row].set(filled["v"][0]),
+            }
+            for layer, filled in zip(cache, row_cache)
+        ]
+
+    pos = jnp.asarray(plens)
+    tokens = jnp.stack([ids[0, plens[0]], ids[1, plens[1]]])
+
+    # Both rows active at ragged depths: each row's logits match the full
+    # forward at ITS position.
+    logits, new_cache = decode_step(
+        params, tokens, pos, cache, CFG, active=jnp.asarray([True, True])
+    )
+    for row, plen in enumerate(plens):
+        np.testing.assert_allclose(
+            np.asarray(logits[row]), np.asarray(full[row, plen]), atol=1e-4,
+            err_msg=f"row {row} at pos {plen}",
+        )
+    assert not np.array_equal(
+        np.asarray(new_cache[0]["k"][1]), np.asarray(cache[0]["k"][1])
+    )
+
+    # Inactive rows freeze: row 1's cache is bit-identical after the step
+    # (its logits are computed but discarded by the engine).
+    _, masked_cache = decode_step(
+        params, tokens, pos, cache, CFG, active=jnp.asarray([True, False])
+    )
+    assert not np.array_equal(
+        np.asarray(masked_cache[0]["k"][0]), np.asarray(cache[0]["k"][0])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(masked_cache[0]["k"][1]), np.asarray(cache[0]["k"][1])
+    )
+
+
+def test_vector_pos_pallas_matches_xla(setup):
+    """The flash-decoding kernel accepts per-batch causal frontiers: same
+    outputs as the grouped-einsum path at ragged positions."""
+    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+        decode_attention,
+        xla_decode_attention,
+    )
+
+    rng = np.random.default_rng(11)
+    B, H, KV, ctx, d = 3, 4, 2, 32, 8
+    q = jnp.asarray(rng.standard_normal((B, H, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, KV, ctx, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, KV, ctx, d)), jnp.float32)
+    pos = jnp.asarray([3, 17, 31])
+    ref = xla_decode_attention(q, k, v, pos)
+    out = decode_attention(q, k, v, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # Scalar pos still matches (the pre-generalization contract).
+    np.testing.assert_allclose(
+        np.asarray(decode_attention(q, k, v, 9)),
+        np.asarray(xla_decode_attention(q, k, v, 9)),
+        atol=2e-5,
+    )
+
+
 def test_top_k_threshold_matches_sort_formulation():
     """lax.top_k thresholding is equivalent to the previous full-sort kth
     selection (ties included: everything >= the k-th largest survives)."""

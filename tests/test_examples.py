@@ -115,6 +115,13 @@ def test_example_pipeline_parallel(tmp_path, sample):
     assert "matches the single-device update" in out
 
 
+def test_example_serving(tmp_path, sample):
+    out = run_example(tmp_path, sample, "10_serving.py", "--new-tokens", "6")
+    assert "serving demo OK" in out
+    assert "byte-identical" in out
+    assert (tmp_path / "serving_completions.jsonl").exists()
+
+
 def test_cli_report_on_fixture_jsonl(tmp_path):
     """`bpe-tpu report` smoke: summarize the committed tiny telemetry
     stream (manifest + spans + steps + clean footer) from the CLI."""
