@@ -129,6 +129,12 @@ run_job() {  # run_job <marker> <timeout_s> <outfile> <cmd...>
 # 1. Headline (always re-run: refreshes the replay capture).
 # BENCH_DRIVER_FLAG=0: a queue job must not raise the driver-priority flag
 # (a timeout-kill would orphan it and pause the rest of this very pass).
+# Snapshot the previous pass's capture FIRST: the regression self-report at
+# the end of this pass compares the freshly measured capture against it.
+HEADLINE_CAP="$CAP/tpu_capture_tinystories-4l.json"
+if [ -e "$HEADLINE_CAP" ]; then
+  cp -a "$HEADLINE_CAP" "$OUT/prev_headline_capture.json" 2>/dev/null || true
+fi
 run_job - 300 "$OUT/bench_headline.jsonl" env BENCH_DRIVER_FLAG=0 python bench.py
 
 # 1b. North-star convergence run (VERDICT r3 #2): TinyStories 4L at the real
@@ -281,6 +287,22 @@ run_job serve_gpt2s_4 1800 "$CAP/serving.jsonl" \
 # recovery watcher (tpu_watch.sh) owns that trap — it re-checks hourly,
 # independent of TPU windows, and disarms once the grid is captured.
 
+# Regression self-report (jax-free, CPU-only — holds no chip time): compare
+# this pass's freshly measured headline capture against the one the
+# previous pass left behind.  Exit 3 = regression beyond threshold; logged
+# loudly (and mirrored) but never fatal — the queue's job is evidence, the
+# report makes the delta machine-checked instead of eyeballed.
+if [ -e "$OUT/prev_headline_capture.json" ] && [ -e "$HEADLINE_CAP" ] && \
+   ! cmp -s "$OUT/prev_headline_capture.json" "$HEADLINE_CAP"; then
+  env JAX_PLATFORMS=cpu python -m bpe_transformer_tpu.telemetry.report \
+    "$HEADLINE_CAP" --baseline "$OUT/prev_headline_capture.json" \
+    >> "$OUT/log" 2>&1
+  case $? in
+    3) log "REGRESSION: headline capture regressed vs previous pass (report above)";;
+    0) log "headline capture delta vs previous pass: within threshold";;
+    *) log "headline regression self-report failed (non-fatal)";;
+  esac
+fi
 log "queue pass complete"
 # Same size guard as the restore: never shrink the mirrored history.
 if [ "$(stat -c%s "$OUT/log" 2>/dev/null || echo 0)" -ge "$(stat -c%s "$MIR/queue_log" 2>/dev/null || echo 0)" ]; then

@@ -1,0 +1,69 @@
+"""jax version compat: expose ``jax.shard_map`` on every supported jax.
+
+This framework (and its tests/benchmarks) calls ``jax.shard_map`` — the
+top-level name newer jax releases export.  jax 0.4.x ships the same
+function only as ``jax.experimental.shard_map.shard_map``; on such a
+runtime every explicit-collective code path (dp psum train step, ring/
+Ulysses sequence parallelism, pipeline stages) would die at call time with
+``AttributeError``.  :func:`ensure_shard_map` bridges the gap by aliasing
+the experimental symbol onto the ``jax`` module once per process.
+
+Torch-free on purpose (unlike its sibling ``compat.adapters``): the
+parallel package and the test suite apply it without dragging the
+reference-suite torch interop into jax-only processes.
+"""
+
+from __future__ import annotations
+
+
+def ensure_shard_map():
+    """Make ``jax.shard_map`` resolvable; returns the function.
+
+    Idempotent and cheap (one hasattr after the first call).  On 0.4.x the
+    alias also translates the modern ``check_vma=`` keyword (this repo's
+    spelling) to the old API's ``check_rep=`` — same meaning, renamed when
+    shard_map moved out of experimental.  Raises ``AttributeError`` only
+    when NEITHER spelling exists — a jax too old to run the parallel
+    strategies at all.
+    """
+    import functools
+    import inspect
+
+    import jax
+
+    if not hasattr(jax.lax, "axis_size"):
+        # Same API generation gap: jax.lax.axis_size arrived alongside
+        # top-level shard_map.  psum of the literal 1 over a named axis is
+        # the classic static spelling of the same value.  Patched before
+        # the shard_map early-return: a build could export one symbol but
+        # not the other.
+        def axis_size(axis_name):
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    base = getattr(jax, "shard_map", None)
+    if base is not None and getattr(base, "_bpe_tpu_shim", False):
+        return base  # already wrapped by an earlier call
+    from_experimental = base is None
+    if from_experimental:
+        from jax.experimental.shard_map import shard_map as base
+
+    wrapped = base
+    try:
+        has_check_vma = "check_vma" in inspect.signature(base).parameters
+    except (TypeError, ValueError):
+        has_check_vma = True  # unintrospectable: assume the modern API
+    if not has_check_vma:
+
+        @functools.wraps(base)
+        def wrapped(*args, check_vma=None, **kwargs):
+            if check_vma is not None and "check_rep" not in kwargs:
+                kwargs["check_rep"] = check_vma
+            return base(*args, **kwargs)
+
+        wrapped._bpe_tpu_shim = True  # after wraps: wraps copies __dict__
+
+    if wrapped is not base or from_experimental:
+        jax.shard_map = wrapped
+    return wrapped

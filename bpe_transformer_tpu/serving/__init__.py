@@ -15,6 +15,11 @@ from bpe_transformer_tpu.serving.engine import (
     TickEvent,
     default_prefill_buckets,
 )
+from bpe_transformer_tpu.serving.metrics import (
+    LatencyHistogram,
+    ServingMetrics,
+    render_prometheus,
+)
 from bpe_transformer_tpu.serving.scheduler import FifoScheduler, QueueFullError
 from bpe_transformer_tpu.serving.server import (
     Request,
@@ -26,13 +31,16 @@ from bpe_transformer_tpu.serving.server import (
 
 __all__ = [
     "FifoScheduler",
+    "LatencyHistogram",
     "QueueFullError",
     "Request",
     "RequestHandle",
     "Result",
     "ServingEngine",
+    "ServingMetrics",
     "SlotPoolEngine",
     "TickEvent",
     "default_prefill_buckets",
     "make_http_server",
+    "render_prometheus",
 ]

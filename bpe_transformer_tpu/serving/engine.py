@@ -255,6 +255,30 @@ class SlotPoolEngine:
         ``len(self.buckets) + 1`` (one prefill per bucket + one tick)."""
         return self._prefill_jit._cache_size() + self._tick_jit._cache_size()
 
+    def slot_states(self) -> list[dict]:
+        """Per-slot occupancy snapshot (the ``/statusz`` view): position,
+        prompt length / bucket, tokens generated vs budget for occupied
+        slots; ``{"active": False}`` for vacant ones.  Host-side metadata
+        only — never touches the device."""
+        states: list[dict] = []
+        for slot in range(self.n_slots):
+            info = self._slots[slot]
+            if not self._active[slot] or info is None:
+                states.append({"slot": slot, "active": False})
+                continue
+            states.append(
+                {
+                    "slot": slot,
+                    "active": True,
+                    "position": int(self._positions[slot]),
+                    "prompt_len": info.prompt_len,
+                    "bucket": info.bucket,
+                    "generated": info.generated,
+                    "max_new_tokens": info.max_new_tokens,
+                }
+            )
+        return states
+
     def bucket_for(self, prompt_len: int) -> int:
         """The smallest bucket holding ``prompt_len`` (prompts are padded up
         to it so prefill shapes come from a bounded set)."""

@@ -1,0 +1,278 @@
+"""Live serving counters + Prometheus text exposition.
+
+``ServingMetrics`` is the in-process aggregate behind ``GET /metrics`` and
+``ServingEngine.stats()``: monotone request/finish/rejection counters and
+fixed-bucket latency histograms for the three request phases (queue wait,
+prefill, decode), fed from the same measurements the PR-1 ``serve/*`` span
+records carry — the HTTP endpoint and the JSONL stream can never disagree.
+
+Deliberately stdlib-only and jax-free (``bpe-tpu monitor`` parses the
+exposition on hosts with no accelerator runtime), and cheap enough to
+update inline in the engine worker loop: one lock, a few integer adds.
+
+Prometheus exposition format (text/plain; version=0.0.4): ``# HELP`` /
+``# TYPE`` comments, counters suffixed ``_total``, histograms as
+cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count`` — the
+subset every Prometheus/VictoriaMetrics/Grafana-agent scraper accepts.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+__all__ = ["LatencyHistogram", "ServingMetrics", "render_prometheus"]
+
+#: Default latency buckets (seconds): sub-ms queue pops up to minute-long
+#: decodes, roughly x2.5 per step — 14 buckets keeps the exposition small.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 15.0, 60.0,
+)
+
+#: Request finish reasons (serving/server.py Result.finish_reason) — the
+#: label set is closed so counter series never explode.
+FINISH_REASONS = ("stop", "length", "deadline", "cancelled", "error")
+
+
+class LatencyHistogram:
+    """Fixed-bucket cumulative histogram (Prometheus semantics): bucket
+    counts are *cumulative* at render time, ``sum``/``count`` track every
+    observation including those beyond the last finite bucket (+Inf)."""
+
+    def __init__(self, buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        if not isinstance(value, (int, float)) or not math.isfinite(value):
+            return
+        value = max(0.0, float(value))
+        self.sum += value
+        self.count += 1
+        for i, bound in enumerate(self.buckets):
+            if value <= bound:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """``(le, cumulative_count)`` pairs ending with ``(inf, count)``."""
+        out = []
+        running = 0
+        for bound, n in zip(self.buckets, self.counts):
+            running += n
+            out.append((bound, running))
+        out.append((math.inf, self.count))
+        return out
+
+    def percentile(self, q: float) -> float | None:
+        """Bucket-upper-bound estimate of the q-quantile (None when empty).
+        Coarse by construction — the JSONL spans hold exact durations; this
+        exists so ``monitor`` can show a live p95 from /metrics alone."""
+        if not self.count:
+            return None
+        rank = max(1, math.ceil(q * self.count))
+        for bound, cum in self.cumulative():
+            if cum >= rank:
+                return bound if math.isfinite(bound) else self.buckets[-1]
+        return self.buckets[-1]
+
+
+class ServingMetrics:
+    """Thread-safe aggregate of everything a scrape needs.
+
+    The engine worker observes phase latencies and finish reasons;
+    transport threads count submissions/rejections; errors land in a
+    bounded ring buffer for ``/statusz``.
+    """
+
+    def __init__(self, clock=time.monotonic, max_errors: int = 16):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.started_at = clock()
+        self.requests_submitted = 0
+        self.requests_rejected = 0
+        self.finished: dict[str, int] = {r: 0 for r in FINISH_REASONS}
+        self.phases: dict[str, LatencyHistogram] = {
+            phase: LatencyHistogram()
+            for phase in ("queue_wait", "prefill", "decode")
+        }
+        self._max_errors = max_errors
+        self._errors: list[dict] = []
+
+    # ------------------------------------------------------------ recording
+
+    def on_submit(self) -> None:
+        with self._lock:
+            self.requests_submitted += 1
+
+    def on_reject(self) -> None:
+        with self._lock:
+            self.requests_rejected += 1
+
+    def on_finish(self, reason: str) -> None:
+        with self._lock:
+            self.finished[reason] = self.finished.get(reason, 0) + 1
+
+    def observe_phase(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            hist = self.phases.get(phase)
+            if hist is not None:
+                hist.observe(seconds)
+
+    def record_error(self, error: str, **attrs) -> None:
+        """Append to the last-error ring buffer (oldest evicted)."""
+        with self._lock:
+            self._errors.append(
+                {
+                    "t": round(self._clock() - self.started_at, 3),
+                    "time_unix": round(time.time(), 3),
+                    "error": error,
+                    **attrs,
+                }
+            )
+            if len(self._errors) > self._max_errors:
+                self._errors = self._errors[-self._max_errors:]
+
+    # ------------------------------------------------------------- querying
+
+    def uptime_s(self) -> float:
+        return self._clock() - self.started_at
+
+    def last_errors(self) -> list[dict]:
+        with self._lock:
+            return list(self._errors)
+
+    def snapshot(self) -> dict:
+        """JSON-ready counter snapshot (the ``stats()``/statusz view)."""
+        with self._lock:
+            return {
+                "uptime_s": round(self.uptime_s(), 3),
+                "requests_submitted": self.requests_submitted,
+                "requests_rejected": self.requests_rejected,
+                "finish_reasons": dict(self.finished),
+                "phase_p50_s": {
+                    p: h.percentile(0.50) for p, h in self.phases.items()
+                },
+                "phase_p95_s": {
+                    p: h.percentile(0.95) for p, h in self.phases.items()
+                },
+            }
+
+
+def _fmt_le(bound: float) -> str:
+    if math.isinf(bound):
+        return "+Inf"
+    formatted = f"{bound:g}"
+    return formatted
+
+
+def render_prometheus(
+    metrics: ServingMetrics,
+    engine_stats: dict | None = None,
+    resources: dict | None = None,
+    prefix: str = "bpe_tpu",
+) -> str:
+    """The ``GET /metrics`` body: counters, gauges, and phase histograms.
+
+    ``engine_stats`` is ``ServingEngine.stats()`` (gauges: queue depth,
+    slot occupancy, compile counter, token/tick totals); ``resources`` an
+    optional ``telemetry.resources.sample_resources()`` record whose
+    non-null fields become gauges (HBM/RSS on TPU hosts).
+    """
+    lines: list[str] = []
+
+    def emit(name, kind, help_text, samples):
+        lines.append(f"# HELP {prefix}_{name} {help_text}")
+        lines.append(f"# TYPE {prefix}_{name} {kind}")
+        for labels, value in samples:
+            if value is None:
+                continue
+            label_str = (
+                "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+                if labels
+                else ""
+            )
+            if isinstance(value, float):
+                value = f"{value:.9g}"
+            lines.append(f"{prefix}_{name}{label_str} {value}")
+
+    with metrics._lock:
+        submitted = metrics.requests_submitted
+        rejected = metrics.requests_rejected
+        finished = dict(metrics.finished)
+        phase_data = {
+            phase: (hist.cumulative(), hist.sum, hist.count)
+            for phase, hist in metrics.phases.items()
+        }
+    emit("uptime_seconds", "gauge", "Seconds since the serving engine started.",
+         [({}, round(metrics.uptime_s(), 3))])
+    emit("requests_submitted_total", "counter",
+         "Requests accepted into the admission queue.", [({}, submitted)])
+    emit("requests_rejected_total", "counter",
+         "Requests rejected at submit time (queue full backpressure).",
+         [({}, rejected)])
+    emit("requests_finished_total", "counter",
+         "Finished requests by finish reason.",
+         [({"reason": reason}, count) for reason, count in sorted(finished.items())])
+
+    samples = []
+    for phase, (cumulative, total, count) in sorted(phase_data.items()):
+        for bound, cum in cumulative:
+            samples.append((
+                "bucket", {"phase": phase, "le": _fmt_le(bound)}, cum
+            ))
+        samples.append(("sum", {"phase": phase}, round(total, 9)))
+        samples.append(("count", {"phase": phase}, count))
+    lines.append(
+        f"# HELP {prefix}_request_phase_seconds "
+        "Per-request phase latency (queue_wait | prefill | decode)."
+    )
+    lines.append(f"# TYPE {prefix}_request_phase_seconds histogram")
+    for suffix, labels, value in samples:
+        label_str = ",".join(f'{k}="{v}"' for k, v in labels.items())
+        if isinstance(value, float):
+            value = f"{value:.9g}"
+        lines.append(
+            f"{prefix}_request_phase_seconds_{suffix}{{{label_str}}} {value}"
+        )
+
+    if engine_stats:
+        emit("queue_depth", "gauge", "Requests waiting in the admission queue.",
+             [({}, engine_stats.get("queue_depth"))])
+        emit("active_slots", "gauge", "KV-cache slots currently decoding.",
+             [({}, engine_stats.get("active_slots"))])
+        emit("slots", "gauge", "KV-cache slot pool capacity.",
+             [({}, engine_stats.get("slots"))])
+        emit("ticks_total", "counter", "Batched decode ticks executed.",
+             [({}, engine_stats.get("ticks"))])
+        emit("tokens_generated_total", "counter",
+             "Tokens sampled across all requests.",
+             [({}, engine_stats.get("tokens_emitted"))])
+        emit("engine_compiled_programs", "gauge",
+             "XLA programs compiled by this engine (bounded: buckets + 1).",
+             [({}, engine_stats.get("compiled_programs"))])
+
+    if resources:
+        emit("compile_events_total", "counter",
+             "Process-wide XLA compile events (jit cache misses).",
+             [({}, resources.get("compile_events"))])
+        emit("host_rss_bytes", "gauge", "Host resident set size.",
+             [({}, resources.get("host_rss_bytes"))])
+        emit("live_buffer_bytes", "gauge",
+             "Total bytes of live jax.Array buffers on this host.",
+             [({}, resources.get("live_buffer_bytes"))])
+        emit("hbm_bytes_in_use", "gauge",
+             "Device memory in use, summed over local devices.",
+             [({}, resources.get("hbm_bytes_in_use"))])
+        emit("hbm_peak_bytes_in_use", "gauge",
+             "Peak device memory in use, summed over local devices.",
+             [({}, resources.get("hbm_peak_bytes_in_use"))])
+        emit("hbm_bytes_limit", "gauge",
+             "Device memory capacity, summed over local devices.",
+             [({}, resources.get("hbm_bytes_limit"))])
+    return "\n".join(lines) + "\n"

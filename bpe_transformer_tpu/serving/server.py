@@ -33,7 +33,12 @@ from pathlib import Path
 from typing import Iterator
 
 from bpe_transformer_tpu.serving.engine import SlotPoolEngine, TickEvent
+from bpe_transformer_tpu.serving.metrics import ServingMetrics, render_prometheus
 from bpe_transformer_tpu.serving.scheduler import FifoScheduler, QueueFullError
+from bpe_transformer_tpu.telemetry.resources import (
+    install_compile_counter,
+    sample_resources,
+)
 
 __all__ = [
     "Request",
@@ -165,7 +170,12 @@ class ServingEngine:
         engine_record_every_s: float = 1.0,
         idle_poll_s: float = 0.02,
         clock=time.monotonic,
+        manifest: dict | None = None,
     ):
+        # Count XLA compiles (the engine's bucketed prefills included) into
+        # the process-wide telemetry.resources counter before the first
+        # program builds.
+        install_compile_counter()
         self.engine = SlotPoolEngine(
             params, config, slots=slots,
             prefill_buckets=prefill_buckets, min_bucket=min_bucket,
@@ -176,6 +186,10 @@ class ServingEngine:
         self.tokenizer = tokenizer
         self.default_stop_id = default_stop_id
         self.default_max_new_tokens = default_max_new_tokens
+        self.manifest = manifest
+        #: Live counter/histogram aggregate behind /metrics and stats() —
+        #: fed from the same measurements the serve/* spans carry.
+        self.metrics = ServingMetrics(clock=clock)
         self._telemetry = telemetry
         self._record_every_s = engine_record_every_s
         self._idle_poll_s = idle_poll_s
@@ -266,13 +280,16 @@ class ServingEngine:
                 request_id=request.request_id,
                 deadline_s=request.deadline_s,
             )
-        except BaseException:
+        except BaseException as exc:
             # Any enqueue failure (backpressure, a bad deadline value, ...)
             # must unregister the entry — a leaked entry holds a Queue and
             # an Event forever.
             with self._entries_lock:
                 self._entries.pop(request.request_id, None)
+            if isinstance(exc, QueueFullError):
+                self.metrics.on_reject()
             raise
+        self.metrics.on_submit()
         return RequestHandle(self, entry)
 
     def generate(
@@ -323,6 +340,8 @@ class ServingEngine:
         return False
 
     def stats(self) -> dict:
+        """Engine/queue gauges + the live request counters — the same
+        aggregate ``GET /metrics`` renders, reachable offline."""
         return {
             "slots": self.engine.n_slots,
             "active_slots": self.engine.active_count,
@@ -332,7 +351,34 @@ class ServingEngine:
             "requests_finished": self._requests_finished,
             "compiled_programs": self.engine.compiled_programs(),
             "prefill_buckets": list(self.engine.buckets),
+            **self.metrics.snapshot(),
         }
+
+    def statusz(self) -> dict:
+        """The ``GET /statusz`` payload: run manifest, uptime, compile
+        accounting (per-engine program count + process-wide compile
+        events), per-slot state, queue depth, and the last-error ring."""
+        resources = sample_resources()
+        return {
+            "manifest": self.manifest,
+            "uptime_s": round(self.metrics.uptime_s(), 3),
+            "compiled_programs": self.engine.compiled_programs(),
+            "compile_events": resources["compile_events"],
+            "prefill_buckets": list(self.engine.buckets),
+            "queue_depth": self.scheduler.depth,
+            "requests_finished": self._requests_finished,
+            "worker_alive": self._thread is not None
+            and self._worker_error is None,
+            "slot_states": self.engine.slot_states(),
+            "resources": resources,
+            "last_errors": self.metrics.last_errors(),
+        }
+
+    def prometheus_metrics(self) -> str:
+        """The ``GET /metrics`` body (Prometheus text exposition)."""
+        return render_prometheus(
+            self.metrics, self.stats(), sample_resources()
+        )
 
     # ------------------------------------------------------------ batch mode
 
@@ -406,6 +452,7 @@ class ServingEngine:
         except BaseException as exc:  # noqa: BLE001 — fail loudly, unblock callers
             self._worker_error = exc
             self._running = False
+            self.metrics.record_error(repr(exc), source="worker")
             if self._telemetry is not None:
                 self._telemetry.event("serve_worker_error", error=repr(exc))
             for slot in list(self._slot_entries):
@@ -519,6 +566,7 @@ class ServingEngine:
             decode_s=decode_s,
         )
         self._requests_finished += 1
+        self.metrics.on_finish(reason)
         with self._entries_lock:
             self._entries.pop(entry.request.request_id, None)
         entry.stream.put(_STREAM_END)
@@ -529,7 +577,9 @@ class ServingEngine:
     def _span(self, name: str, start: float, dur: float, request: Request):
         """Emit one request-phase span record.  Spans are emitted directly
         (not via Telemetry's nesting stack — concurrent requests interleave,
-        so LIFO nesting does not apply)."""
+        so LIFO nesting does not apply).  The same duration feeds the live
+        /metrics histogram, so the scrape and the stream always agree."""
+        self.metrics.observe_phase(name, dur)
         if self._telemetry is None:
             return
         self._telemetry.emit(
@@ -575,6 +625,11 @@ class ServingEngine:
                 "compiled_programs": self.engine.compiled_programs(),
             }
         )
+        # Resource accounting rides the same cadence: HBM/RSS/compile
+        # trends of a serving process are as load-bearing as tokens/sec.
+        self._telemetry.emit(
+            sample_resources(t=round(now - self._t0, 6))
+        )
         self._last_record_t = now
         self._last_record_tokens = tokens
 
@@ -592,7 +647,12 @@ def make_http_server(
       "stop_id"?, "deadline_s"?}`` -> ``{"completion"?, "token_ids",
       "finish_reason", "timings", "request_id"}``; 400 on bad input, 503
       when the admission queue is full (backpressure).
-    * ``GET /healthz`` — engine/queue stats.
+    * ``GET /healthz`` — engine/queue stats (JSON).
+    * ``GET /metrics`` — Prometheus text exposition: request/token
+      counters, queue depth, slot occupancy, per-phase latency
+      histograms, compile + HBM/RSS accounting (`serving/metrics.py`).
+    * ``GET /statusz`` — JSON operator page: run manifest, uptime,
+      compile counters, per-slot state, last-error ring buffer.
 
     ``port=0`` binds an ephemeral port (tests); the caller owns
     ``serve_forever()`` / ``shutdown()``.
@@ -606,17 +666,29 @@ def make_http_server(
             pass
 
         def _reply(self, code: int, payload: dict) -> None:
-            body = json.dumps(payload).encode("utf-8")
+            self._reply_text(code, json.dumps(payload), "application/json")
+
+        def _reply_text(self, code: int, text: str, content_type: str) -> None:
+            body = text.encode("utf-8")
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 (stdlib API)
-            if self.path != "/healthz":
-                return self._reply(404, {"error": "unknown path"})
-            self._reply(200, {"ok": True, **serving.stats()})
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
+                return self._reply(200, {"ok": True, **serving.stats()})
+            if path == "/metrics":
+                return self._reply_text(
+                    200,
+                    serving.prometheus_metrics(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            if path == "/statusz":
+                return self._reply(200, serving.statusz())
+            return self._reply(404, {"error": "unknown path"})
 
         def do_POST(self):  # noqa: N802 (stdlib API)
             if self.path != "/generate":

@@ -21,6 +21,13 @@ Subsumes and extends the old ``utils.metrics`` / ``utils.profiling`` pair
 
 from bpe_transformer_tpu.telemetry.manifest import git_sha, run_manifest
 from bpe_transformer_tpu.telemetry.report import nonfinite_fields
+from bpe_transformer_tpu.telemetry.resources import (
+    compile_events,
+    install_compile_counter,
+    record_compile_events,
+    sample_resources,
+)
+from bpe_transformer_tpu.telemetry.schema import RECORD_SCHEMAS, validate_record
 from bpe_transformer_tpu.telemetry.sinks import MetricsLogger
 from bpe_transformer_tpu.telemetry.spans import Telemetry
 from bpe_transformer_tpu.telemetry.watchdog import NonFiniteError, Watchdog
@@ -55,16 +62,22 @@ def __getattr__(name: str):
 __all__ = [
     "MetricsLogger",
     "NonFiniteError",
+    "RECORD_SCHEMAS",
     "StepTimer",
     "Telemetry",
     "Watchdog",
+    "compile_events",
     "flatten_health",
     "git_sha",
     "group_norms",
     "health_metrics",
+    "install_compile_counter",
     "nonfinite_count",
     "nonfinite_fields",
     "profile_trace",
+    "record_compile_events",
     "run_manifest",
+    "sample_resources",
     "time_fn",
+    "validate_record",
 ]

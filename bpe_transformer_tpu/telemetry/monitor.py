@@ -1,0 +1,408 @@
+"""``bpe-tpu monitor``: a live operational view of a running (or finished)
+run — loss/throughput, queue/slot state, HBM headroom, compile counts.
+
+Two sources, one panel:
+
+- **a telemetry stream** (``bpe-tpu monitor run/metrics.jsonl``): tail the
+  unified JSONL the training loop / serving engine writes, folding every
+  record kind (metric | span | event | engine | resources | manifest |
+  footer) into the latest operational state;
+- **a live server** (``bpe-tpu monitor --url host:port``): poll
+  ``GET /metrics`` on a ``bpe-tpu serve`` process and parse the Prometheus
+  exposition back into the same state.
+
+Pure host-side and jax-free (like `report`): it runs on a laptop watching a
+stream rsynced off a pod, or next to the serving process itself.  Renders
+with curses on a tty (q quits), plain refreshing frames otherwise;
+``--once`` prints a single frame and exits (scripts, smoke tests).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import time
+from pathlib import Path
+
+#: Event names worth flagging on the panel (matches report's anomaly list).
+_ANOMALY_EVENTS = ("nonfinite", "watchdog_hang", "serve_worker_error")
+
+
+# ----------------------------------------------------------- state folding
+
+
+def fold_records(records: list[dict], state: dict | None = None) -> dict:
+    """Fold telemetry records (oldest-first) into the latest operational
+    state; pass the previous ``state`` back in to fold incrementally while
+    tailing."""
+    state = dict(state) if state else {"anomalies": 0, "n_records": 0}
+    for record in records:
+        if not isinstance(record, dict):
+            continue
+        state["n_records"] += 1
+        kind = record.get("kind", "metric")
+        if kind == "manifest":
+            devices = record.get("devices") or {}
+            state["run_kind"] = record.get("run_kind")
+            state["devices"] = (
+                f"{devices.get('count', '?')}x{devices.get('kind', '?')}"
+                if devices
+                else None
+            )
+        elif kind == "metric":
+            for key in ("step", "loss", "val_loss", "tokens_per_sec",
+                        "mfu", "grad_norm", "step_wall_s"):
+                if key in record:
+                    state[key] = record[key]
+            loss = record.get("loss")
+            if isinstance(loss, float) and not math.isfinite(loss):
+                state["anomalies"] += 1
+        elif kind == "engine":
+            for key in ("active_slots", "queue_depth", "tokens_total",
+                        "requests_finished", "compiled_programs"):
+                if key in record:
+                    state[key] = record[key]
+            state["serve_tokens_per_sec"] = record.get("tokens_per_sec")
+        elif kind == "resources":
+            for key in ("host_rss_bytes", "live_buffer_bytes",
+                        "hbm_bytes_in_use", "hbm_peak_bytes_in_use",
+                        "hbm_bytes_limit", "compile_events"):
+                if record.get(key) is not None:
+                    state[key] = record[key]
+        elif kind == "event":
+            if record.get("name") in _ANOMALY_EVENTS:
+                state["anomalies"] += 1
+                state["last_anomaly"] = record.get("name")
+        elif kind == "footer":
+            state["footer_clean"] = record.get("clean")
+    return state
+
+
+def parse_prometheus(text: str) -> dict:
+    """Prometheus text exposition -> ``{name: value}`` /
+    ``{name{labels}: value}`` for every sample line."""
+    samples: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name, value = line.rsplit(None, 1)
+            samples[name] = float(value)
+        except ValueError:
+            continue
+    return samples
+
+
+def fold_prometheus(samples: dict, prefix: str = "bpe_tpu") -> dict:
+    """Map a ``/metrics`` scrape onto the same state dict the JSONL fold
+    produces, so one renderer serves both sources."""
+    def get(name):
+        return samples.get(f"{prefix}_{name}")
+
+    finished = sum(
+        value
+        for name, value in samples.items()
+        if name.startswith(f"{prefix}_requests_finished_total")
+    )
+    state = {
+        "run_kind": "serve",
+        "n_records": len(samples),
+        "anomalies": int(
+            samples.get(f'{prefix}_requests_finished_total{{reason="error"}}', 0)
+        ),
+        "uptime_s": get("uptime_seconds"),
+        "queue_depth": get("queue_depth"),
+        "active_slots": get("active_slots"),
+        "slots": get("slots"),
+        "requests_finished": finished,
+        "requests_rejected": get("requests_rejected_total"),
+        "tokens_total": get("tokens_generated_total"),
+        "compiled_programs": get("engine_compiled_programs"),
+        "compile_events": get("compile_events_total"),
+        "host_rss_bytes": get("host_rss_bytes"),
+        "live_buffer_bytes": get("live_buffer_bytes"),
+        "hbm_bytes_in_use": get("hbm_bytes_in_use"),
+        "hbm_peak_bytes_in_use": get("hbm_peak_bytes_in_use"),
+        "hbm_bytes_limit": get("hbm_bytes_limit"),
+    }
+    return {k: v for k, v in state.items() if v is not None}
+
+
+# ---------------------------------------------------------------- rendering
+
+
+def _mib(n) -> str:
+    if not isinstance(n, (int, float)):
+        return "-"
+    return f"{n / 2**20:,.1f} MiB"
+
+
+def _num(n, digits=4) -> str:
+    if n is None:
+        return "-"
+    if isinstance(n, float):
+        return f"{n:,.{digits}g}"
+    return str(n)
+
+
+def render_frame(state: dict, source: str) -> str:
+    """One monitor frame: a few dense lines, every one optional on absence
+    of its data (a training stream has no queue; a CPU run has no HBM)."""
+    lines = [
+        f"bpe-tpu monitor — {state.get('run_kind', '?')}"
+        + (f" on {state['devices']}" if state.get("devices") else "")
+        + f"  [{source}]"
+    ]
+    if state.get("uptime_s") is not None:
+        lines[0] += f"  uptime {state['uptime_s']:,.0f}s"
+
+    if "step" in state or "loss" in state:
+        parts = [f"step {_num(state.get('step'))}",
+                 f"loss {_num(state.get('loss'))}"]
+        if state.get("val_loss") is not None:
+            parts.append(f"val {_num(state['val_loss'])}")
+        if state.get("grad_norm") is not None:
+            parts.append(f"gnorm {_num(state['grad_norm'])}")
+        if state.get("tokens_per_sec") is not None:
+            parts.append(f"tok/s {_num(state['tokens_per_sec'], 6)}")
+        if state.get("mfu") is not None:
+            parts.append(f"mfu {_num(state['mfu'], 3)}")
+        lines.append("  train  " + "  ".join(parts))
+
+    if state.get("queue_depth") is not None or state.get("active_slots") is not None:
+        parts = []
+        if state.get("active_slots") is not None:
+            slots = state.get("slots")
+            parts.append(
+                f"slots {_num(state['active_slots'])}"
+                + (f"/{_num(slots)}" if slots is not None else "")
+            )
+        if state.get("queue_depth") is not None:
+            parts.append(f"queue {_num(state['queue_depth'])}")
+        if state.get("requests_finished") is not None:
+            parts.append(f"requests {_num(state['requests_finished'])}")
+        if state.get("requests_rejected"):
+            parts.append(f"rejected {_num(state['requests_rejected'])}")
+        if state.get("serve_tokens_per_sec") is not None:
+            parts.append(f"tok/s {_num(state['serve_tokens_per_sec'], 6)}")
+        if state.get("tokens_total") is not None:
+            parts.append(f"tokens {_num(state['tokens_total'])}")
+        lines.append("  serve  " + "  ".join(parts))
+
+    mem_parts = []
+    if state.get("hbm_bytes_in_use") is not None:
+        hbm = f"hbm {_mib(state['hbm_bytes_in_use'])}"
+        limit = state.get("hbm_bytes_limit")
+        if limit:
+            hbm += f" / {_mib(limit)} ({100 * state['hbm_bytes_in_use'] / limit:.0f}%)"
+        if state.get("hbm_peak_bytes_in_use") is not None:
+            hbm += f"  peak {_mib(state['hbm_peak_bytes_in_use'])}"
+        mem_parts.append(hbm)
+    if state.get("live_buffer_bytes") is not None:
+        mem_parts.append(f"live buffers {_mib(state['live_buffer_bytes'])}")
+    if state.get("host_rss_bytes") is not None:
+        mem_parts.append(f"rss {_mib(state['host_rss_bytes'])}")
+    if mem_parts:
+        lines.append("  mem    " + "  ".join(mem_parts))
+
+    compile_parts = []
+    if state.get("compile_events") is not None:
+        compile_parts.append(f"compile events {_num(state['compile_events'])}")
+    if state.get("compiled_programs") is not None:
+        compile_parts.append(
+            f"engine programs {_num(state['compiled_programs'])}"
+        )
+    if compile_parts:
+        lines.append("  xla    " + "  ".join(compile_parts))
+
+    status = f"  state  records {state.get('n_records', 0)}"
+    status += f"  anomalies {state.get('anomalies', 0)}"
+    if state.get("last_anomaly"):
+        status += f" (last: {state['last_anomaly']})"
+    if state.get("footer_clean") is not None:
+        status += (
+            "  [run ended cleanly]"
+            if state["footer_clean"]
+            else "  [run ended UNCLEAN]"
+        )
+    lines.append(status)
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ sources
+
+
+class FileSource:
+    """Tail a metrics.jsonl incrementally (a truncated/rotated file is
+    re-read whole).  Reads BYTES and splits/decodes manually: the writer may
+    be mid-way through a multibyte character (or a corrupt line) exactly
+    when we poll, and a torn tail must wait for the next poll, not kill the
+    monitor or drift the offset."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.label = str(path)
+        self._offset = 0
+        self.state: dict = fold_records([])
+
+    def refresh(self) -> dict:
+        try:
+            size = self.path.stat().st_size
+        except OSError:
+            return self.state
+        if size < self._offset:  # truncated/rotated: start over
+            self._offset = 0
+            self.state = fold_records([])
+        if size == self._offset:
+            return self.state
+        records = []
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                for raw in f:
+                    if not raw.endswith(b"\n"):
+                        break  # torn tail mid-write: pick it up next poll
+                    self._offset += len(raw)
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            return self.state
+        self.state = fold_records(records, self.state)
+        return self.state
+
+
+class UrlSource:
+    """Poll a running server's ``GET /metrics``."""
+
+    def __init__(self, url: str, timeout: float = 5.0):
+        if "://" not in url:
+            url = f"http://{url}"
+        self.url = url.rstrip("/") + "/metrics"
+        self.label = self.url
+        self.timeout = timeout
+        self.state: dict = {}
+
+    def refresh(self) -> dict:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(self.url, timeout=self.timeout) as resp:
+                text = resp.read().decode("utf-8", "replace")
+        except OSError as exc:
+            self.state = dict(self.state)
+            self.state["last_anomaly"] = f"scrape failed: {exc}"
+            return self.state
+        self.state = fold_prometheus(parse_prometheus(text))
+        return self.state
+
+
+# --------------------------------------------------------------------- loops
+
+
+def _plain_loop(source, interval: float, once: bool, out=None) -> int:
+    out = out or sys.stdout
+    while True:
+        frame = render_frame(source.refresh(), source.label)
+        print(frame, file=out, flush=True)
+        if once:
+            return 0
+        print("-" * 72, file=out, flush=True)
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+def _curses_loop(source, interval: float) -> int:
+    import curses
+
+    def run(screen):
+        curses.curs_set(0)
+        screen.nodelay(True)
+        while True:
+            frame = render_frame(source.refresh(), source.label)
+            screen.erase()
+            max_y, max_x = screen.getmaxyx()
+            for y, line in enumerate(frame.splitlines()[: max_y - 1]):
+                screen.addnstr(y, 0, line, max_x - 1)
+            screen.addnstr(
+                min(max_y - 1, frame.count("\n") + 2), 0,
+                "q to quit", max_x - 1,
+            )
+            screen.refresh()
+            deadline = time.monotonic() + interval
+            while time.monotonic() < deadline:
+                if screen.getch() in (ord("q"), ord("Q")):
+                    return 0
+                time.sleep(0.05)
+
+    try:
+        return curses.wrapper(run) or 0
+    except KeyboardInterrupt:
+        return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="bpe-tpu monitor",
+        description="Live view of a telemetry stream or a serving "
+        "/metrics endpoint (jax-free).",
+    )
+    parser.add_argument("metrics", nargs="?", default=None,
+                        help="telemetry metrics.jsonl to tail")
+    parser.add_argument("--url", default=None, metavar="HOST:PORT",
+                        help="poll http://HOST:PORT/metrics instead")
+    parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument("--once", action="store_true",
+                        help="render one frame and exit")
+    parser.add_argument("--plain", action="store_true",
+                        help="plain frames even on a tty (no curses)")
+    try:
+        args = parser.parse_args(argv if argv is not None else sys.argv[1:])
+    except SystemExit as exc:
+        return int(exc.code or 0)
+
+    if bool(args.metrics) == bool(args.url):
+        print("monitor: give a metrics.jsonl path OR --url host:port",
+              file=sys.stderr)
+        return 2
+    if args.metrics:
+        if not Path(args.metrics).exists():
+            print(f"monitor: no such file {args.metrics}", file=sys.stderr)
+            return 1
+        source = FileSource(args.metrics)
+        # Nudge (one-shot mode): a stream with zero readable records still
+        # renders, all fields dashed — matching report's graceful-empty
+        # contract.  The refresh here is not wasted work: its folded state
+        # persists and the render loop's own refresh picks up from the
+        # advanced byte offset.
+        if args.once and not source.refresh().get("n_records"):
+            print(f"monitor: {args.metrics} holds no readable records yet",
+                  file=sys.stderr)
+    else:
+        source = UrlSource(args.url)
+
+    use_curses = (
+        not args.once
+        and not args.plain
+        and sys.stdout.isatty()
+    )
+    if use_curses:
+        try:
+            return _curses_loop(source, args.interval)
+        except Exception:
+            pass  # no terminfo/odd TERM: fall back to plain frames
+    return _plain_loop(source, args.interval, args.once)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

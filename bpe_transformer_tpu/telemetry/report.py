@@ -226,6 +226,32 @@ def summarize(records: list[dict]) -> dict:
             ):
                 health_last[key] = value
 
+    # Resource-accounting trajectory (kind="resources", telemetry/resources.py):
+    # HBM/RSS/live-buffer trends plus the process compile counter.  Null
+    # fields (HBM on CPU backends) drop out of _stats naturally.
+    resources = [r for r in records if r.get("kind") == "resources"]
+    resource_summary = None
+    if resources:
+        resource_summary = {
+            "n": len(resources),
+            "host_rss_bytes": _stats([r.get("host_rss_bytes") for r in resources]),
+            "live_buffer_bytes": _stats(
+                [r.get("live_buffer_bytes") for r in resources]
+            ),
+            "hbm_bytes_in_use": _stats(
+                [r.get("hbm_bytes_in_use") for r in resources]
+            ),
+            "hbm_peak_bytes_in_use": _stats(
+                [r.get("hbm_peak_bytes_in_use") for r in resources]
+            ),
+            "hbm_bytes_limit": _stats(
+                [r.get("hbm_bytes_limit") for r in resources]
+            ),
+            "compile_events": _stats(
+                [r.get("compile_events") for r in resources]
+            ),
+        }
+
     return {
         "manifest": manifest,
         "n_manifests": len(manifests),
@@ -242,10 +268,18 @@ def summarize(records: list[dict]) -> dict:
             "tokens_per_sec": _stats(
                 [r["tokens_per_sec"] for r in steps if "tokens_per_sec" in r]
             ),
+            "tokens_per_sec_per_chip": _stats(
+                [
+                    r["tokens_per_sec_per_chip"]
+                    for r in steps
+                    if "tokens_per_sec_per_chip" in r
+                ]
+            ),
             "step_wall_s": _stats([r["step_wall_s"] for r in steps if "step_wall_s" in r]),
             "mfu": _stats([r["mfu"] for r in steps if "mfu" in r]),
         },
         "serving": serving,
+        "resources": resource_summary,
         "spans": span_breakdown,
         "health_last": health_last,
         "events": [e.get("name") for e in events],
@@ -363,6 +397,35 @@ def render_report(records: list[dict]) -> str:
                     f"  p95 {_fmt(ph['p95_s'])}s  max {_fmt(ph['max_s'])}s"
                 )
 
+    rs = s["resources"]
+    if rs:
+        lines.append(f"== resources ({rs['n']} samples) ==")
+        for key, label, scale in (
+            ("host_rss_bytes", "host rss", 2**20),
+            ("live_buffer_bytes", "live buffers", 2**20),
+            ("hbm_bytes_in_use", "hbm in use", 2**20),
+            ("hbm_peak_bytes_in_use", "hbm peak", 2**20),
+        ):
+            st_r = rs[key]
+            if st_r:
+                lines.append(
+                    f"  {label:<13s}{st_r['first'] / scale:,.1f} -> "
+                    f"{st_r['last'] / scale:,.1f} MiB"
+                    f"  (max {st_r['max'] / scale:,.1f})"
+                )
+        if rs["hbm_bytes_limit"] and rs["hbm_bytes_in_use"]:
+            limit = rs["hbm_bytes_limit"]["last"]
+            if limit:
+                lines.append(
+                    f"  hbm headroom {100 * (1 - rs['hbm_bytes_in_use']['last'] / limit):.1f}%"
+                    f" of {limit / 2**30:,.2f} GiB"
+                )
+        if rs["compile_events"]:
+            ce = rs["compile_events"]
+            lines.append(
+                f"  compile events {_fmt(ce.get('first'))} -> {_fmt(ce.get('last'))}"
+            )
+
     if s["spans"]:
         lines.append("== spans ==")
         for path, entry in sorted(
@@ -388,18 +451,300 @@ def render_report(records: list[dict]) -> str:
     return "\n".join(lines)
 
 
+# ------------------------------------------------------ regression compare
+
+#: Comparable metrics: name -> (extractor over a summarize() dict, better).
+#: ``better`` is the direction of improvement; a move AGAINST it beyond the
+#: threshold is a regression.  Extractors return None when the stream lacks
+#: the metric — such metrics are simply skipped (a training stream and a
+#: serving stream share a schema, not a metric set).
+COMPARE_METRICS: dict = {
+    "loss_last": (
+        lambda s: s["steps"]["loss"].get("last"), "lower"),
+    "val_loss_best": (
+        lambda s: s["val_loss"].get("min"), "lower"),
+    "tokens_per_sec_mean": (
+        lambda s: s["throughput"]["tokens_per_sec"].get("mean"), "higher"),
+    "tokens_per_sec_per_chip_mean": (
+        lambda s: s["throughput"]["tokens_per_sec_per_chip"].get("mean"),
+        "higher"),
+    "mfu_mean": (
+        lambda s: s["throughput"]["mfu"].get("mean"), "higher"),
+    "step_wall_s_mean": (
+        lambda s: s["throughput"]["step_wall_s"].get("mean"), "lower"),
+    "serve_tokens_per_sec_mean": (
+        lambda s: (s["serving"] or {}).get("tokens_per_sec", {}).get("mean"),
+        "higher"),
+    "serve_decode_p95_s": (
+        lambda s: ((s["serving"] or {}).get("phases", {})
+                   .get("decode", {}).get("p95_s")), "lower"),
+    "serve_queue_wait_p95_s": (
+        lambda s: ((s["serving"] or {}).get("phases", {})
+                   .get("queue_wait", {}).get("p95_s")), "lower"),
+    "hbm_peak_bytes": (
+        lambda s: (s["resources"] or {}).get("hbm_peak_bytes_in_use", {}).get("max")
+        if s.get("resources") else None, "lower"),
+}
+
+
+def extract_compare_metrics(summary: dict) -> dict:
+    """``{name: (value, better)}`` for every comparable metric the stream
+    actually carries (finite values only)."""
+    out = {}
+    for name, (extract, better) in COMPARE_METRICS.items():
+        try:
+            value = extract(summary)
+        except (KeyError, TypeError, AttributeError):
+            value = None
+        if isinstance(value, (int, float)) and math.isfinite(value):
+            out[name] = (float(value), better)
+    return out
+
+
+def baseline_capture_metrics(capture: dict) -> dict:
+    """Comparable metrics out of a bench capture JSON (``bench.py``'s
+    ``tpu_capture_*.json`` / the driver's ``BENCH_*.json`` with its payload
+    under ``"parsed"``), mapped onto the stream metric names."""
+    if isinstance(capture.get("parsed"), dict):
+        capture = capture["parsed"]
+    out = {}
+    value = capture.get("value")
+    if isinstance(value, (int, float)) and math.isfinite(value):
+        out["tokens_per_sec_per_chip_mean"] = (float(value), "higher")
+    mfu = capture.get("mfu")
+    if isinstance(mfu, (int, float)) and math.isfinite(mfu):
+        out["mfu_mean"] = (float(mfu), "higher")
+    val_loss = capture.get("final_val_loss")
+    if isinstance(val_loss, (int, float)) and math.isfinite(val_loss):
+        out["val_loss_best"] = (float(val_loss), "lower")
+    return out
+
+
+def compare_metrics(
+    baseline: dict,
+    current: dict,
+    default_threshold_pct: float = 5.0,
+    thresholds: dict | None = None,
+) -> tuple[list[dict], list[str]]:
+    """Per-metric deltas of current vs baseline over their SHARED metrics.
+
+    Returns ``(rows, regressions)``: one row per shared metric with the
+    signed percent delta and a verdict (``ok`` / ``improved`` /
+    ``regressed``), and the names that regressed beyond their threshold.
+    """
+    thresholds = thresholds or {}
+    rows: list[dict] = []
+    regressions: list[str] = []
+    for name in COMPARE_METRICS:
+        if name not in baseline or name not in current:
+            continue
+        base_value, better = baseline[name]
+        cur_value, _ = current[name]
+        threshold = float(thresholds.get(name, default_threshold_pct))
+        if base_value == 0:
+            delta_pct = 0.0 if cur_value == 0 else math.inf
+        else:
+            delta_pct = 100.0 * (cur_value - base_value) / abs(base_value)
+        worse = delta_pct < 0 if better == "higher" else delta_pct > 0
+        beyond = abs(delta_pct) > threshold
+        verdict = "ok"
+        if beyond:
+            verdict = "regressed" if worse else "improved"
+        if verdict == "regressed":
+            regressions.append(name)
+        rows.append(
+            {
+                "metric": name,
+                "baseline": base_value,
+                "current": cur_value,
+                "delta_pct": delta_pct,
+                "threshold_pct": threshold,
+                "better": better,
+                "verdict": verdict,
+            }
+        )
+    return rows, regressions
+
+
+def render_compare(
+    rows: list[dict], regressions: list[str], baseline_label: str
+) -> str:
+    lines = [f"== compare vs {baseline_label} =="]
+    if not rows:
+        lines.append("  (no shared metrics to compare)")
+        return "\n".join(lines)
+    lines.append(
+        f"  {'metric':<30s}{'baseline':>14s}{'current':>14s}"
+        f"{'delta':>10s}  verdict"
+    )
+    for row in rows:
+        marker = {"regressed": "!! ", "improved": "   "}.get(row["verdict"], "   ")
+        lines.append(
+            f"  {row['metric']:<30s}{_fmt(row['baseline'], 6):>14s}"
+            f"{_fmt(row['current'], 6):>14s}{row['delta_pct']:>+9.1f}%"
+            f"  {marker}{row['verdict']}"
+        )
+    if regressions:
+        lines.append(
+            f"  {len(regressions)} regression(s): {', '.join(regressions)}"
+        )
+    else:
+        lines.append("  no regressions beyond threshold")
+    return "\n".join(lines)
+
+
+def _load_capture_json(path: str | Path) -> dict | None:
+    """A bench capture JSON (one pretty-printed object, not JSONL), or None
+    when the file isn't one.  Lets the compare gate run capture-vs-capture
+    (``report new_capture.json --baseline prev_capture.json``) — the shape
+    ``benchmarks/tpu_queue.sh`` self-reports with after each pass."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def _parse_thresholds(pairs: list[str]) -> dict:
+    """``--threshold metric=pct`` pairs -> {metric: pct}; unknown metric
+    names are rejected so a typo cannot silently disable a gate."""
+    out: dict = {}
+    for pair in pairs:
+        name, sep, pct = pair.partition("=")
+        if not sep or name not in COMPARE_METRICS:
+            known = ", ".join(sorted(COMPARE_METRICS))
+            raise ValueError(
+                f"bad --threshold {pair!r} (want METRIC=PCT with METRIC one "
+                f"of: {known})"
+            )
+        out[name] = float(pct)
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
-    args = argv if argv is not None else sys.argv[1:]
-    if len(args) != 1:
-        print("usage: python -m bpe_transformer_tpu.telemetry.report metrics.jsonl",
-              file=sys.stderr)
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="bpe-tpu report",
+        description="Summarize a telemetry metrics.jsonl; optionally gate "
+        "it against a baseline stream or bench capture.",
+    )
+    parser.add_argument("metrics", help="telemetry metrics.jsonl to report on")
+    parser.add_argument(
+        "--compare", metavar="BASELINE_JSONL", default=None,
+        help="baseline telemetry stream: print per-metric deltas and exit "
+        "3 when any shared metric regresses beyond its threshold",
+    )
+    parser.add_argument(
+        "--baseline", metavar="BENCH_JSON", default=None,
+        help="bench capture JSON (tpu_capture_*.json / BENCH_*.json) as the "
+        "comparison baseline instead of a second stream",
+    )
+    parser.add_argument(
+        "--threshold-pct", type=float, default=5.0,
+        help="default regression threshold in percent (default: 5)",
+    )
+    parser.add_argument(
+        "--threshold", action="append", default=[], metavar="METRIC=PCT",
+        help="per-metric threshold override (repeatable)",
+    )
+    try:
+        args = parser.parse_args(argv if argv is not None else sys.argv[1:])
+    except SystemExit as exc:
+        # argparse exits 2 on usage errors; surface that as a return code so
+        # callers (and tests) never see a SystemExit from library use.
+        return int(exc.code or 0)
+
+    records = load_records(args.metrics)
+    capture_current = None
+    if len(records) == 1 and (
+        "parsed" in records[0]
+        or ("value" in records[0] and "metric" in records[0])
+    ):
+        # A compact single-line bench capture parses as a 1-record "stream";
+        # route it to the capture path like its pretty-printed siblings.
+        capture_current = records[0]
+        records = []
+    if not records and capture_current is None:
+        # Not a JSONL stream — maybe a bench capture JSON (capture-vs-
+        # capture compare, the tpu_queue.sh self-report shape).
+        capture_current = _load_capture_json(args.metrics)
+        if capture_current is None:
+            print(
+                f"report: no readable records in {args.metrics} — empty, "
+                "missing, or fully corrupt stream (nothing to summarize)",
+                file=sys.stderr,
+            )
+            return 1
+    try:
+        thresholds = _parse_thresholds(args.threshold)
+    except ValueError as exc:
+        print(f"report: {exc}", file=sys.stderr)
         return 2
-    records = load_records(args[0])
-    if not records:
-        print(f"no readable records in {args[0]}", file=sys.stderr)
-        return 1
-    print(render_report(records))
-    return 0
+    if capture_current is not None:
+        current_metrics = baseline_capture_metrics(capture_current)
+        if not current_metrics:
+            print(
+                f"report: {args.metrics} is neither a telemetry stream nor "
+                "a bench capture with comparable metrics",
+                file=sys.stderr,
+            )
+            return 1
+        parsed = (
+            capture_current["parsed"]
+            if isinstance(capture_current.get("parsed"), dict)
+            else capture_current
+        )
+        print(f"== bench capture {args.metrics} ==")
+        print(
+            f"  {parsed.get('metric', '?')}  value {_fmt(parsed.get('value'), 6)}"
+            f"  mfu {_fmt(parsed.get('mfu'))}"
+            f"  platform {parsed.get('platform', '?')}"
+        )
+    else:
+        summary = summarize(records)
+        current_metrics = extract_compare_metrics(summary)
+        print(render_report(records))
+
+    if args.compare is None and args.baseline is None:
+        return 0
+    if args.compare is not None and args.baseline is not None:
+        print("report: use --compare OR --baseline, not both", file=sys.stderr)
+        return 2
+    if args.compare is not None:
+        base_records = load_records(args.compare)
+        if not base_records:
+            print(
+                f"report: no readable records in baseline {args.compare}",
+                file=sys.stderr,
+            )
+            return 1
+        base_metrics = extract_compare_metrics(summarize(base_records))
+        label = args.compare
+    else:
+        try:
+            with open(args.baseline) as f:
+                capture = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"report: unreadable baseline {args.baseline}: {exc}",
+                  file=sys.stderr)
+            return 1
+        if not isinstance(capture, dict):
+            print(f"report: baseline {args.baseline} is not a JSON object",
+                  file=sys.stderr)
+            return 1
+        base_metrics = baseline_capture_metrics(capture)
+        label = args.baseline
+    rows, regressions = compare_metrics(
+        base_metrics,
+        current_metrics,
+        default_threshold_pct=args.threshold_pct,
+        thresholds=thresholds,
+    )
+    print()
+    print(render_compare(rows, regressions, label))
+    return 3 if regressions else 0
 
 
 if __name__ == "__main__":

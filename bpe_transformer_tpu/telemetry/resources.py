@@ -1,0 +1,177 @@
+"""Resource accounting: the two numbers that actually kill TPU jobs.
+
+Production pjit/TPU deployments die to exactly two silent resource leaks —
+HBM creep (a growing live-buffer set marching toward ``bytes_limit``) and
+recompile storms (a shape leak turning every step into a multi-second XLA
+compile).  Neither shows up in loss curves; both are cheap to sample.  This
+module turns them into ``kind="resources"`` records in the unified PR-1
+telemetry stream:
+
+- **Device memory** — ``jax.local_devices()[*].memory_stats()`` (per-device
+  ``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``, summed across
+  local devices).  CPU backends return ``None`` from ``memory_stats()``;
+  the fields simply stay ``None`` there.
+- **Live buffers** — the total bytes of all live ``jax.Array``\\ s on this
+  host (`jax.live_arrays`), a backend-independent HBM proxy that works on
+  the CPU test platform too.  Metadata-only: no device sync.
+- **Host RSS** — ``/proc/self/status`` VmRSS (with a ``getrusage`` peak
+  fallback): host-side leaks (tokenizer tables, checkpoint staging copies)
+  kill pods just as dead.
+- **Compile events** — a process-wide counter fed by ``jax.monitoring``'s
+  compile-duration events (every jit cache miss, including the serving
+  engine's bucketed prefills) plus :func:`record_compile_events` for code
+  that compiles outside jax's event stream.  A counter that keeps climbing
+  after warmup is the recompile-storm signature.
+
+Everything here is **sync-free** (no ``device_get``, no blocking on async
+dispatch) so sampling can ride the existing once-per-``log_every`` metric
+fetch at zero additional host syncs per step — and **jax-optional**: on a
+host without jax the record still carries RSS, so the module stays safe to
+import from the jax-free report/monitor tools.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+#: Process-wide compile-event count (monitoring listener + manual records).
+_compile_events = 0
+_compile_lock = threading.Lock()
+_listener_installed = False
+
+#: The jax.monitoring duration event every backend compile records exactly
+#: once (traced-jaxpr and MLIR-lowering events fire alongside it; counting
+#: only this one keeps "1 event == 1 XLA compile").
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def record_compile_events(n: int = 1) -> int:
+    """Manually add ``n`` compile events to the process-wide counter (for
+    compile paths jax's monitoring stream doesn't cover); returns the new
+    total."""
+    global _compile_events
+    with _compile_lock:
+        _compile_events += n
+        return _compile_events
+
+
+def compile_events() -> int:
+    """Process-wide compile-event count so far (see module docstring)."""
+    with _compile_lock:
+        return _compile_events
+
+
+def install_compile_counter() -> bool:
+    """Register the ``jax.monitoring`` listener feeding :func:`compile_events`.
+
+    Idempotent; returns whether the listener is installed.  Safe (returns
+    False) without jax or on a jax without the monitoring API.  Callers that
+    sample resources should install this as early as possible — events
+    before installation are simply not counted.
+    """
+    global _listener_installed
+    # Check-and-register under the lock: listeners cannot be unregistered,
+    # so two racing first calls (a ServingEngine construction concurrent
+    # with a train loop arming the counter) must not both install — every
+    # compile would count twice for the process lifetime.
+    with _compile_lock:
+        if _listener_installed:
+            return True
+        try:
+            import jax.monitoring as monitoring
+
+            def _on_duration(event: str, duration: float, **_kwargs) -> None:
+                if event == _COMPILE_EVENT:
+                    record_compile_events(1)
+
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            return False
+        _listener_installed = True
+        return True
+
+
+def host_rss_bytes() -> int | None:
+    """Current resident set size of this process in bytes (Linux
+    ``/proc/self/status`` VmRSS; ``getrusage`` *peak* RSS as a portable
+    fallback), or None when neither source exists."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KiB on Linux, bytes on macOS.
+        return peak_kb if sys.platform == "darwin" else peak_kb * 1024
+    except Exception:
+        return None
+
+
+def device_memory_stats() -> dict | None:
+    """Summed ``memory_stats()`` across local devices: ``{"bytes_in_use",
+    "peak_bytes_in_use", "bytes_limit", "n_devices"}``, or None when the
+    backend exposes no stats (CPU) or jax is absent.  Metadata-only — never
+    syncs the device."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return None
+    totals = {"bytes_in_use": 0, "peak_bytes_in_use": 0, "bytes_limit": 0}
+    n = 0
+    for device in devices:
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        n += 1
+        for key in totals:
+            value = stats.get(key)
+            if isinstance(value, int):
+                totals[key] += value
+    if n == 0:
+        return None
+    totals["n_devices"] = n
+    return totals
+
+
+def live_buffer_bytes() -> int | None:
+    """Total bytes of live ``jax.Array`` buffers on this host (params, opt
+    state, caches, stray temporaries) — the backend-independent HBM proxy.
+    None without jax."""
+    try:
+        import jax
+
+        return int(sum(a.nbytes for a in jax.live_arrays()))
+    except Exception:
+        return None
+
+
+def sample_resources(**extra) -> dict:
+    """One ``kind="resources"`` record: host RSS, live-buffer bytes, summed
+    device-memory stats (None fields on CPU), and the process compile
+    counter.  ``extra`` attrs (``step``, ``t``) merge into the record.
+    Sync-free — safe at every ``log_every`` boundary."""
+    record: dict = {
+        "kind": "resources",
+        "time_unix": round(time.time(), 3),
+        "host_rss_bytes": host_rss_bytes(),
+        "live_buffer_bytes": live_buffer_bytes(),
+        "compile_events": compile_events(),
+    }
+    mem = device_memory_stats()
+    record["hbm_bytes_in_use"] = mem["bytes_in_use"] if mem else None
+    record["hbm_peak_bytes_in_use"] = mem["peak_bytes_in_use"] if mem else None
+    record["hbm_bytes_limit"] = mem["bytes_limit"] if mem else None
+    record.update(extra)
+    return record

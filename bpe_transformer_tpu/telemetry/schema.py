@@ -1,0 +1,64 @@
+"""The documented telemetry record schema — one source of truth.
+
+Every record any module in this package emits into the unified JSONL stream
+must be one of the kinds below, carrying at least the required fields.  The
+table is duplicated (deliberately, as prose) in ``ARCHITECTURE.md`` and
+``README.md`` § Observability; ``tools/check_telemetry_schema.py`` — wired
+into tier-1 — greps the package for every emitted ``kind`` and fails when
+one is missing from this registry, so a new record kind cannot ship
+undocumented.
+
+Jax-free: the report/monitor tools import this on hosts with no
+accelerator runtime.
+"""
+
+from __future__ import annotations
+
+#: kind -> set of REQUIRED fields.  Step/val metric records carry no
+#: ``kind`` key (the pre-telemetry JSONL schema, preserved); they are
+#: registered under the pseudo-kind ``"metric"``.
+RECORD_SCHEMAS: dict[str, set[str]] = {
+    # Run header: config, mesh, versions, git SHA, host (telemetry/manifest.py).
+    "manifest": {"kind", "run_kind", "time_utc", "host"},
+    # Closed wall-clock span; ``path`` is the /-joined nesting (spans.py).
+    "span": {"kind", "name", "path", "t", "dur_s"},
+    # Point-in-time marker: NaN dumps, watchdog trips, worker errors.
+    "event": {"kind", "name", "t"},
+    # Periodic serving-engine snapshot (serving/server.py).
+    "engine": {
+        "kind", "t", "active_slots", "queue_depth", "tokens_per_sec",
+        "tokens_total", "ticks", "requests_finished", "compiled_programs",
+    },
+    # Resource accounting sample (telemetry/resources.py): HBM fields are
+    # None on backends without memory_stats (CPU), never absent.
+    "resources": {
+        "kind", "time_unix", "host_rss_bytes", "live_buffer_bytes",
+        "compile_events", "hbm_bytes_in_use", "hbm_peak_bytes_in_use",
+        "hbm_bytes_limit",
+    },
+    # Run trailer: record counts + clean verdict (spans.py Telemetry.footer).
+    "footer": {"kind", "t", "record_counts"},
+    # Step/val metrics (NO kind key): at least a step number plus one
+    # metric value (loss or val_loss in practice).
+    "metric": {"step"},
+}
+
+
+def record_kind(record: dict) -> str:
+    """The schema kind of a record: its ``kind`` field, or ``"metric"``
+    for the kind-less step/val records."""
+    return record.get("kind", "metric")
+
+
+def validate_record(record: dict) -> list[str]:
+    """Problems with one record against the documented schema (empty list =
+    valid): unknown kind, or a required field missing.  Fields may be null
+    (e.g. HBM stats on CPU) — required means *present*, not non-null."""
+    kind = record_kind(record)
+    schema = RECORD_SCHEMAS.get(kind)
+    if schema is None:
+        return [f"undocumented record kind {kind!r}"]
+    missing = sorted(schema - record.keys())
+    if missing:
+        return [f"kind {kind!r} missing required fields: {', '.join(missing)}"]
+    return []

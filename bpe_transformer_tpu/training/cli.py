@@ -266,8 +266,11 @@ def cmd_serve(args) -> int:
 
     logger = MetricsLogger(jsonl_path=args.metrics_jsonl)
     telemetry = Telemetry(sink=logger.log) if args.metrics_jsonl else None
+    # Built unconditionally: /statusz serves the manifest even when no
+    # metrics JSONL is being written.
+    manifest = run_manifest(kind="serve", model_config=model_config)
     if telemetry is not None:
-        telemetry.emit(run_manifest(kind="serve", model_config=model_config))
+        telemetry.emit(manifest)
 
     serving = ServingEngine(
         payload["params"],
@@ -279,6 +282,7 @@ def cmd_serve(args) -> int:
         default_stop_id=stop_id,
         default_max_new_tokens=args.max_new_tokens,
         telemetry=telemetry,
+        manifest=manifest,
     )
     try:
         with serving:
@@ -321,7 +325,8 @@ def cmd_serve(args) -> int:
             print(
                 f"serving on http://{host}:{port}  "
                 f"(slots={args.slots}, queue={args.max_queue}; "
-                "POST /generate, GET /healthz; Ctrl-C/SIGTERM to stop)",
+                "POST /generate, GET /healthz /metrics /statusz; "
+                "Ctrl-C/SIGTERM to stop)",
                 flush=True,
             )
             try:
@@ -341,7 +346,32 @@ def cmd_report(args) -> int:
     # a laptop reading a metrics.jsonl pulled off a TPU pod.
     from bpe_transformer_tpu.telemetry.report import main as report_main
 
-    return report_main([args.metrics])
+    forwarded = [args.metrics]
+    if args.compare:
+        forwarded += ["--compare", args.compare]
+    if args.baseline:
+        forwarded += ["--baseline", args.baseline]
+    forwarded += ["--threshold-pct", str(args.threshold_pct)]
+    for pair in args.threshold or []:
+        forwarded += ["--threshold", pair]
+    return report_main(forwarded)
+
+
+def cmd_monitor(args) -> int:
+    # jax-free live view: tail a metrics.jsonl or poll a /metrics endpoint.
+    from bpe_transformer_tpu.telemetry.monitor import main as monitor_main
+
+    forwarded = []
+    if args.metrics:
+        forwarded.append(args.metrics)
+    if args.url:
+        forwarded += ["--url", args.url]
+    forwarded += ["--interval", str(args.interval)]
+    if args.once:
+        forwarded.append("--once")
+    if args.plain:
+        forwarded.append("--plain")
+    return monitor_main(forwarded)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -559,10 +589,39 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser(
         "report",
         help="summarize a telemetry metrics.jsonl (loss/throughput/MFU "
-        "stats, span breakdown, anomaly list); no accelerator needed",
+        "stats, span breakdown, anomaly list); no accelerator needed; "
+        "--compare/--baseline gate regressions with a nonzero exit",
     )
     p.add_argument("metrics", help="path to a metrics.jsonl telemetry stream")
+    p.add_argument("--compare", default=None, metavar="BASELINE_JSONL",
+                   help="baseline stream: print per-metric deltas; exit 3 "
+                   "on any regression beyond threshold")
+    p.add_argument("--baseline", default=None, metavar="BENCH_JSON",
+                   help="bench capture JSON (tpu_capture_*.json / "
+                   "BENCH_*.json) as the comparison baseline")
+    p.add_argument("--threshold-pct", type=float, default=5.0,
+                   help="default regression threshold in percent")
+    p.add_argument("--threshold", action="append", default=[],
+                   metavar="METRIC=PCT",
+                   help="per-metric threshold override (repeatable)")
     p.set_defaults(fn=cmd_report)
+
+    p = sub.add_parser(
+        "monitor",
+        help="live operational view: tail a metrics.jsonl or poll a "
+        "running server's /metrics endpoint; no accelerator needed",
+    )
+    p.add_argument("metrics", nargs="?", default=None,
+                   help="telemetry metrics.jsonl to tail")
+    p.add_argument("--url", default=None, metavar="HOST:PORT",
+                   help="poll http://HOST:PORT/metrics instead of a file")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh interval in seconds (default: 2)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (scripts/smoke tests)")
+    p.add_argument("--plain", action="store_true",
+                   help="plain stdout frames even on a tty (no curses)")
+    p.set_defaults(fn=cmd_monitor)
 
     return parser
 

@@ -39,8 +39,10 @@ from bpe_transformer_tpu.telemetry import (
     Telemetry,
     Watchdog,
     flatten_health,
+    install_compile_counter,
     nonfinite_fields,
     run_manifest,
+    sample_resources,
 )
 
 
@@ -138,6 +140,9 @@ def train(
     # spanned; records are buffered until the sinks exist (attach below).
     telemetry = Telemetry()
     setup_span = telemetry.start_span("setup")
+    # Arm the process-wide compile counter before the first trace so every
+    # jit cache miss of this run lands in the kind="resources" records.
+    install_compile_counter()
 
     if loop.health_stats and loop.parallel in ("sp", "pp"):
         raise ValueError(
@@ -599,6 +604,11 @@ def train(
                 # through the same JSONL handle) and counts the record for
                 # the footer's record_counts.
                 telemetry.emit(record)
+                # Resource accounting rides the same once-per-log_every
+                # boundary: sample_resources is sync-free (RSS, live-buffer
+                # metadata, device memory_stats, compile counter), so HBM
+                # headroom and recompile trends cost zero extra host syncs.
+                telemetry.emit(sample_resources(step=iteration))
                 log_fn(
                     f"step {record['step']:>6d}  loss {record['loss']:.4f}  "
                     f"lr {record['lr']:.2e}  gnorm {record['grad_norm']:.3f}  "

@@ -24,6 +24,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# jax 0.4.x compat: tests call jax.shard_map directly (the spelling newer
+# jax exports); alias the experimental symbol before any test module loads.
+from bpe_transformer_tpu.compat.shardmap import ensure_shard_map  # noqa: E402
+
+ensure_shard_map()
+
 import pytest  # noqa: E402
 
 REPO_ROOT = Path(__file__).resolve().parent.parent

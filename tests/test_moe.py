@@ -237,6 +237,7 @@ def test_ep_step_matches_single_device(dispatch):
     )
 
 
+@pytest.mark.slow
 def test_sp_moe_step_matches_single_device():
     """Context-parallel (ring attention) step with MoE FFNs == single-device
     step.  Capacity is generous so per-shard routing has no drops.  The aux
@@ -276,6 +277,7 @@ def test_sp_moe_step_matches_single_device():
     )
 
 
+@pytest.mark.slow
 def test_sp_moe_loop_trains(tmp_path):
     """The training loop accepts parallel="sp" with an MoE config (the hole
     closed in round 2) and the loss decreases."""
@@ -317,6 +319,7 @@ def test_moe_expert_weights_sharded_on_expert_axis():
     assert all(axis is None for axis in specs["layers"][0]["attn"]["q_proj"])
 
 
+@pytest.mark.slow
 def test_pp_moe_step_matches_single_device():
     """GPipe pipeline step with MoE FFNs == single-device step (aux weight
     zeroed for exact parity: the pp aux is per-microbatch/per-dispatch-group
@@ -362,6 +365,7 @@ def test_pp_moe_step_matches_single_device():
     )
 
 
+@pytest.mark.slow
 def test_pp_moe_loop_trains():
     """The training loop accepts parallel="pp" with an MoE config (the
     second composition hole closed in round 2) and the loss decreases with

@@ -1,13 +1,21 @@
 """Flash/chunked ring attention parity (split from test_parallel.py: these
 compile grad-of-shard_map programs with interpret-mode Pallas calls and
-dominate the file's runtime)."""
+dominate the file's runtime).
+
+Whole module lives behind the ``slow`` marker: every case runs grad-of-
+shard_map with interpret-mode Pallas on the 8-device CPU mesh — minutes
+each, far outside the tier-1 wall-clock budget.
+"""
 
 import dataclasses
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
+
+pytestmark = pytest.mark.slow
 
 from bpe_transformer_tpu.models import TS_TEST_CONFIG, init_params
 from bpe_transformer_tpu.optim import adamw_init

@@ -415,6 +415,14 @@ def test_serving_telemetry_stream_and_report(setup):
     assert {"serve/queue_wait", "serve/prefill", "serve/decode"} <= paths
     engines = [r for r in records if r.get("kind") == "engine"]
     assert engines and all("tokens_per_sec" in r for r in engines)
+    # kind="resources" records ride the engine-record cadence: non-null
+    # host RSS, an int compile counter, HBM keys present (null on CPU).
+    resources = [r for r in records if r.get("kind") == "resources"]
+    assert resources, "no resources records in the serving stream"
+    for r in resources:
+        assert r["host_rss_bytes"] > 0
+        assert isinstance(r["compile_events"], int)
+        assert "hbm_bytes_in_use" in r
     footer = records[-1]
     assert footer["kind"] == "footer" and footer["clean"] is True
     assert footer["requests"] == 3
@@ -460,6 +468,172 @@ def test_offline_batch_file_mode(tmp_path, setup):
         assert ln["finish_reason"] == "length" and ln["n_tokens"] == 4
         assert isinstance(ln["completion"], str)
         assert ln["decode_s"] >= 0.0
+
+
+# ------------------------------------------------- live metrics (tentpole)
+
+
+def _parse_prom(text: str) -> dict:
+    from bpe_transformer_tpu.telemetry.monitor import parse_prometheus
+
+    return parse_prometheus(text)
+
+
+def test_stats_and_statusz_offline_surface(setup):
+    """ServingEngine.stats() exposes the same counters /metrics renders
+    (submitted/rejected/finish-reason tallies, phase percentiles) and
+    statusz() the operator page (manifest, uptime, compile accounting,
+    per-slot state, error ring) — all without any HTTP server.  One engine
+    serves both checks: per-engine jit caches make engines the expensive
+    resource in this module."""
+    params, prompts = setup
+    manifest = {"kind": "manifest", "run_kind": "serve", "host": "test"}
+    with ServingEngine(
+        params, CFG, slots=1, min_bucket=8, manifest=manifest
+    ) as serving:
+        serving.generate(prompts[0], max_new_tokens=3, temperature=0.0)
+        serving.scheduler.max_queue = 1
+        rejected = 0
+        handles = []
+        for seed in range(6):
+            try:
+                handles.append(
+                    serving.submit(
+                        Request(
+                            prompt_ids=tuple(prompts[0]),
+                            max_new_tokens=8,
+                            seed=seed,
+                        )
+                    )
+                )
+            except QueueFullError:
+                rejected += 1
+        for h in handles:
+            h.result(timeout=60)
+        stats = serving.stats()
+        page = serving.statusz()
+    assert rejected >= 1, "queue never filled — rejection counter untested"
+    assert stats["requests_rejected"] == rejected
+    assert stats["requests_submitted"] == 1 + len(handles)
+    assert stats["finish_reasons"]["length"] == 1 + len(handles)
+    assert stats["finish_reasons"]["error"] == 0
+    assert stats["uptime_s"] > 0
+    assert stats["phase_p95_s"]["decode"] is not None
+
+    assert page["manifest"] is manifest
+    assert page["uptime_s"] > 0
+    assert page["compiled_programs"] >= 1
+    assert isinstance(page["compile_events"], int)
+    assert page["compile_events"] >= page["compiled_programs"]
+    assert len(page["slot_states"]) == 1
+    assert page["slot_states"][0]["slot"] == 0
+    assert page["last_errors"] == []
+    assert page["resources"]["host_rss_bytes"] > 0
+    json.dumps(page)  # the whole page must be one JSON document
+
+
+def test_statusz_records_worker_error(setup, monkeypatch):
+    params, prompts = setup
+    serving = ServingEngine(params, CFG, slots=1, min_bucket=8)
+    monkeypatch.setattr(
+        serving.engine, "admit",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("chip on fire")),
+    )
+    serving.start()
+    handle = serving.submit(
+        Request(prompt_ids=tuple(prompts[0]), max_new_tokens=2)
+    )
+    assert handle.result(timeout=10).finish_reason == "error"
+    errors = serving.statusz()["last_errors"]
+    assert errors and "chip on fire" in errors[-1]["error"]
+    assert serving.stats()["finish_reasons"]["error"] >= 1
+    serving.close()
+
+
+def test_metrics_endpoint_prometheus_exposition(setup):
+    """ACCEPTANCE: GET /metrics returns valid Prometheus text exposition —
+    counters monotone across scrapes, histograms sum-consistent (+Inf
+    bucket == _count, bucket counts nondecreasing in le) — and /statusz
+    returns the manifest + compile counter."""
+    params, prompts = setup
+    manifest = {"kind": "manifest", "run_kind": "serve", "host": "test"}
+    with ServingEngine(
+        params, CFG, slots=2, min_bucket=8, manifest=manifest
+    ) as serving:
+        serving.generate(prompts[0], max_new_tokens=3, temperature=0.0)
+        server = make_http_server(serving, port=0)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+
+            def scrape():
+                resp = urllib.request.urlopen(f"{base}/metrics", timeout=30)
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                return _parse_prom(resp.read().decode())
+
+            first = scrape()
+            assert first["bpe_tpu_requests_submitted_total"] == 1
+            assert first['bpe_tpu_requests_finished_total{reason="length"}'] == 1
+            assert first["bpe_tpu_tokens_generated_total"] == 3
+            assert first["bpe_tpu_engine_compiled_programs"] >= 1
+
+            serving.generate(prompts[1], max_new_tokens=4, temperature=0.0)
+            second = scrape()
+            # Counters are monotone between scrapes.
+            for name in (
+                "bpe_tpu_requests_submitted_total",
+                "bpe_tpu_tokens_generated_total",
+                "bpe_tpu_ticks_total",
+                'bpe_tpu_requests_finished_total{reason="length"}',
+            ):
+                assert second[name] >= first[name], name
+            assert second["bpe_tpu_requests_submitted_total"] == 2
+            assert second["bpe_tpu_tokens_generated_total"] == 7
+
+            # Histogram consistency per phase: buckets cumulative/monotone,
+            # +Inf bucket equals _count, _sum nonnegative.
+            for phase in ("queue_wait", "prefill", "decode"):
+                buckets = sorted(
+                    (
+                        (float(name.split('le="')[1].rstrip('"}').replace(
+                            "+Inf", "inf")), value)
+                        for name, value in second.items()
+                        if name.startswith(
+                            "bpe_tpu_request_phase_seconds_bucket"
+                        )
+                        and f'phase="{phase}"' in name
+                    ),
+                )
+                counts = [v for _, v in buckets]
+                assert counts == sorted(counts), f"{phase}: non-cumulative"
+                count = second[
+                    f'bpe_tpu_request_phase_seconds_count{{phase="{phase}"}}'
+                ]
+                assert buckets[-1][1] == count == 2
+                assert (
+                    second[
+                        f'bpe_tpu_request_phase_seconds_sum{{phase="{phase}"}}'
+                    ]
+                    >= 0
+                )
+
+            statusz = json.loads(
+                urllib.request.urlopen(f"{base}/statusz", timeout=30).read()
+            )
+            assert statusz["manifest"]["run_kind"] == "serve"
+            assert statusz["compiled_programs"] >= 1
+            assert isinstance(statusz["compile_events"], int)
+            assert len(statusz["slot_states"]) == 2
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"{base}/nope", timeout=30)
+            assert err.value.code == 404
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
 
 
 # ------------------------------------------------------------------- HTTP
@@ -616,6 +790,23 @@ def test_cli_serve_http_smoke(tmp_path, setup):
         assert out["finish_reason"] in ("length", "stop")
         assert len(out["token_ids"]) >= 1
         assert isinstance(out["completion"], str)
+
+        # The live observability surface on a real `bpe-tpu serve` process:
+        # Prometheus /metrics and the /statusz operator page.
+        prom = _parse_prom(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=60
+            ).read().decode()
+        )
+        assert prom["bpe_tpu_requests_submitted_total"] >= 1
+        assert prom["bpe_tpu_tokens_generated_total"] >= 1
+        statusz = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz", timeout=60
+            ).read()
+        )
+        assert statusz["manifest"]["run_kind"] == "serve"
+        assert statusz["compiled_programs"] >= 1
     finally:
         killer.cancel()
         proc.send_signal(signal.SIGINT)

@@ -8,6 +8,7 @@ seconds under JAX_PLATFORMS=cpu (the integration tests train a byte-level
 
 import json
 import math
+from pathlib import Path
 
 import jax.numpy as jnp
 import numpy as np
@@ -443,9 +444,14 @@ def test_report_importable_without_jax(tmp_path):
         "import sys\n"
         "sys.modules['jax'] = None\n"  # any `import jax` now raises
         "from bpe_transformer_tpu.telemetry.report import summarize\n"
+        "from bpe_transformer_tpu.telemetry.monitor import fold_records\n"
         "from bpe_transformer_tpu.telemetry import (\n"
-        "    MetricsLogger, Telemetry, Watchdog, nonfinite_fields, run_manifest)\n"
+        "    MetricsLogger, Telemetry, Watchdog, nonfinite_fields,\n"
+        "    run_manifest, sample_resources, validate_record)\n"
         "assert 'jax_version' not in run_manifest(kind='offline')\n"
+        "record = sample_resources()\n"  # degrades: RSS only, null device fields
+        "assert record['host_rss_bytes'] and record['hbm_bytes_in_use'] is None\n"
+        "assert validate_record(record) == []\n"
         "print('ok')\n"
     )
     repo = Path(__file__).resolve().parent.parent
@@ -456,6 +462,351 @@ def test_report_importable_without_jax(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert proc.stdout.strip() == "ok"
+
+
+# ------------------------------------------------------ resources (PR 3)
+
+
+def test_sample_resources_record_shape_and_rss():
+    from bpe_transformer_tpu.telemetry import sample_resources, validate_record
+
+    record = sample_resources(step=7)
+    assert record["kind"] == "resources" and record["step"] == 7
+    assert validate_record(record) == []
+    # Host RSS must be real on Linux CI; live buffers are an int (possibly
+    # 0); CPU backends carry null HBM fields, but the KEYS are pinned.
+    assert record["host_rss_bytes"] > 1024 * 1024
+    assert isinstance(record["live_buffer_bytes"], int)
+    assert isinstance(record["compile_events"], int)
+    for key in ("hbm_bytes_in_use", "hbm_peak_bytes_in_use", "hbm_bytes_limit"):
+        assert key in record
+
+
+def test_compile_counter_counts_fresh_jit_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    from bpe_transformer_tpu.telemetry import (
+        compile_events,
+        install_compile_counter,
+        record_compile_events,
+    )
+
+    assert install_compile_counter() is True
+    assert install_compile_counter() is True  # idempotent
+    before = compile_events()
+
+    @jax.jit
+    def f(x, c):
+        return x * c
+
+    f(jnp.ones(3), 2.0)  # fresh program: one compile event
+    first = compile_events()
+    assert first >= before + 1
+    f(jnp.ones(3), 3.0)  # cache hit: no new event
+    assert compile_events() == first
+    f(jnp.ones((2, 2)), 2.0)  # new shape: recompile
+    assert compile_events() >= first + 1
+    assert record_compile_events(2) == compile_events()
+
+
+def test_validate_record_flags_unknown_and_missing():
+    from bpe_transformer_tpu.telemetry import validate_record
+
+    assert validate_record({"step": 3, "loss": 1.0}) == []
+    assert validate_record(
+        {"kind": "span", "name": "x", "path": "x", "t": 0.0, "dur_s": 0.1}
+    ) == []
+    assert "undocumented" in validate_record({"kind": "mystery"})[0]
+    assert "missing required" in validate_record({"kind": "span", "name": "x"})[0]
+
+
+def test_telemetry_schema_tool_is_clean():
+    """tools/check_telemetry_schema.py (the tier-1 gate): every kind
+    emitted in the package is documented, the docs tables are current, and
+    the committed fixtures validate."""
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [_sys.executable, str(repo / "tools" / "check_telemetry_schema.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "telemetry schema clean" in proc.stdout
+
+
+# ------------------------------------------------- compare / regression gate
+
+
+def test_compare_metrics_directions_and_thresholds():
+    from bpe_transformer_tpu.telemetry.report import compare_metrics
+
+    base = {
+        "tokens_per_sec_mean": (1000.0, "higher"),
+        "loss_last": (2.0, "lower"),
+        "step_wall_s_mean": (0.01, "lower"),
+    }
+    cur = {
+        "tokens_per_sec_mean": (900.0, "higher"),   # -10%: regression
+        "loss_last": (1.8, "lower"),                # -10%: improvement
+        "step_wall_s_mean": (0.0102, "lower"),      # +2%: within threshold
+        "mfu_mean": (0.3, "higher"),                # not in baseline: skipped
+    }
+    rows, regressions = compare_metrics(base, cur, default_threshold_pct=5.0)
+    verdicts = {r["metric"]: r["verdict"] for r in rows}
+    assert verdicts == {
+        "loss_last": "improved",
+        "tokens_per_sec_mean": "regressed",
+        "step_wall_s_mean": "ok",
+    }
+    assert regressions == ["tokens_per_sec_mean"]
+    # A per-metric threshold override can waive the gate.
+    _, regressions = compare_metrics(
+        base, cur, default_threshold_pct=5.0,
+        thresholds={"tokens_per_sec_mean": 15.0},
+    )
+    assert regressions == []
+
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+def test_report_compare_fixture_pair_gates_regression(capsys):
+    """ACCEPTANCE: the committed fixture pair encodes a known throughput/
+    MFU/HBM regression; `bpe-tpu report --compare` exits 3 on it, 0 in the
+    improving direction, and 0 when thresholds waive it."""
+    from bpe_transformer_tpu.telemetry.report import main as report_main
+
+    base = str(FIXTURES / "compare_base.jsonl")
+    regressed = str(FIXTURES / "compare_regressed.jsonl")
+    assert report_main([regressed, "--compare", base]) == 3
+    out = capsys.readouterr().out
+    assert "== compare vs" in out and "regressed" in out
+    assert "tokens_per_sec_mean" in out and "hbm_peak_bytes" in out
+
+    # The improving direction passes the gate (deltas flagged "improved").
+    assert report_main([base, "--compare", regressed]) == 0
+    assert "improved" in capsys.readouterr().out
+
+    # Thresholds are configurable: wide enough, the same pair passes.
+    assert report_main(
+        [regressed, "--compare", base, "--threshold-pct", "50"]
+    ) == 0
+    # ...and a bad per-metric threshold is a usage error, not a silent skip.
+    assert report_main(
+        [regressed, "--compare", base, "--threshold", "typo_metric=5"]
+    ) == 2
+
+
+def test_report_baseline_capture_gate(tmp_path, capsys):
+    """--baseline gates a stream against a bench capture JSON (and a
+    capture against a previous capture — the tpu_queue.sh self-report)."""
+    from bpe_transformer_tpu.telemetry.report import main as report_main
+
+    capture = tmp_path / "tpu_capture_test.json"
+    capture.write_text(json.dumps(
+        {"metric": "tok/s", "value": 1500000.0, "mfu": 0.28,
+         "platform": "tpu", "final_val_loss": 2.7}
+    ))
+    regressed = str(FIXTURES / "compare_regressed.jsonl")
+    assert report_main([regressed, "--baseline", str(capture)]) == 3
+    assert "regressed" in capsys.readouterr().out
+
+    slower = tmp_path / "tpu_capture_prev.json"
+    slower.write_text(json.dumps(
+        {"metric": "tok/s", "value": 1000000.0, "mfu": 0.2, "platform": "tpu"}
+    ))
+    assert report_main([str(capture), "--baseline", str(slower)]) == 0
+    out = capsys.readouterr().out
+    assert "== bench capture" in out and "improved" in out
+    assert report_main([str(slower), "--baseline", str(capture)]) == 3
+
+
+def test_report_graceful_on_empty_and_manifest_less(tmp_path, capsys):
+    """Satellite: an empty (or corrupt-only) stream exits 1 with a clear
+    message — never a traceback — and a manifest-less stream still renders
+    with an explicit '(no manifest record)' line."""
+    from bpe_transformer_tpu.telemetry.report import main as report_main
+
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert report_main([str(empty)]) == 1
+    err = capsys.readouterr().err
+    assert "no readable records" in err and "Traceback" not in err
+
+    corrupt = tmp_path / "corrupt.jsonl"
+    corrupt.write_text("not json at all\n{truncat")
+    assert report_main([str(corrupt)]) == 1
+
+    manifestless = tmp_path / "manifestless.jsonl"
+    manifestless.write_text(json.dumps({"step": 1, "loss": 2.0}) + "\n")
+    assert report_main([str(manifestless)]) == 0
+    assert "(no manifest record)" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------------ monitor
+
+
+def test_monitor_fold_records_builds_operational_state():
+    from bpe_transformer_tpu.telemetry.monitor import fold_records, render_frame
+
+    state = fold_records([
+        {"kind": "manifest", "run_kind": "train",
+         "devices": {"count": 8, "kind": "cpu"}},
+        {"step": 10, "loss": 3.0, "tokens_per_sec": 500.0, "mfu": 0.1},
+        {"step": 20, "loss": 2.5, "tokens_per_sec": 600.0, "mfu": 0.12},
+        {"kind": "resources", "time_unix": 0.0, "host_rss_bytes": 2**30,
+         "live_buffer_bytes": 2**20, "compile_events": 4,
+         "hbm_bytes_in_use": None, "hbm_peak_bytes_in_use": None,
+         "hbm_bytes_limit": None},
+        {"kind": "event", "name": "watchdog_hang", "t": 5.0},
+        {"kind": "footer", "t": 9.0, "clean": True, "record_counts": {}},
+    ])
+    assert state["step"] == 20 and state["loss"] == 2.5
+    assert state["host_rss_bytes"] == 2**30
+    assert "hbm_bytes_in_use" not in state  # null never overwrites
+    assert state["anomalies"] == 1 and state["last_anomaly"] == "watchdog_hang"
+    assert state["footer_clean"] is True
+    frame = render_frame(state, "test.jsonl")
+    assert "step 20" in frame and "loss 2.5" in frame
+    assert "rss 1,024.0 MiB" in frame
+    assert "anomalies 1" in frame and "cleanly" in frame
+    # Incremental fold continues from prior state (the tail path).
+    state2 = fold_records([{"step": 30, "loss": 2.4}], state)
+    assert state2["step"] == 30 and state2["anomalies"] == 1
+
+
+def test_monitor_prometheus_roundtrip():
+    """render_prometheus -> parse_prometheus -> fold_prometheus closes the
+    loop: the monitor reconstructs serve state from a real scrape body."""
+    from bpe_transformer_tpu.serving.metrics import (
+        ServingMetrics,
+        render_prometheus,
+    )
+    from bpe_transformer_tpu.telemetry.monitor import (
+        fold_prometheus,
+        parse_prometheus,
+        render_frame,
+    )
+
+    m = ServingMetrics()
+    m.on_submit(); m.on_submit(); m.on_reject()
+    m.on_finish("length"); m.on_finish("stop")
+    m.observe_phase("decode", 0.2)
+    m.observe_phase("queue_wait", 0.004)
+    text = render_prometheus(
+        m,
+        {"queue_depth": 1, "active_slots": 2, "slots": 4, "ticks": 9,
+         "tokens_emitted": 55, "compiled_programs": 3},
+        {"compile_events": 7, "host_rss_bytes": 2**20,
+         "live_buffer_bytes": None, "hbm_bytes_in_use": None,
+         "hbm_peak_bytes_in_use": None, "hbm_bytes_limit": None},
+    )
+    state = fold_prometheus(parse_prometheus(text))
+    assert state["requests_finished"] == 2
+    assert state["requests_rejected"] == 1
+    assert state["queue_depth"] == 1 and state["slots"] == 4
+    assert state["tokens_total"] == 55
+    assert state["compile_events"] == 7
+    assert "hbm_bytes_in_use" not in state  # null gauges never rendered
+    frame = render_frame(state, "http://x/metrics")
+    assert "slots 2/4" in frame and "queue 1" in frame and "rejected 1" in frame
+
+
+def test_monitor_histogram_consistency():
+    from bpe_transformer_tpu.serving.metrics import LatencyHistogram
+
+    h = LatencyHistogram(buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    cumulative = h.cumulative()
+    assert [c for _, c in cumulative] == [1, 2, 3, 4]
+    assert cumulative[-1][0] == math.inf and cumulative[-1][1] == h.count == 4
+    assert h.sum == pytest.approx(5.555)
+    assert h.percentile(0.5) == 0.1
+    assert h.percentile(1.0) == 1.0  # +Inf clamps to the last finite bound
+    h.observe(float("nan"))  # ignored, not corrupted
+    assert h.count == 4
+
+
+def test_monitor_cli_once_smoke(tmp_path):
+    """Satellite: `bpe-tpu monitor <stream> --once` renders one frame and
+    exits 0 in a non-tty subprocess, without jax importable."""
+    import subprocess
+    import sys as _sys
+
+    repo = Path(__file__).resolve().parent.parent
+    fixture = repo / "tests" / "fixtures" / "serving_tiny.jsonl"
+    proc = subprocess.run(
+        [
+            _sys.executable, "-c",
+            "import sys; sys.modules['jax'] = None\n"
+            "from bpe_transformer_tpu.telemetry.monitor import main\n"
+            f"sys.exit(main([{str(fixture)!r}, '--once']))",
+        ],
+        capture_output=True, text=True, timeout=120,
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": str(repo)},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "bpe-tpu monitor" in proc.stdout
+    assert "requests 3" in proc.stdout
+
+    # Usage errors are crisp: no source, or two sources.
+    from bpe_transformer_tpu.telemetry.monitor import main as monitor_main
+
+    assert monitor_main([]) == 2
+    assert monitor_main(["x.jsonl", "--url", "host:1"]) == 2
+    assert monitor_main([str(tmp_path / "missing.jsonl")]) == 1
+
+
+def test_monitor_url_mode_against_live_endpoint(tmp_path):
+    """--url mode: the monitor scrapes a real HTTP /metrics endpoint (a
+    stub server rendering ServingMetrics) and folds it into a frame."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from bpe_transformer_tpu.serving.metrics import (
+        ServingMetrics,
+        render_prometheus,
+    )
+    from bpe_transformer_tpu.telemetry.monitor import UrlSource
+
+    m = ServingMetrics()
+    m.on_submit()
+    m.on_finish("length")
+    m.observe_phase("decode", 0.1)
+    body = render_prometheus(
+        m, {"queue_depth": 0, "active_slots": 0, "slots": 2, "ticks": 3,
+            "tokens_emitted": 12, "compiled_programs": 2},
+    )
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            data = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    server = HTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        source = UrlSource(f"127.0.0.1:{server.server_address[1]}")
+        state = source.refresh()
+        assert state["requests_finished"] == 1
+        assert state["tokens_total"] == 12
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=10)
 
 
 # -------------------------------------------------- loop integration (CPU)
@@ -516,6 +867,16 @@ def test_train_emits_unified_stream_and_report_reads_it(tmp_path, byte_data):
         assert r["nonfinite_loss"] == 0
         assert r["grad_norm/attn"] > 0 and r["param_norm/ffn"] > 0
         assert r["tokens_per_sec"] > 0 and r["step_wall_s"] > 0
+
+    # ACCEPTANCE (PR 3): the run emits kind="resources" records at every
+    # log boundary with non-null host RSS (HBM fields null on CPU), at
+    # zero extra host syncs — they ride the existing metric fetch.
+    resources = [r for r in records if r.get("kind") == "resources"]
+    assert [r["step"] for r in resources] == [4, 8]
+    for r in resources:
+        assert r["host_rss_bytes"] > 0
+        assert isinstance(r["compile_events"], int) and r["compile_events"] >= 1
+        assert "hbm_bytes_in_use" in r and "live_buffer_bytes" in r
 
     footer = records[-1]
     assert footer["kind"] == "footer" and footer["clean"] is True

@@ -230,6 +230,7 @@ def test_generate_greedy_and_topk(byte_data):
     assert all(0 <= t < TINY.vocab_size for t in sampled)
 
 
+@pytest.mark.slow
 def test_pp_training_runs(byte_data, tmp_path):
     """GPipe pipeline loop: 2 stages x 4-way data parallel, with eval +
     checkpoint in the stacked-stage layout."""
@@ -420,6 +421,7 @@ def test_loop_grad_accum_trains():
     assert summary["history"][-1]["loss"] < summary["history"][0]["loss"]
 
 
+@pytest.mark.slow
 def test_loop_sp_zigzag_trains_and_evals(tmp_path):
     """parallel='sp' with sp_zigzag=True: the striped schedule trains and
     the dense eval still sees sequences in global order."""
@@ -446,6 +448,7 @@ def test_loop_sp_zigzag_trains_and_evals(tmp_path):
     assert np.isfinite(summary["final_val_loss"])
 
 
+@pytest.mark.slow
 def test_loop_sp_grad_accum_trains_and_evals(tmp_path):
     """The training loop drives grad accumulation under the sp (ring
     attention) mesh — the r3 NotImplementedError is gone: microbatch scan
@@ -471,6 +474,7 @@ def test_loop_sp_grad_accum_trains_and_evals(tmp_path):
     assert np.isfinite(summary["final_val_loss"])
 
 
+@pytest.mark.slow
 def test_loop_sp_inner_steps_with_tail_trains(tmp_path):
     """inner_steps under sp through the loop, with a 1-step TAIL (9 steps,
     stride 4 -> scans of 4+4+1): the tail rebuilds the step via
@@ -537,6 +541,7 @@ def test_loop_inner_steps_on_fsdp_mesh_trains(byte_data):
     assert hist[-1]["step"] == 18
 
 
+@pytest.mark.slow
 def test_loop_pp_grad_accum_trains_and_evals(byte_data, tmp_path):
     """The training loop drives grad accumulation around the pipeline —
     the last pp NotImplementedError is gone: each accumulation slice runs
@@ -562,6 +567,7 @@ def test_loop_pp_grad_accum_trains_and_evals(byte_data, tmp_path):
     assert np.isfinite(summary["final_val_loss"])
 
 
+@pytest.mark.slow
 def test_loop_pp_inner_steps_with_tail_trains(byte_data, tmp_path):
     """inner_steps under pp through the loop, with a 1-step TAIL (9 steps,
     stride 4 -> scans of 4+4+1): the tail rebuilds via build_step(1) and
@@ -586,6 +592,7 @@ def test_loop_pp_inner_steps_with_tail_trains(byte_data, tmp_path):
     assert np.isfinite(summary["final_val_loss"])
 
 
+@pytest.mark.slow
 def test_loop_sp_ulysses_trains_and_evals(byte_data, tmp_path):
     """The training loop drives the Ulysses all-to-all schedule (heads
     scattered over the seq axis) end-to-end, eval on the dense forward."""
