@@ -160,7 +160,21 @@ def _capture_path() -> Path:
         # gpt2-medium remats by default, so BENCH_REMAT=1 is not a deviation
         # there (mirrors _try_replay_capture's want_remat resolution).
         suffix += "_remat"
+    if _dynamics_enabled():
+        # Dynamics-introspection overhead run (tpu_queue.sh dyn_overhead):
+        # its own capture file, compared against the plain headline by the
+        # queue's self-report — it must never clobber the replayed capture.
+        suffix += "_dynamics"
     return CAPTURE_DIR / f"tpu_capture_{ARGS.config}{suffix}.json"
+
+
+def _dynamics_enabled() -> bool:
+    """BENCH_DYNAMICS=1: build the train step with the in-graph
+    telemetry.dynamics stats (per-layer norms, update ratios, activation
+    taps) so the capture measures their tokens/sec overhead.  A boolean,
+    not a cadence — the stats compile into every step; the training CLI's
+    --dynamics-every N only gates record EMISSION, never compute."""
+    return os.environ.get("BENCH_DYNAMICS") == "1"
 
 
 def _write_capture_atomic(payload: dict) -> None:
@@ -561,14 +575,17 @@ def bench_jax(platform: str) -> None:
     ids = rng.integers(0, config.vocab_size, size=(batch, config.context_length))
     x = jnp.asarray(ids)
     y = jnp.asarray(np.roll(ids, -1, axis=1))
+    dynamics = _dynamics_enabled()
     if inner > 1:
         from bpe_transformer_tpu.training.train_step import make_scanned_train_step
 
-        step = make_scanned_train_step(config, TrainHParams(), inner)
+        step = make_scanned_train_step(
+            config, TrainHParams(), inner, dynamics=dynamics
+        )
         x = jnp.broadcast_to(x, (inner, *x.shape))
         y = jnp.broadcast_to(y, (inner, *y.shape))
     else:
-        step = make_train_step(config, TrainHParams())
+        step = make_train_step(config, TrainHParams(), dynamics=dynamics)
 
     # A value fetch is the only reliable execution barrier on every backend
     # (block_until_ready has proven unreliable on relayed remote devices).
@@ -609,6 +626,7 @@ def bench_jax(platform: str) -> None:
             remat=config.remat,
             ffn_impl=config.ffn_impl,
             moe_dispatch=config.moe_dispatch if config.ffn_type == "moe" else None,
+            dynamics_stats=dynamics,
             flops_per_step=train_step_flops(config, batch),
         )
         # Leave room for the torch baseline (GPT-2-scale CPU steps take

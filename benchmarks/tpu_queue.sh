@@ -281,6 +281,18 @@ run_job serve_gpt2s_4 1800 "$CAP/serving.jsonl" \
   python benchmarks/bench_serving.py --config gpt2-small-32k \
   --concurrency 4 --requests 8
 
+# Dynamics-introspection overhead (PR 4): the headline config with the
+# in-graph telemetry.dynamics stats compiled into the step (per-layer
+# norms, update ratios, activation taps), captured to its own file
+# (suffix _dynamics) — the <2% tokens/sec overhead claim is measured on
+# the chip, not asserted.  Marker "-" (re-run every pass, like the
+# headline): the self-report at the end compares this capture to the
+# SAME pass's fresh headline, so environment drift between passes can
+# never masquerade as introspection overhead.
+run_job - 300 "$OUT/bench_dynamics.jsonl" \
+  env BENCH_DYNAMICS=1 BENCH_NO_CPU_FALLBACK=1 BENCH_DRIVER_FLAG=0 \
+  python bench.py
+
 # Multi-worker host tokenization (VERDICT r4 #7) is deliberately NOT a
 # queue job: it needs no TPU, and running it here would hold queue.lock
 # through a ~15-min CPU-only bench while a tunnel window closes.  The
@@ -301,6 +313,21 @@ if [ -e "$OUT/prev_headline_capture.json" ] && [ -e "$HEADLINE_CAP" ] && \
     3) log "REGRESSION: headline capture regressed vs previous pass (report above)";;
     0) log "headline capture delta vs previous pass: within threshold";;
     *) log "headline regression self-report failed (non-fatal)";;
+  esac
+fi
+# Dynamics-overhead self-report (jax-free, CPU-only): the _dynamics
+# capture vs the plain headline capture at a 2% gate.  Exit 3 = the
+# in-graph introspection costs more than the documented budget; logged
+# loudly, never fatal (evidence first).
+DYN_CAP="$CAP/tpu_capture_tinystories-4l_dynamics.json"
+if [ -e "$DYN_CAP" ] && [ -e "$HEADLINE_CAP" ]; then
+  env JAX_PLATFORMS=cpu python -m bpe_transformer_tpu.telemetry.report \
+    "$DYN_CAP" --baseline "$HEADLINE_CAP" --threshold-pct 2 \
+    >> "$OUT/log" 2>&1
+  case $? in
+    3) log "DYNAMICS OVERHEAD: tokens/sec >2% below the plain headline (report above)";;
+    0) log "dynamics overhead vs plain headline: within the 2% budget";;
+    *) log "dynamics overhead self-report failed (non-fatal)";;
   esac
 fi
 log "queue pass complete"

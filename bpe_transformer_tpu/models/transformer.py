@@ -147,6 +147,7 @@ def _attention(
     rope_cos_sin: tuple[Array, Array] | None,
     positions: Array,
     attention_fn=None,
+    entropy_tap: dict | None = None,
 ) -> Array:
     if attention_fn is None and config.attention_impl == "flash":
         from bpe_transformer_tpu.kernels.pallas.flash_attention import (
@@ -190,6 +191,34 @@ def _attention(
             )
     elif attention_fn is None and config.attention_impl != "xla":
         raise ValueError(f"unknown attention_impl: {config.attention_impl!r}")
+    if entropy_tap is not None:
+        # Dynamics introspection (telemetry.dynamics): record the mean
+        # attention entropy of this layer from the q/k handed to the
+        # attention callable — post-RoPE for the xla/flash paths, pre-RoPE
+        # under flash_fused above the crossover (where RoPE lives inside
+        # the kernel; the entropy is then of the un-rotated scores — an
+        # indicator, not an exact value).  Sampled from batch element 0:
+        # the tap re-materializes an (S, S) score matrix, and one example
+        # is plenty for a collapse/uniformity diagnostic.
+        from bpe_transformer_tpu.ops.core import (
+            attention_entropy,
+            causal_mask,
+            scaled_dot_product_attention,
+        )
+
+        inner = attention_fn
+
+        def tapped(q, k, v, _inner=inner):
+            q_s = q[:1] if q.ndim > 3 else q
+            k_s = k[:1] if k.ndim > 3 else k
+            entropy_tap["attn_entropy"] = attention_entropy(q_s, k_s)
+            if _inner is not None:
+                return _inner(q, k, v)
+            return scaled_dot_product_attention(
+                q, k, v, causal_mask(q.shape[-2])
+            )
+
+        attention_fn = tapped
     return multihead_self_attention(
         x,
         attn_params["q_proj"],
@@ -212,18 +241,22 @@ def transformer_block_aux(
     rope_cos_sin: tuple[Array, Array] | None,
     positions: Array,
     attention_fn=None,
+    entropy_tap: dict | None = None,
 ) -> tuple[Array, Array]:
     """One block; returns ``(x, aux_loss)`` (aux nonzero only for MoE FFNs).
 
     Pre-norm by default, post-norm under the ablation flag.
     ``attention_fn(q, k, v)`` overrides the config-selected attention (used
     by the sequence-parallel path to substitute ring attention).
+    ``entropy_tap`` (a dict, dynamics introspection) receives this layer's
+    mean attention entropy under ``"attn_entropy"``.
     """
     if config.use_post_norm:
         x = _maybe_norm(
             x
             + _attention(
-                x, block_params["attn"], config, rope_cos_sin, positions, attention_fn
+                x, block_params["attn"], config, rope_cos_sin, positions,
+                attention_fn, entropy_tap,
             ),
             block_params["ln1"],
             config,
@@ -232,7 +265,8 @@ def transformer_block_aux(
         return _maybe_norm(x + f, block_params["ln2"], config), aux
     h = _maybe_norm(x, block_params["ln1"], config)
     x = x + _attention(
-        h, block_params["attn"], config, rope_cos_sin, positions, attention_fn
+        h, block_params["attn"], config, rope_cos_sin, positions, attention_fn,
+        entropy_tap,
     )
     h = _maybe_norm(x, block_params["ln2"], config)
     f, aux = _ffn(h, block_params["ffn"], config)
@@ -253,19 +287,15 @@ def transformer_block(
     )[0]
 
 
-def forward_hidden(
+def _forward_prologue(
     params: Params,
     token_ids: Array,
     config: ModelConfig,
-    positions: Array | None = None,
-    attention_fn=None,
-) -> tuple[Array, Array]:
-    """Final-norm hidden states ``(batch, seq, d_model)`` + summed MoE aux.
-
-    Everything in :func:`forward` except the LM head — the seam for
-    memory-lean losses that stream the vocab projection in chunks instead of
-    materializing ``(batch, seq, vocab)`` logits.
-    """
+    positions: Array | None,
+):
+    """Shared entry of the forward passes: seq validation, default
+    positions, mixed-precision weight cast, embedding lookup, RoPE tables.
+    Returns ``(x, compute_params, rope_cos_sin, positions)``."""
     seq_len = token_ids.shape[-1]
     if seq_len > config.context_length:
         raise ValueError(
@@ -294,6 +324,25 @@ def forward_hidden(
             config.d_head, config.context_length, config.rope_theta
         )
         rope_cos_sin = (cos.astype(act_dtype), sin.astype(act_dtype))
+    return x, compute_params, rope_cos_sin, positions
+
+
+def forward_hidden(
+    params: Params,
+    token_ids: Array,
+    config: ModelConfig,
+    positions: Array | None = None,
+    attention_fn=None,
+) -> tuple[Array, Array]:
+    """Final-norm hidden states ``(batch, seq, d_model)`` + summed MoE aux.
+
+    Everything in :func:`forward` except the LM head — the seam for
+    memory-lean losses that stream the vocab projection in chunks instead of
+    materializing ``(batch, seq, vocab)`` logits.
+    """
+    x, compute_params, rope_cos_sin, positions = _forward_prologue(
+        params, token_ids, config, positions
+    )
 
     block = transformer_block_aux
     if config.remat:
@@ -308,6 +357,77 @@ def forward_hidden(
 
     x = _maybe_norm(x, compute_params["ln_final"], config)
     return x, aux_total
+
+
+def _block_with_stats(
+    x: Array,
+    block_params: dict,
+    config: ModelConfig,
+    rope_cos_sin: tuple[Array, Array] | None,
+    positions: Array,
+    attention_fn=None,
+) -> tuple[Array, Array, dict]:
+    """One block + its activation statistics (dynamics introspection).
+
+    The stats are part of the RETURN value (not a side channel), so the
+    function stays pure and composes with ``jax.checkpoint`` — under remat
+    the tap simply recomputes with the block in the backward pass.
+    """
+    tap: dict = {}
+    x, aux = transformer_block_aux(
+        x, block_params, config, rope_cos_sin, positions, attention_fn, tap
+    )
+    x32 = x.astype(jnp.float32)
+    stats = {
+        "rms": jnp.sqrt(jnp.mean(jnp.square(x32))),
+        "absmax": jnp.max(jnp.abs(x32)),
+        "nonfinite": jnp.sum(~jnp.isfinite(x)).astype(jnp.int32),
+        "attn_entropy": tap.get("attn_entropy", jnp.zeros((), jnp.float32)),
+    }
+    return x, aux, stats
+
+
+def forward_hidden_stats(
+    params: Params,
+    token_ids: Array,
+    config: ModelConfig,
+    positions: Array | None = None,
+    attention_fn=None,
+) -> tuple[Array, Array, dict]:
+    """:func:`forward_hidden` + per-block activation statistics.
+
+    Returns ``(hidden, aux_total, act_stats)`` where ``act_stats`` stacks
+    one scalar per layer: ``{"rms": (L,), "absmax": (L,), "nonfinite":
+    (L,) i32, "attn_entropy": (L,)}`` — block-output RMS/absmax/non-finite
+    counts plus the mean attention entropy (sampled from batch element 0).
+    The stats are ordinary traced scalars, so the dynamics-enabled train
+    step gets them from the SAME forward it differentiates — no second
+    pass, no host syncs (`telemetry.dynamics`).  Honors ``config.remat``
+    like :func:`forward_hidden`.
+    """
+    x, compute_params, rope_cos_sin, positions = _forward_prologue(
+        params, token_ids, config, positions
+    )
+
+    block = _block_with_stats
+    if config.remat:
+        # config and attention_fn are non-array (static) arguments.
+        block = jax.checkpoint(_block_with_stats, static_argnums=(2, 5), policy=None)
+    aux_total = jnp.zeros((), jnp.float32)
+    per_layer: list[dict] = []
+    for block_params in compute_params["layers"]:
+        x, aux, stats = block(
+            x, block_params, config, rope_cos_sin, positions, attention_fn
+        )
+        aux_total = aux_total + aux
+        per_layer.append(stats)
+    act_stats = {
+        key: jnp.stack([stats[key] for stats in per_layer])
+        for key in per_layer[0]
+    }
+
+    x = _maybe_norm(x, compute_params["ln_final"], config)
+    return x, aux_total, act_stats
 
 
 def forward(

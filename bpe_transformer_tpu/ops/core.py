@@ -105,6 +105,31 @@ def causal_mask(seq_len: int, dtype=bool) -> Array:
     return jnp.tril(jnp.ones((seq_len, seq_len), dtype=dtype))
 
 
+def attention_entropy(q: Array, k: Array, causal: bool = True) -> Array:
+    """Mean Shannon entropy (nats) of the softmax attention distribution.
+
+    ``q (..., Sq, d)``, ``k (..., Sk, d)`` — the same tensors an
+    ``attention_fn`` receives; scores/log-softmax accumulate in float32.
+    Averaged over every leading axis and query position: ~0 means the
+    heads collapsed onto single keys, ~log(Sk) means uniform (no learned
+    structure).  The telemetry dynamics tap (`telemetry.dynamics`) calls
+    this on a batch slice — it re-materializes the (Sq, Sk) score matrix,
+    which fused attention kernels exist to avoid.
+    """
+    q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
+    scores = jnp.einsum("...qd,...kd->...qk", q32, k32) / jnp.sqrt(
+        jnp.asarray(q.shape[-1], jnp.float32)
+    )
+    if causal:
+        scores = jnp.where(
+            causal_mask(scores.shape[-1])[: scores.shape[-2]], scores, MASK_VALUE
+        )
+    logp = jax.nn.log_softmax(scores, axis=-1)
+    # exp(logp) is exactly 0 at masked entries, so p * logp contributes -0.0
+    # there (never NaN).
+    return -jnp.mean(jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+
 def split_heads(x: Array, num_heads: int) -> Array:
     """``(..., S, H*dh) -> (..., H, S, dh)`` with head-major row layout.
 

@@ -45,30 +45,39 @@ def _multi_step_body(
     inner_steps: int,
     reduce_axis: str | None,
     health: bool = False,
+    dynamics: bool = False,
 ) -> tuple[Callable, bool]:
     """(body, stacked): the per-shard update body for the requested
     accumulation/scan mode, and whether batches carry a leading stacked dim
     (``(accum|inner, micro_batch, seq)`` instead of ``(batch, seq)``).
 
-    ``health`` threads through to the shared update bodies (see
-    ``training.train_step.train_step_fn``): the device-side health stats
-    compile inside the same sharded program, so their reductions reuse the
-    step's collectives and nothing new crosses the host boundary."""
+    ``health`` and ``dynamics`` thread through to the shared update bodies
+    (see ``training.train_step.train_step_fn``): the device-side health/
+    dynamics stats compile inside the same sharded program, so their
+    reductions reuse the step's collectives and nothing new crosses the
+    host boundary."""
     if accum_steps > 1 and inner_steps > 1:
         raise ValueError("accum_steps and inner_steps cannot both exceed 1")
     if accum_steps > 1:
         return (
             grad_accum_step_fn(
-                config, hparams, accum_steps, reduce_axis, health=health
+                config, hparams, accum_steps, reduce_axis, health=health,
+                dynamics=dynamics,
             ),
             True,
         )
     if inner_steps > 1:
         return (
-            scanned_step_fn(config, hparams, inner_steps, reduce_axis, health=health),
+            scanned_step_fn(
+                config, hparams, inner_steps, reduce_axis, health=health,
+                dynamics=dynamics,
+            ),
             True,
         )
-    return train_step_fn(config, hparams, reduce_axis, health=health), False
+    return (
+        train_step_fn(config, hparams, reduce_axis, health=health, dynamics=dynamics),
+        False,
+    )
 
 
 def make_dp_train_step(
@@ -79,6 +88,7 @@ def make_dp_train_step(
     accum_steps: int = 1,
     inner_steps: int = 1,
     health: bool = False,
+    dynamics: bool = False,
 ) -> Callable:
     """Data-parallel step with an explicit gradient all-reduce over ``axis``.
 
@@ -93,7 +103,8 @@ def make_dp_train_step(
     with its own all-reduce; batches are ``(inner_steps, batch, seq)``.
     """
     body, stacked = _multi_step_body(
-        config, hparams, accum_steps, inner_steps, reduce_axis=axis, health=health
+        config, hparams, accum_steps, inner_steps, reduce_axis=axis,
+        health=health, dynamics=dynamics,
     )
     batch_spec = P(None, axis) if stacked else P(axis)
     # out_specs are pytree PREFIXES: the final P() covers the whole metrics
@@ -117,6 +128,7 @@ def make_gspmd_train_step(
     accum_steps: int = 1,
     inner_steps: int = 1,
     health: bool = False,
+    dynamics: bool = False,
 ) -> Callable:
     """Sharding-annotated jit step; XLA derives the collective schedule.
 
@@ -132,7 +144,8 @@ def make_gspmd_train_step(
     if example_params is None:
         raise ValueError("example_params is required to derive shardings")
     body, stacked = _multi_step_body(
-        config, hparams, accum_steps, inner_steps, reduce_axis=None, health=health
+        config, hparams, accum_steps, inner_steps, reduce_axis=None,
+        health=health, dynamics=dynamics,
     )
     p_sh = param_shardings(example_params, mesh, strategy)
     replicated = NamedSharding(mesh, P())

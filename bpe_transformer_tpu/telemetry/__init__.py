@@ -12,6 +12,12 @@ Subsumes and extends the old ``utils.metrics`` / ``utils.profiling`` pair
 - `health` — device-side health stats computed INSIDE the jitted train step
   (non-finite detection, per-layer-group grad/param norms, MoE load
   balance), fetched with the existing once-per-``log_every`` sync;
+- `dynamics` — per-layer training-dynamics introspection (grad/param
+  norms, update-to-param ratios, activation stats, NaN/Inf localization),
+  same in-graph/zero-extra-sync contract, emitted as ``kind="dynamics"``
+  records;
+- `trace` — Chrome trace-event export of the span stream
+  (``bpe-tpu report --trace``, jax-free);
 - `watchdog` — hung-step detection against the trailing median step time
   plus the "dump state + raise or skip" non-finite policy;
 - `timing` — ``StepTimer`` throughput/MFU windows, ``profile_trace``,
@@ -32,15 +38,18 @@ from bpe_transformer_tpu.telemetry.sinks import MetricsLogger
 from bpe_transformer_tpu.telemetry.spans import Telemetry
 from bpe_transformer_tpu.telemetry.watchdog import NonFiniteError, Watchdog
 
-#: `health` and `timing` import jax at module load; they resolve lazily
-#: (PEP 562) so the jax-free members above — most importantly the report
-#: tool — stay importable on hosts with no accelerator runtime, matching
-#: the package root's lazy-subpackage design.
+#: `health`, `dynamics`, and `timing` import jax at module load; they
+#: resolve lazily (PEP 562) so the jax-free members above — most
+#: importantly the report tool — stay importable on hosts with no
+#: accelerator runtime, matching the package root's lazy-subpackage design.
 _LAZY_SUBMODULE = {
     "flatten_health": "health",
     "group_norms": "health",
     "health_metrics": "health",
     "nonfinite_count": "health",
+    "dynamics_metrics": "dynamics",
+    "dynamics_record": "dynamics",
+    "flatten_dynamics": "dynamics",
     "StepTimer": "timing",
     "profile_trace": "timing",
     "time_fn": "timing",
@@ -67,6 +76,9 @@ __all__ = [
     "Telemetry",
     "Watchdog",
     "compile_events",
+    "dynamics_metrics",
+    "dynamics_record",
+    "flatten_dynamics",
     "flatten_health",
     "git_sha",
     "group_norms",

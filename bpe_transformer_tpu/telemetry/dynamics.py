@@ -1,0 +1,176 @@
+"""Training-dynamics introspection, computed INSIDE the jitted train step.
+
+`telemetry.health` answers "is the run healthy?" with five coarse
+layer-group norms and global non-finite counts; this module answers the
+next question an unstable run forces — *which layer* diverged, was it
+drifting beforehand, did an attention head collapse — with per-layer
+resolution:
+
+- **per-layer gradient and parameter L2 norms** — one scalar per
+  transformer layer (``layers.N``) plus the embed/head/final-norm tensors,
+  so a norm drifting for 500 steps before the NaN is visible in the
+  trajectory, not just the post-mortem;
+- **update-to-param ratios** — ``||Δp|| / ||p||`` of the actual AdamW
+  update (post-clip, post-weight-decay, the real parameter delta), the
+  canonical learning-rate sanity signal (healthy runs sit around 1e-3; a
+  layer 10x off the median is the outlier the report calls out);
+- **per-block activation statistics** (`models.transformer.
+  forward_hidden_stats`) — RMS / absmax / non-finite count of every
+  block's output plus the mean attention entropy per layer (sampled from
+  batch element 0; ~0 = collapsed heads, ~log(seq) = uniform);
+- **per-tensor non-finite counts** for NaN/Inf localization — counted on
+  the step's INPUT params (where the poison actually lives when the step
+  runs; post-update params are globally poisoned one step after any NaN
+  gradient) and on the gradients, yielding a ``first_nonfinite`` tensor
+  path (``params/layers.3.ffn.w1``) the watchdog event and report callout
+  name directly.
+
+The host-sync constraint is the same one `telemetry.resources` respects
+(and the pjit/TPUv4 scaling literature demands): everything here is an
+ordinary device scalar appended to the step's ``metrics`` pytree, fetched
+by the loop's existing once-per-``log_every`` ``device_get`` — ZERO
+additional device→host transfers.  Host-side, :func:`flatten_dynamics`
+turns the fetched pytree into the flat keys of a ``kind="dynamics"``
+record (`telemetry.schema`).
+
+Localization granularity equals the fetch cadence: a NaN appearing
+mid-window poisons downstream tensors by the boundary.  The documented
+forensic workflow is therefore: watchdog trips at step N -> resume from
+the last checkpoint with ``--dynamics-every 1 --log-every 1`` and the
+first boundary names the offending tensor before the cascade.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+
+#: ``keystr`` tokens: ``['layers'][3]['attn']['q_proj']`` -> layers, 3, ...
+_KEY_TOKEN = re.compile(r"\['(\w+)'\]|\[(\d+)\]")
+
+
+def tensor_path(key_path) -> str:
+    """A pytree key path -> dotted tensor path (``layers.3.attn.q_proj``)."""
+    keystr = jax.tree_util.keystr(key_path)
+    return ".".join(a or b for a, b in _KEY_TOKEN.findall(keystr))
+
+
+def layer_label(path: str) -> str:
+    """Per-layer bucket of a dotted tensor path: ``layers.N`` for block
+    tensors, the top-level name (``token_embeddings``/``lm_head``/
+    ``ln_final``) otherwise."""
+    parts = path.split(".")
+    if parts[0] == "layers" and len(parts) > 1:
+        return f"layers.{parts[1]}"
+    return parts[0]
+
+
+def per_layer_norms(tree) -> dict:
+    """Per-layer L2 norms of a pytree as ``{layer_label: f32 scalar}``.
+
+    Squared sums accumulate in f32 (bf16 squares overflow at moderate
+    norms); grouping is static at trace time, so this adds only reduction
+    ops to the jitted program.
+    """
+    sums: dict = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        label = layer_label(tensor_path(path))
+        sq = jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        sums[label] = sums.get(label, 0.0) + sq
+    return {label: jnp.sqrt(total) for label, total in sorted(sums.items())}
+
+
+def per_tensor_nonfinite(tree) -> dict:
+    """Non-finite element count of every leaf, keyed by dotted tensor path
+    (i32 scalars — the NaN/Inf localization map)."""
+    return {
+        tensor_path(path): jnp.sum(~jnp.isfinite(leaf)).astype(jnp.int32)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+
+
+def dynamics_metrics(grads, params_before, params_after, act_stats=None) -> dict:
+    """The device-side dynamics sub-pytree for a train step's metrics.
+
+    ``grads`` should be the PRE-clip (post-pmean) gradients — the true
+    magnitudes, not the clipped ones the optimizer consumes.  Norms and
+    the update ratio describe the post-update params (the trajectory);
+    non-finite localization counts the step's INPUT params (see module
+    docstring).  ``act_stats`` is the per-layer activation dict from
+    ``forward_hidden_stats`` (None on paths that cannot tap activations,
+    e.g. the grad-accumulation scan).
+    """
+    update = jax.tree_util.tree_map(
+        lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+        params_after,
+        params_before,
+    )
+    param_norms = per_layer_norms(params_after)
+    update_norms = per_layer_norms(update)
+    out = {
+        "grad_norm": per_layer_norms(grads),
+        "param_norm": param_norms,
+        "update_ratio": {
+            label: update_norms[label] / (param_norms[label] + 1e-12)
+            for label in param_norms
+        },
+        "nonfinite_params": per_tensor_nonfinite(params_before),
+        "nonfinite_grads": per_tensor_nonfinite(grads),
+    }
+    if act_stats is not None:
+        out["act"] = act_stats
+    return out
+
+
+def flatten_dynamics(dyn: dict) -> dict:
+    """Host-side: the fetched dynamics pytree -> flat ``kind="dynamics"``
+    record keys.
+
+    Norm/ratio scalars become ``grad_norm/layers.N`` etc.; activation
+    arrays fan out per layer (``act_rms/layers.N``, ``attn_entropy/...``);
+    non-finite counts appear ONLY when nonzero (``nonfinite_params/<path>``
+    — a clean step carries no localization noise), and the first offender
+    (params, then activations, then grads — the order that survives the
+    poisoning cascade longest) lands in ``first_nonfinite``.
+    """
+    flat: dict = {}
+    for src in ("grad_norm", "param_norm", "update_ratio"):
+        for label, value in dyn.get(src, {}).items():
+            flat[f"{src}/{label}"] = float(value)
+    act = dyn.get("act")
+    act_first = None
+    if act:
+        for name, prefix in (
+            ("rms", "act_rms"),
+            ("absmax", "act_absmax"),
+            ("attn_entropy", "attn_entropy"),
+        ):
+            for i, value in enumerate(act.get(name, ())):
+                flat[f"{prefix}/layers.{i}"] = float(value)
+        for i, count in enumerate(act.get("nonfinite", ())):
+            if int(count):
+                flat[f"act_nonfinite/layers.{i}"] = int(count)
+                if act_first is None:
+                    act_first = f"act/layers.{i}"
+    first = None
+    for src, label in (("nonfinite_params", "params"), ("nonfinite_grads", "grads")):
+        src_first = None
+        for path, count in dyn.get(src, {}).items():
+            if int(count):
+                flat[f"{src}/{path}"] = int(count)
+                if src_first is None:
+                    src_first = f"{label}/{path}"
+        if first is None:
+            first = src_first
+            if src == "nonfinite_params" and first is None:
+                first = act_first
+    if first is not None:
+        flat["first_nonfinite"] = first
+    return flat
+
+
+def dynamics_record(step: int, flat: dict) -> dict:
+    """One ``kind="dynamics"`` record (schema: `telemetry.schema`)."""
+    return {"kind": "dynamics", "step": step, **flat}

@@ -5,8 +5,9 @@ Two sources, one panel:
 
 - **a telemetry stream** (``bpe-tpu monitor run/metrics.jsonl``): tail the
   unified JSONL the training loop / serving engine writes, folding every
-  record kind (metric | span | event | engine | resources | manifest |
-  footer) into the latest operational state;
+  record kind (metric | span | event | engine | resources | dynamics |
+  manifest | footer) into the latest operational state — a dynamics-enabled
+  training run gets a live per-layer grad-norm/update-ratio table;
 - **a live server** (``bpe-tpu monitor --url host:port``): poll
   ``GET /metrics`` on a ``bpe-tpu serve`` process and parse the Prometheus
   exposition back into the same state.
@@ -70,6 +71,28 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
                         "hbm_bytes_limit", "compile_events"):
                 if record.get(key) is not None:
                     state[key] = record[key]
+        elif kind == "dynamics":
+            # Latest per-layer introspection sample (telemetry/dynamics.py):
+            # keep the whole flat record, merged so a partial sample (e.g.
+            # grad-accum paths carry no activation stats) never erases the
+            # keys a previous full sample established.
+            dyn = dict(state.get("dynamics") or {})
+            dyn.update(
+                {
+                    k: v
+                    for k, v in record.items()
+                    if k.startswith(("grad_norm/", "param_norm/",
+                                     "update_ratio/", "act_rms/",
+                                     "act_absmax/", "attn_entropy/"))
+                }
+            )
+            state["dynamics"] = dyn
+            state["dynamics_step"] = record.get("step")
+            if record.get("first_nonfinite"):
+                state["anomalies"] += 1
+                state["last_anomaly"] = (
+                    f"nonfinite {record['first_nonfinite']}"
+                )
         elif kind == "event":
             if record.get("name") in _ANOMALY_EVENTS:
                 state["anomalies"] += 1
@@ -131,6 +154,15 @@ def fold_prometheus(samples: dict, prefix: str = "bpe_tpu") -> dict:
 
 
 # ---------------------------------------------------------------- rendering
+
+
+def _dyn_labels(dyn: dict) -> list[str]:
+    """Per-layer labels present in a folded dynamics sample, in the same
+    natural order as the report's Dynamics table (schema.layer_sort_key)."""
+    from bpe_transformer_tpu.telemetry.schema import layer_sort_key
+
+    labels = {key.split("/", 1)[1] for key in dyn if "/" in key}
+    return sorted(labels, key=layer_sort_key)
 
 
 def _mib(n) -> str:
@@ -206,6 +238,26 @@ def render_frame(state: dict, source: str) -> str:
         mem_parts.append(f"rss {_mib(state['host_rss_bytes'])}")
     if mem_parts:
         lines.append("  mem    " + "  ".join(mem_parts))
+
+    dyn = state.get("dynamics")
+    if dyn:
+        step = state.get("dynamics_step")
+        lines.append(
+            "  dyn    per-layer introspection"
+            + (f" (step {_num(step)})" if step is not None else "")
+        )
+        lines.append(
+            f"         {'layer':<18s}{'gnorm':>10s}{'upd/param':>11s}"
+            f"{'act rms':>9s}{'entropy':>9s}"
+        )
+        for label in _dyn_labels(dyn):
+            lines.append(
+                f"         {label:<18s}"
+                f"{_num(dyn.get(f'grad_norm/{label}'), 3):>10s}"
+                f"{_num(dyn.get(f'update_ratio/{label}'), 2):>11s}"
+                f"{_num(dyn.get(f'act_rms/{label}'), 3):>9s}"
+                f"{_num(dyn.get(f'attn_entropy/{label}'), 3):>9s}"
+            )
 
     compile_parts = []
     if state.get("compile_events") is not None:

@@ -10,9 +10,13 @@ header, step-metric records, span/event records, and a footer.
 
 Sections: run manifest, loss-curve stats, throughput/MFU trajectory, a
 serving summary (engine records + per-request queue_wait/prefill/decode
-span percentiles, for ``bpe-tpu serve`` streams), span breakdown, health
-summary, and an anomaly list (non-finite records, loss spikes,
-watchdog/NaN/serving events, a missing or unclean footer).
+span percentiles, for ``bpe-tpu serve`` streams), a dynamics summary
+(per-layer norm trajectories, update-ratio outliers, first-non-finite
+localization — ``kind="dynamics"`` records, `telemetry.dynamics`), span
+breakdown, health summary, and an anomaly list (non-finite records, loss
+spikes, watchdog/NaN/serving events, a missing or unclean footer).
+``--trace out.json`` additionally exports the span stream as Chrome
+trace-event JSON (`telemetry.trace`).
 """
 
 from __future__ import annotations
@@ -21,6 +25,8 @@ import json
 import math
 import sys
 from pathlib import Path
+
+from bpe_transformer_tpu.telemetry.schema import layer_sort_key
 
 
 def nonfinite_fields(record: dict) -> list[str]:
@@ -66,6 +72,14 @@ def load_records(path: str | Path) -> list[dict]:
     except OSError:
         return []
     return records
+
+
+def _last_value(records: list[dict], key: str):
+    """The key's value in the LAST record that carries it (None if none)."""
+    for record in reversed(records):
+        if key in record:
+            return record[key]
+    return None
 
 
 def _stats(values: list[float]) -> dict:
@@ -139,9 +153,15 @@ def summarize(records: list[dict]) -> dict:
     anomalies: list[str] = []
     for record in steps:
         bad = nonfinite_fields(record)
-        if bad:
+        if bad or record.get("nonfinite_path"):
             anomalies.append(
-                f"non-finite state at step {record.get('step')}: {', '.join(bad)}"
+                f"non-finite state at step {record.get('step')}"
+                + (f": {', '.join(bad)}" if bad else "")
+                + (
+                    f" (localized to {record['nonfinite_path']})"
+                    if record.get("nonfinite_path")
+                    else ""
+                )
             )
     for record in vals:
         v = record.get("val_loss")
@@ -160,6 +180,7 @@ def summarize(records: list[dict]) -> dict:
                 f"{event['name']} event"
                 + (f" at step {event['step']}" if event.get("step") is not None else "")
                 + (f" (silent {event['silent_s']}s)" if "silent_s" in event else "")
+                + (f" localized to {event['path']}" if event.get("path") else "")
                 + (f": {event['error']}" if "error" in event else "")
             )
     if (steps or engines) and footer is None:
@@ -252,6 +273,70 @@ def summarize(records: list[dict]) -> dict:
             ),
         }
 
+    # Training-dynamics records (kind="dynamics", telemetry/dynamics.py):
+    # per-layer norm trajectories, update-ratio outliers, and the
+    # first-non-finite localization callout.
+    dynamics = [r for r in records if r.get("kind") == "dynamics"]
+    dynamics_summary = None
+    if dynamics:
+        labels = sorted(
+            {
+                key.split("/", 1)[1]
+                for r in dynamics
+                for key in r
+                if key.startswith("grad_norm/")
+            },
+            key=layer_sort_key,
+        )
+        per_layer = {}
+        for label in labels:
+            per_layer[label] = {
+                "grad_norm": _stats(
+                    [r[f"grad_norm/{label}"] for r in dynamics
+                     if f"grad_norm/{label}" in r]
+                ),
+                "update_ratio_last": _last_value(dynamics, f"update_ratio/{label}"),
+                "act_rms_last": _last_value(dynamics, f"act_rms/{label}"),
+                "attn_entropy_last": _last_value(dynamics, f"attn_entropy/{label}"),
+            }
+        localization = next(
+            (
+                {"step": r.get("step"), "path": r["first_nonfinite"]}
+                for r in dynamics
+                if r.get("first_nonfinite")
+            ),
+            None,
+        )
+        ratios = {
+            label: stats["update_ratio_last"]
+            for label, stats in per_layer.items()
+            if isinstance(stats["update_ratio_last"], (int, float))
+            and math.isfinite(stats["update_ratio_last"])
+            and stats["update_ratio_last"] > 0
+        }
+        outliers = []
+        if len(ratios) >= 3:
+            median = _pctl(list(ratios.values()), 0.5)
+            if median and median > 0:
+                outliers = [
+                    {"layer": label, "ratio": ratio,
+                     "x_median": ratio / median}
+                    for label, ratio in ratios.items()
+                    if ratio > 10 * median or ratio < median / 10
+                ]
+        dynamics_summary = {
+            "n": len(dynamics),
+            "step_range": [dynamics[0].get("step"), dynamics[-1].get("step")],
+            "per_layer": per_layer,
+            "first_nonfinite": localization,
+            "update_ratio_outliers": outliers,
+        }
+        if localization:
+            anomalies.append(
+                f"non-finite localized to {localization['path']} "
+                f"(first dynamics record at step {localization['step']})"
+            )
+
     return {
         "manifest": manifest,
         "n_manifests": len(manifests),
@@ -280,6 +365,7 @@ def summarize(records: list[dict]) -> dict:
         },
         "serving": serving,
         "resources": resource_summary,
+        "dynamics": dynamics_summary,
         "spans": span_breakdown,
         "health_last": health_last,
         "events": [e.get("name") for e in events],
@@ -424,6 +510,41 @@ def render_report(records: list[dict]) -> str:
             ce = rs["compile_events"]
             lines.append(
                 f"  compile events {_fmt(ce.get('first'))} -> {_fmt(ce.get('last'))}"
+            )
+
+    dy = s["dynamics"]
+    if dy:
+        lines.append(
+            f"== dynamics ({dy['n']} records, steps "
+            f"{dy['step_range'][0]}..{dy['step_range'][1]}) =="
+        )
+        lines.append(
+            f"  {'layer':<20s}{'grad norm (first -> last)':<28s}"
+            f"{'upd/param':>10s}{'act rms':>9s}{'entropy':>9s}"
+        )
+        for label, st_l in dy["per_layer"].items():
+            gn = st_l["grad_norm"]
+            traj = (
+                f"{_fmt(gn.get('first'))} -> {_fmt(gn.get('last'))}"
+                if gn
+                else "-"
+            )
+            lines.append(
+                f"  {label:<20s}{traj:<28s}"
+                f"{_fmt(st_l['update_ratio_last'], 3):>10s}"
+                f"{_fmt(st_l['act_rms_last'], 3):>9s}"
+                f"{_fmt(st_l['attn_entropy_last'], 3):>9s}"
+            )
+        if dy["first_nonfinite"]:
+            lines.append(
+                f"  ! first non-finite: {dy['first_nonfinite']['path']} "
+                f"at step {dy['first_nonfinite']['step']}"
+            )
+        for outlier in dy["update_ratio_outliers"]:
+            lines.append(
+                f"  ! update-ratio outlier: {outlier['layer']} at "
+                f"{_fmt(outlier['ratio'], 3)} "
+                f"({outlier['x_median']:.1f}x the per-layer median)"
             )
 
     if s["spans"]:
@@ -642,6 +763,12 @@ def main(argv: list[str] | None = None) -> int:
         "comparison baseline instead of a second stream",
     )
     parser.add_argument(
+        "--trace", metavar="OUT_JSON", default=None,
+        help="export the span stream as Chrome trace-event JSON (open in "
+        "Perfetto / chrome://tracing); engine/resources records become "
+        "counter tracks",
+    )
+    parser.add_argument(
         "--threshold-pct", type=float, default=5.0,
         help="default regression threshold in percent (default: 5)",
     )
@@ -706,6 +833,22 @@ def main(argv: list[str] | None = None) -> int:
         summary = summarize(records)
         current_metrics = extract_compare_metrics(summary)
         print(render_report(records))
+
+    if args.trace is not None:
+        if not records:
+            print(
+                "report: --trace needs a telemetry stream, not a bench "
+                "capture JSON",
+                file=sys.stderr,
+            )
+            return 2
+        from bpe_transformer_tpu.telemetry.trace import write_trace
+
+        n = write_trace(records, args.trace)
+        print(
+            f"wrote {n} trace events -> {args.trace} "
+            "(open in Perfetto / chrome://tracing)"
+        )
 
     if args.compare is None and args.baseline is None:
         return 0

@@ -8,6 +8,12 @@ into tier-1 — greps the package for every emitted ``kind`` and fails when
 one is missing from this registry, so a new record kind cannot ship
 undocumented.
 
+The Chrome trace exporter (``telemetry/trace.py``) additionally assumes
+``span`` records carry ``t``/``dur_s`` on the run-relative seconds axis,
+``engine`` records share that ``t`` axis, and ``resources`` records carry
+absolute ``time_unix`` — declared as ``TRACE_ASSUMPTIONS`` there and
+cross-checked against this registry by the same tool.
+
 Jax-free: the report/monitor tools import this on hosts with no
 accelerator runtime.
 """
@@ -36,12 +42,32 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
         "compile_events", "hbm_bytes_in_use", "hbm_peak_bytes_in_use",
         "hbm_bytes_limit",
     },
+    # Training-dynamics introspection sample (telemetry/dynamics.py),
+    # emitted every --dynamics-every steps at the log-cadence fetch.  The
+    # payload is flat per-layer keys — grad_norm/param_norm/update_ratio
+    # per layer label (``layers.N``, ``token_embeddings``, ...), activation
+    # act_rms/act_absmax/attn_entropy per block, nonzero non-finite counts
+    # per tensor path (``nonfinite_params/layers.3.ffn.w1``) and a
+    # ``first_nonfinite`` localization path — all optional (a grad-accum
+    # step has no activation taps; a clean step has no non-finite keys).
+    "dynamics": {"kind", "step"},
     # Run trailer: record counts + clean verdict (spans.py Telemetry.footer).
     "footer": {"kind", "t", "record_counts"},
     # Step/val metrics (NO kind key): at least a step number plus one
     # metric value (loss or val_loss in practice).
     "metric": {"step"},
 }
+
+
+def layer_sort_key(label: str):
+    """Natural ordering for the per-layer labels of ``dynamics`` records:
+    ``layers.2`` before ``layers.10``, block layers before the top-level
+    tensors (``lm_head``, ``ln_final``, ``token_embeddings``).  Shared by
+    the report and monitor renderers so their tables always agree."""
+    parts = label.split(".")
+    if parts[0] == "layers" and len(parts) > 1 and parts[1].isdigit():
+        return (0, int(parts[1]), label)
+    return (1, 0, label)
 
 
 def record_kind(record: dict) -> str:

@@ -1,0 +1,209 @@
+"""Chrome trace-event export: the span stream as a Perfetto-viewable JSON.
+
+``bpe-tpu report --trace out.json`` turns the unified telemetry stream's
+``kind="span"`` records into Chrome trace-event *complete* events (``"ph":
+"X"``) and the periodic ``kind="engine"`` / ``kind="resources"`` snapshots
+into *counter* tracks (``"ph": "C"``), producing a file chrome://tracing
+and https://ui.perfetto.dev open directly.  Jax-free, like the rest of the
+report tooling.
+
+Layout: every distinct span ``path`` gets its own named thread lane
+(first-seen order, so ``setup`` sorts above ``setup/resume`` — parents
+open before children), which keeps concurrent serving requests from
+garbling one another while the nesting stays readable from the lane names.
+
+Timeline assumptions (declared in :data:`TRACE_ASSUMPTIONS`, cross-checked
+against the schema registry by ``tools/check_telemetry_schema.py``): span
+``t``/``dur_s`` are seconds relative to the run's ``Telemetry`` epoch —
+engine records share that ``t`` axis; resources records carry absolute
+``time_unix`` and are re-based against the manifest's ``time_utc`` (the
+run start) when present, else against the first resources sample.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import sys
+from pathlib import Path
+
+#: Record kind -> fields this exporter reads.  Every entry must be a
+#: subset of the kind's required schema fields (telemetry/schema.py) —
+#: tools/check_telemetry_schema.py enforces it, so a schema change cannot
+#: silently break the exporter.
+TRACE_ASSUMPTIONS: dict[str, set[str]] = {
+    "span": {"name", "path", "t", "dur_s"},
+    "engine": {"kind", "t"},
+    "resources": {"kind", "time_unix"},
+}
+
+#: Counter series pulled from each periodic record kind.
+_ENGINE_COUNTERS = ("active_slots", "queue_depth", "tokens_per_sec")
+_RESOURCE_COUNTERS = (
+    "host_rss_bytes",
+    "live_buffer_bytes",
+    "hbm_bytes_in_use",
+    "compile_events",
+)
+
+_PID = 1
+
+
+def _manifest_epoch_unix(records: list[dict]) -> float | None:
+    """The run-start unix time from the latest manifest's ``time_utc``
+    (ISO-8601), or None when absent/unparseable."""
+    for record in reversed(records):
+        if record.get("kind") == "manifest" and record.get("time_utc"):
+            try:
+                return datetime.datetime.fromisoformat(
+                    str(record["time_utc"])
+                ).timestamp()
+            except ValueError:
+                return None
+    return None
+
+
+def trace_events(records: list[dict]) -> list[dict]:
+    """Telemetry records -> a Chrome trace-event list (ts/dur in µs)."""
+    events: list[dict] = [
+        {
+            "ph": "M",
+            "pid": _PID,
+            "name": "process_name",
+            "args": {"name": "bpe-tpu telemetry"},
+        }
+    ]
+    tids: dict[str, int] = {}
+
+    def tid_for(path: str) -> int:
+        tid = tids.get(path)
+        if tid is None:
+            tid = tids[path] = len(tids) + 1
+            events.append(
+                {
+                    "ph": "M",
+                    "pid": _PID,
+                    "tid": tid,
+                    "name": "thread_name",
+                    "args": {"name": path},
+                }
+            )
+            events.append(
+                {
+                    "ph": "M",
+                    "pid": _PID,
+                    "tid": tid,
+                    "name": "thread_sort_index",
+                    "args": {"sort_index": tid},
+                }
+            )
+        return tid
+
+    epoch_unix = _manifest_epoch_unix(records)
+    first_resources_unix = next(
+        (
+            r["time_unix"]
+            for r in records
+            if r.get("kind") == "resources"
+            and isinstance(r.get("time_unix"), (int, float))
+        ),
+        None,
+    )
+
+    for record in records:
+        kind = record.get("kind")
+        if kind == "span":
+            t, dur = record.get("t"), record.get("dur_s")
+            if not isinstance(t, (int, float)) or not isinstance(
+                dur, (int, float)
+            ):
+                continue
+            path = str(record.get("path") or record.get("name") or "?")
+            args = {
+                k: v
+                for k, v in record.items()
+                if k not in ("kind", "name", "path", "t", "dur_s")
+            }
+            events.append(
+                {
+                    "ph": "X",
+                    "pid": _PID,
+                    "tid": tid_for(path),
+                    "name": str(record.get("name", path)),
+                    "cat": "span",
+                    "ts": round(t * 1e6, 1),
+                    "dur": round(dur * 1e6, 1),
+                    **({"args": args} if args else {}),
+                }
+            )
+        elif kind == "engine":
+            t = record.get("t")
+            if not isinstance(t, (int, float)):
+                continue
+            series = {
+                k: record[k]
+                for k in _ENGINE_COUNTERS
+                if isinstance(record.get(k), (int, float))
+            }
+            if series:
+                events.append(
+                    {
+                        "ph": "C",
+                        "pid": _PID,
+                        "name": "engine",
+                        "ts": round(t * 1e6, 1),
+                        "args": series,
+                    }
+                )
+        elif kind == "resources":
+            t_unix = record.get("time_unix")
+            if not isinstance(t_unix, (int, float)):
+                continue
+            base = epoch_unix if epoch_unix is not None else first_resources_unix
+            series = {
+                k: record[k]
+                for k in _RESOURCE_COUNTERS
+                if isinstance(record.get(k), (int, float))
+            }
+            if series:
+                events.append(
+                    {
+                        "ph": "C",
+                        "pid": _PID,
+                        "name": "resources",
+                        "ts": round(max(t_unix - (base or t_unix), 0.0) * 1e6, 1),
+                        "args": series,
+                    }
+                )
+    return events
+
+
+def write_trace(records: list[dict], out_path: str | Path) -> int:
+    """Write the Chrome trace JSON; returns the number of non-metadata
+    events exported (0 = the stream had no spans/counters to export)."""
+    events = trace_events(records)
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    Path(out_path).write_text(json.dumps(payload) + "\n")
+    return sum(1 for e in events if e.get("ph") != "M")
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Standalone entry: ``python -m ...telemetry.trace in.jsonl out.json``
+    (the CLI route is ``bpe-tpu report in.jsonl --trace out.json``)."""
+    from bpe_transformer_tpu.telemetry.report import load_records
+
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 2:
+        print("usage: trace METRICS_JSONL OUT_JSON", file=sys.stderr)
+        return 2
+    records = load_records(argv[0])
+    if not records:
+        print(f"trace: no readable records in {argv[0]}", file=sys.stderr)
+        return 1
+    n = write_trace(records, argv[1])
+    print(f"wrote {n} trace events -> {argv[1]} (open in Perfetto / chrome://tracing)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

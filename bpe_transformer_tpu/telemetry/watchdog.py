@@ -201,6 +201,11 @@ class Watchdog:
         JSONL even when ``"raise"`` tears the loop down next).
         """
         self.nonfinite_events += 1
+        # Dynamics localization (telemetry.dynamics): when the loop stamped
+        # the offending tensor path onto the record, the event and the
+        # raised error name it — "NaN in params/layers.3.ffn.w1", not just
+        # "loss is NaN".
+        path = record.get("nonfinite_path")
         if self._telemetry is not None:
             self._telemetry.event(
                 "nonfinite",
@@ -208,11 +213,16 @@ class Watchdog:
                 fields=fields or [],
                 policy=self.policy,
                 record=record,
+                **({"path": path} if path else {}),
             )
         if self.policy == "raise":
+            detail = ", ".join(fields) if fields else (
+                "dynamics localization" if path else "loss"
+            )
             raise NonFiniteError(
                 f"non-finite training state at step {record.get('step')}"
-                f" ({', '.join(fields) if fields else 'loss'});"
-                " state dumped to the telemetry stream",
+                f" ({detail})"
+                + (f", localized to {path}" if path else "")
+                + "; state dumped to the telemetry stream",
                 record=record,
             )

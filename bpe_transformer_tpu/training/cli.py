@@ -151,6 +151,7 @@ def cmd_train(args) -> int:
         metrics_jsonl=args.metrics_jsonl,
         wandb_project=args.wandb_project,
         health_stats=args.health_stats,
+        dynamics_every=args.dynamics_every,
         watchdog=args.watchdog,
         watchdog_factor=args.watchdog_factor,
         watchdog_policy=args.watchdog_policy,
@@ -351,6 +352,8 @@ def cmd_report(args) -> int:
         forwarded += ["--compare", args.compare]
     if args.baseline:
         forwarded += ["--baseline", args.baseline]
+    if args.trace:
+        forwarded += ["--trace", args.trace]
     forwarded += ["--threshold-pct", str(args.threshold_pct)]
     for pair in args.threshold or []:
         forwarded += ["--threshold", pair]
@@ -427,6 +430,18 @@ def build_parser() -> argparse.ArgumentParser:
         "(non-finite loss/grad/param detection, per-layer-group grad/param "
         "norms, MoE expert balance) and log them every --log-every; opt-in "
         "— the default step is unchanged",
+    )
+    p.add_argument(
+        "--dynamics-every",
+        type=int,
+        default=0,
+        metavar="N",
+        help='emit kind="dynamics" training-introspection records every N '
+        "steps (0 = off; N must be a multiple of --log-every): per-layer "
+        "grad/param norms, update-to-param ratios, activation RMS/absmax + "
+        "attention entropy, and NaN/Inf localization by tensor path — "
+        "computed inside the jitted step and fetched with the existing "
+        "log sync, zero extra host syncs",
     )
     p.add_argument(
         "--watchdog",
@@ -599,6 +614,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--baseline", default=None, metavar="BENCH_JSON",
                    help="bench capture JSON (tpu_capture_*.json / "
                    "BENCH_*.json) as the comparison baseline")
+    p.add_argument("--trace", default=None, metavar="OUT_JSON",
+                   help="export the span stream as Chrome trace-event "
+                   "JSON (Perfetto / chrome://tracing); engine/resources "
+                   "records become counter tracks")
     p.add_argument("--threshold-pct", type=float, default=5.0,
                    help="default regression threshold in percent")
     p.add_argument("--threshold", action="append", default=[],

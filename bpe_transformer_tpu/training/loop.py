@@ -38,6 +38,8 @@ from bpe_transformer_tpu.telemetry import (
     StepTimer,
     Telemetry,
     Watchdog,
+    dynamics_record,
+    flatten_dynamics,
     flatten_health,
     install_compile_counter,
     nonfinite_fields,
@@ -67,6 +69,15 @@ class LoopConfig:
     #: default step is byte-identical to before.  Not supported with
     #: parallel="sp"/"pp" (those strategies build their own update bodies).
     health_stats: bool = False
+    #: Emit kind="dynamics" training-introspection records every N steps
+    #: (0 = off; telemetry.dynamics): per-layer grad/param norms,
+    #: update-to-param ratios, per-block activation RMS/absmax + attention
+    #: entropy, and per-tensor non-finite localization.  Everything is
+    #: computed INSIDE the jitted step and fetched with the existing
+    #: log_every sync — zero additional device→host transfers — so N must
+    #: be a multiple of log_every.  Not supported with parallel="sp"/"pp"
+    #: (same constraint as health_stats).
+    dynamics_every: int = 0
     #: Enable the telemetry watchdog: a background thread flags hung steps
     #: (no metric sync within watchdog_factor x the trailing median step
     #: time), and non-finite states detected at a log boundary follow
@@ -150,6 +161,23 @@ def train(
             "(sp/pp build their own update bodies); drop --health-stats or "
             "use a dp/GSPMD strategy"
         )
+    if loop.dynamics_every < 0:
+        raise ValueError(
+            f"dynamics_every must be >= 0, got {loop.dynamics_every}"
+        )
+    if loop.dynamics_every:
+        if loop.parallel in ("sp", "pp"):
+            raise ValueError(
+                f'dynamics_every is not supported with parallel='
+                f'"{loop.parallel}" (sp/pp build their own update bodies); '
+                "drop --dynamics-every or use a dp/GSPMD strategy"
+            )
+        if loop.dynamics_every % loop.log_every:
+            raise ValueError(
+                f"dynamics_every={loop.dynamics_every} must be a multiple "
+                f"of log_every={loop.log_every} — dynamics records ride "
+                "the log-cadence metric fetch (no extra host syncs)"
+            )
     if loop.watchdog and loop.watchdog_policy not in Watchdog.POLICIES:
         # Validate BEFORE any sink opens: a bad policy must not leak an open
         # JSONL handle or an unfinished wandb run.
@@ -325,6 +353,7 @@ def train(
             lambda b: shard_batch(b, mesh),
         )
     health = loop.health_stats
+    dynamics = loop.dynamics_every > 0
     if mesh is None:
         def build_step(n=stride):
             if n > 1:
@@ -332,16 +361,20 @@ def train(
                     make_scanned_train_step,
                 )
 
-                return make_scanned_train_step(model_config, hparams, n, health=health)
+                return make_scanned_train_step(
+                    model_config, hparams, n, health=health, dynamics=dynamics
+                )
             if accum > 1:
                 from bpe_transformer_tpu.training.train_step import (
                     make_grad_accum_train_step,
                 )
 
                 return make_grad_accum_train_step(
-                    model_config, hparams, accum, health=health
+                    model_config, hparams, accum, health=health, dynamics=dynamics
                 )
-            return make_train_step(model_config, hparams, health=health)
+            return make_train_step(
+                model_config, hparams, health=health, dynamics=dynamics
+            )
 
         step_fn = build_step()
         place = place_plain = lambda b: b
@@ -349,7 +382,7 @@ def train(
         def build_step(n=stride):
             return make_dp_train_step(
                 model_config, hparams, mesh, accum_steps=accum, inner_steps=n,
-                health=health,
+                health=health, dynamics=dynamics,
             )
 
         step_fn = build_step()
@@ -394,6 +427,7 @@ def train(
                 accum_steps=accum,
                 inner_steps=n,
                 health=health,
+                dynamics=dynamics,
             )
 
         step_fn = build_step()
@@ -578,6 +612,11 @@ def train(
             is_last = iteration == loop.steps
             if iteration % loop.log_every == 0 or is_last:
                 fetched = jax.device_get(metrics)  # the device sync point
+                dyn_flat = None
+                if dynamics:
+                    # Already on host — the dynamics pytree rode the fetch
+                    # above; flattening costs no device round-trip.
+                    dyn_flat = flatten_dynamics(fetched["dynamics"])
                 last_loss = float(fetched["loss"])
                 rates = timer.snapshot()
                 real_steps = iteration - prev_sync_iteration - excluded_steps
@@ -598,12 +637,21 @@ def train(
                     record["mfu"] = rates["mfu"]
                 if loop.health_stats:
                     record.update(flatten_health(fetched["health"]))
+                if dyn_flat and "first_nonfinite" in dyn_flat:
+                    # Localization rides the step record so the watchdog's
+                    # nonfinite event (and NonFiniteError message) names
+                    # the offending tensor path, not just "loss is NaN".
+                    record["nonfinite_path"] = dyn_flat["first_nonfinite"]
                 history.append(record)
                 # Through the narrator, not sinks.log directly: emit() holds
                 # the telemetry lock (the watchdog thread writes hang events
                 # through the same JSONL handle) and counts the record for
                 # the footer's record_counts.
                 telemetry.emit(record)
+                if dyn_flat is not None and (
+                    iteration % loop.dynamics_every == 0 or is_last
+                ):
+                    telemetry.emit(dynamics_record(iteration, dyn_flat))
                 # Resource accounting rides the same once-per-log_every
                 # boundary: sample_resources is sync-free (RSS, live-buffer
                 # metadata, device memory_stats, compile counter), so HBM
@@ -620,7 +668,7 @@ def train(
                     # median with a near-zero artifact.
                     wd.beat(step_wall_s if real_steps > 0 else None)
                 bad_fields = nonfinite_fields(record)
-                if bad_fields:
+                if bad_fields or record.get("nonfinite_path"):
                     # Dump-then-policy: the event (with the full record)
                     # reaches the JSONL before "raise" tears the loop down;
                     # without a watchdog the anomaly is recorded and the

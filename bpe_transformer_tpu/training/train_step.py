@@ -36,12 +36,40 @@ class TrainHParams:
     grad_clip_norm: float = 1.0
 
 
-def make_loss_fn(config: ModelConfig, with_aux: bool = False) -> Callable:
+def make_loss_fn(
+    config: ModelConfig, with_aux: bool = False, with_stats: bool = False
+) -> Callable:
     """``with_aux=True`` returns ``(loss, aux)`` instead of the scalar loss,
     where ``aux`` is the raw MoE load-balance loss (0 for dense FFNs) —
     the health-enabled train step exports it as an expert-balance stat
-    (exactly 1.0 at perfectly uniform routing)."""
+    (exactly 1.0 at perfectly uniform routing).
+
+    ``with_stats=True`` (dynamics introspection; supersedes ``with_aux``)
+    returns ``(loss, (aux, act_stats))`` with the per-layer activation
+    statistics from ``forward_hidden_stats`` — same forward, same math,
+    plus cheap in-graph reductions."""
     is_moe = config.ffn_type == "moe"
+
+    if with_stats:
+        from bpe_transformer_tpu.models.transformer import (
+            forward_hidden_stats,
+            lm_head_weight,
+        )
+        from bpe_transformer_tpu.ops.core import head_logits
+        from bpe_transformer_tpu.ops.losses import lm_loss
+
+        def stats_loss_fn(params, x, y):
+            hidden, aux, act_stats = forward_hidden_stats(params, x, config)
+            head_w = lm_head_weight(params, config)
+            if config.loss_chunk_size:
+                loss = lm_loss(hidden, head_w, y, config.loss_chunk_size)
+            else:
+                loss = cross_entropy(head_logits(hidden, head_w), y)
+            if is_moe:
+                loss = loss + config.router_aux_weight * aux
+            return loss, (aux, act_stats)
+
+        return stats_loss_fn
 
     if config.loss_chunk_size:
         from bpe_transformer_tpu.models.transformer import (
@@ -81,11 +109,23 @@ def make_loss_fn(config: ModelConfig, with_aux: bool = False) -> Callable:
     return loss_fn
 
 
+def _reduce_act_stats(act_stats: dict, axis: str) -> dict:
+    """Fold per-shard activation stats to global ones under a mapped mesh
+    axis: means average, absmax maxes, non-finite counts sum."""
+    return {
+        "rms": jax.lax.pmean(act_stats["rms"], axis),
+        "absmax": jax.lax.pmax(act_stats["absmax"], axis),
+        "nonfinite": jax.lax.psum(act_stats["nonfinite"], axis),
+        "attn_entropy": jax.lax.pmean(act_stats["attn_entropy"], axis),
+    }
+
+
 def train_step_fn(
     config: ModelConfig,
     hparams: TrainHParams,
     reduce_axis: str | None = None,
     health: bool = False,
+    dynamics: bool = False,
 ) -> Callable:
     """The un-jitted update body ``(params, opt_state, x, y) ->
     (params, opt_state, metrics)`` shared by every execution mode.
@@ -98,13 +138,26 @@ def train_step_fn(
     non-finite loss/grad/param detection, per-layer-group grad/param norms,
     and (MoE) the raw expert load-balance loss as ``moe_aux``.  All extra
     cost is a few reductions inside the same jitted program — the stats
-    ride the loop's existing once-per-``log_every`` metric fetch."""
+    ride the loop's existing once-per-``log_every`` metric fetch.
+
+    ``dynamics=True`` (opt-in, `telemetry.dynamics`) additionally appends
+    ``metrics["dynamics"]``: per-layer grad/param norms, update-to-param
+    ratios, per-tensor non-finite localization counts, and per-block
+    activation stats tapped from the SAME differentiated forward
+    (``forward_hidden_stats``).  Everything stays on device and rides the
+    same log-cadence fetch — zero extra host syncs."""
     is_moe = config.ffn_type == "moe"
     with_aux = health and is_moe
-    loss_fn = make_loss_fn(config, with_aux=with_aux)
+    loss_fn = make_loss_fn(config, with_aux=with_aux, with_stats=dynamics)
 
     def step(params, opt_state: AdamWState, x, y):
-        if with_aux:
+        act_stats = None
+        if dynamics:
+            (loss, (aux, act_stats)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, x, y)
+            moe_aux = aux if with_aux else None
+        elif with_aux:
             (loss, moe_aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, x, y
             )
@@ -118,6 +171,11 @@ def train_step_fn(
                 # The exported expert-balance stat must describe GLOBAL
                 # routing, not shard 0's micro-batch.
                 moe_aux = jax.lax.pmean(moe_aux, reduce_axis)
+            if act_stats is not None:
+                act_stats = _reduce_act_stats(act_stats, reduce_axis)
+        # Dynamics reports the TRUE (pre-clip, post-pmean) gradient
+        # magnitudes; the optimizer consumes the clipped tree below.
+        raw_grads = grads
         grads, grad_norm = clip_by_global_norm(grads, hparams.grad_clip_norm)
         lr = cosine_schedule_jax(
             opt_state.step,
@@ -126,7 +184,7 @@ def train_step_fn(
             hparams.warmup_iters,
             hparams.cosine_cycle_iters,
         )
-        params, opt_state = adamw_update(
+        new_params, opt_state = adamw_update(
             params,
             grads,
             opt_state,
@@ -145,20 +203,32 @@ def train_step_fn(
 
             # Post-update params: optimizer-produced non-finites are caught
             # the same step they appear (before they can be checkpointed).
-            metrics["health"] = health_metrics(loss, grads, params)
+            metrics["health"] = health_metrics(loss, grads, new_params)
             if moe_aux is not None:
                 metrics["health"]["moe_aux"] = moe_aux.astype(jnp.float32)
-        return params, opt_state, metrics
+        if dynamics:
+            from bpe_transformer_tpu.telemetry.dynamics import dynamics_metrics
+
+            metrics["dynamics"] = dynamics_metrics(
+                raw_grads, params, new_params, act_stats
+            )
+        return new_params, opt_state, metrics
 
     return step
 
 
 def make_train_step(
-    config: ModelConfig, hparams: TrainHParams, health: bool = False
+    config: ModelConfig,
+    hparams: TrainHParams,
+    health: bool = False,
+    dynamics: bool = False,
 ) -> Callable:
     """Single-device jitted train step with buffer donation (params and opt
     state update in place in HBM)."""
-    return jax.jit(train_step_fn(config, hparams, health=health), donate_argnums=(0, 1))
+    return jax.jit(
+        train_step_fn(config, hparams, health=health, dynamics=dynamics),
+        donate_argnums=(0, 1),
+    )
 
 
 def accumulate_grads(grad_fn, params, xs, ys, accum_steps: int, context: str = ""):
@@ -204,6 +274,7 @@ def grad_accum_step_fn(
     accum_steps: int,
     reduce_axis: str | None = None,
     health: bool = False,
+    dynamics: bool = False,
 ) -> Callable:
     """Un-jitted accumulation body: one optimizer update from
     ``accum_steps`` microbatch gradients.
@@ -223,6 +294,11 @@ def grad_accum_step_fn(
     :func:`train_step_fn`; the MoE ``moe_aux`` export is plain-step-only —
     the accumulation scan carries loss+grads, not per-microbatch aux).
 
+    ``dynamics=True`` appends ``metrics["dynamics"]`` computed from the
+    ACCUMULATED gradients and the update (per-layer norms, update ratios,
+    non-finite localization); activation stats are absent on this path —
+    the scan carries loss+grads, not per-microbatch activation taps.
+
     Signature: ``(params, opt_state, xs, ys) -> (params, opt_state,
     metrics)`` with ``xs/ys: (accum_steps, micro_batch, seq)``.
     """
@@ -238,6 +314,7 @@ def grad_accum_step_fn(
             grads = jax.lax.pmean(grads, reduce_axis)
             loss = jax.lax.pmean(loss, reduce_axis)
 
+        raw_grads = grads
         grads, grad_norm = clip_by_global_norm(grads, hparams.grad_clip_norm)
         lr = cosine_schedule_jax(
             opt_state.step,
@@ -246,7 +323,7 @@ def grad_accum_step_fn(
             hparams.warmup_iters,
             hparams.cosine_cycle_iters,
         )
-        params, opt_state = adamw_update(
+        new_params, opt_state = adamw_update(
             params,
             grads,
             opt_state,
@@ -263,18 +340,30 @@ def grad_accum_step_fn(
         if health:
             from bpe_transformer_tpu.telemetry.health import health_metrics
 
-            metrics["health"] = health_metrics(loss, grads, params)
-        return params, opt_state, metrics
+            metrics["health"] = health_metrics(loss, grads, new_params)
+        if dynamics:
+            from bpe_transformer_tpu.telemetry.dynamics import dynamics_metrics
+
+            metrics["dynamics"] = dynamics_metrics(
+                raw_grads, params, new_params, None
+            )
+        return new_params, opt_state, metrics
 
     return step
 
 
 def make_grad_accum_train_step(
-    config: ModelConfig, hparams: TrainHParams, accum_steps: int, health: bool = False
+    config: ModelConfig,
+    hparams: TrainHParams,
+    accum_steps: int,
+    health: bool = False,
+    dynamics: bool = False,
 ) -> Callable:
     """Single-device jitted wrapper of :func:`grad_accum_step_fn`."""
     return jax.jit(
-        grad_accum_step_fn(config, hparams, accum_steps, health=health),
+        grad_accum_step_fn(
+            config, hparams, accum_steps, health=health, dynamics=dynamics
+        ),
         donate_argnums=(0, 1),
     )
 
@@ -286,6 +375,7 @@ def scanned_step_fn(
     reduce_axis: str | None = None,
     body: Callable | None = None,
     health: bool = False,
+    dynamics: bool = False,
 ) -> Callable:
     """Un-jitted body: ``inner_steps`` optimizer updates via ``lax.scan``.
 
@@ -307,7 +397,9 @@ def scanned_step_fn(
     if inner_steps < 1:
         raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
     if body is None:
-        body = train_step_fn(config, hparams, reduce_axis, health=health)
+        body = train_step_fn(
+            config, hparams, reduce_axis, health=health, dynamics=dynamics
+        )
 
     def multi(params, opt_state: AdamWState, xs, ys):
         def scan_body(carry, batch):
@@ -325,11 +417,17 @@ def scanned_step_fn(
 
 
 def make_scanned_train_step(
-    config: ModelConfig, hparams: TrainHParams, inner_steps: int, health: bool = False
+    config: ModelConfig,
+    hparams: TrainHParams,
+    inner_steps: int,
+    health: bool = False,
+    dynamics: bool = False,
 ) -> Callable:
     """Single-device jitted wrapper of :func:`scanned_step_fn`."""
     return jax.jit(
-        scanned_step_fn(config, hparams, inner_steps, health=health),
+        scanned_step_fn(
+            config, hparams, inner_steps, health=health, dynamics=dynamics
+        ),
         donate_argnums=(0, 1),
     )
 

@@ -955,6 +955,175 @@ def test_nan_injection_skip_policy_keeps_training(tmp_path, byte_data):
     assert footer["watchdog_nonfinite_events"] >= 1
 
 
+# ----------------------------------------------- dynamics introspection
+
+
+def test_dynamics_paths_labels_and_localization():
+    """Pure helpers: tensor paths, layer labels, and the params -> act ->
+    grads localization priority in flatten_dynamics."""
+    import jax
+
+    from bpe_transformer_tpu.telemetry.dynamics import (
+        dynamics_metrics,
+        flatten_dynamics,
+        layer_label,
+        per_layer_norms,
+    )
+
+    assert layer_label("layers.3.attn.q_proj") == "layers.3"
+    assert layer_label("token_embeddings") == "token_embeddings"
+
+    params = {
+        "layers": [
+            {"ffn": {"w1": jnp.ones((2, 2))}},
+            {"ffn": {"w1": jnp.full((2, 2), float("nan"))}},
+        ],
+        "lm_head": jnp.ones((3,)),
+    }
+    norms = per_layer_norms(params)
+    assert set(norms) == {"layers.0", "layers.1", "lm_head"}
+    assert norms["layers.0"] == pytest.approx(2.0)
+
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    clean = jax.tree_util.tree_map(jnp.ones_like, params)
+    dyn = jax.device_get(dynamics_metrics(grads, params, clean))
+    flat = flatten_dynamics(dyn)
+    # The NaN lives in the step's INPUT params; only nonzero counts emit.
+    assert flat["nonfinite_params/layers.1.ffn.w1"] == 4
+    assert flat["first_nonfinite"] == "params/layers.1.ffn.w1"
+    assert not any(k.startswith("nonfinite_grads/") for k in flat)
+    assert flat["update_ratio/layers.0"] >= 0
+
+    # Clean trees carry no localization keys at all.
+    flat_clean = flatten_dynamics(
+        jax.device_get(dynamics_metrics(grads, clean, clean))
+    )
+    assert "first_nonfinite" not in flat_clean
+    assert not any(k.startswith("nonfinite_") for k in flat_clean)
+
+    # Activation localization outranks gradients (the finite-params,
+    # overflowing-activation scenario) but not params.
+    act = {
+        "rms": jnp.ones((2,)),
+        "absmax": jnp.ones((2,)),
+        "nonfinite": jnp.array([0, 7], jnp.int32),
+        "attn_entropy": jnp.ones((2,)),
+    }
+    bad_grads = jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, float("inf")), params
+    )
+    flat_act = flatten_dynamics(
+        jax.device_get(dynamics_metrics(bad_grads, clean, clean, act))
+    )
+    assert flat_act["first_nonfinite"] == "act/layers.1"
+    assert flat_act["act_nonfinite/layers.1"] == 7
+    assert flat_act["attn_entropy/layers.0"] == pytest.approx(1.0)
+
+
+def test_dynamics_enabled_train_step_exports_per_layer_stats():
+    import jax
+
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.telemetry.dynamics import flatten_dynamics
+    from bpe_transformer_tpu.training.train_step import (
+        TrainHParams,
+        make_train_step,
+    )
+
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, TINY.vocab_size, size=(4, TINY.context_length))
+    x, y = jnp.asarray(ids), jnp.asarray(np.roll(ids, -1, axis=1))
+
+    # Default step: no dynamics key, metrics unchanged.
+    _, _, metrics = make_train_step(TINY, TrainHParams())(
+        params, adamw_init(params), x, y
+    )
+    assert "dynamics" not in metrics
+
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    step = make_train_step(TINY, TrainHParams(), dynamics=True)
+    _, _, metrics = step(params, adamw_init(params), x, y)
+    flat = flatten_dynamics(jax.device_get(metrics["dynamics"]))
+    for layer in ("layers.0", "layers.1", "token_embeddings", "lm_head"):
+        assert flat[f"grad_norm/{layer}"] > 0
+        assert flat[f"param_norm/{layer}"] > 0
+        assert flat[f"update_ratio/{layer}"] >= 0
+    for i in range(TINY.num_layers):
+        assert math.isfinite(flat[f"act_rms/layers.{i}"])
+        assert flat[f"act_absmax/layers.{i}"] > 0
+        # Causal softmax entropy over a 16-token context: strictly inside
+        # (0, log 16].
+        assert 0 < flat[f"attn_entropy/layers.{i}"] <= math.log(16) + 1e-5
+    assert "first_nonfinite" not in flat  # clean run
+
+
+def test_dynamics_rides_scanned_and_grad_accum_variants():
+    import jax
+
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.telemetry.dynamics import flatten_dynamics
+    from bpe_transformer_tpu.training.train_step import (
+        TrainHParams,
+        make_grad_accum_train_step,
+        make_scanned_train_step,
+    )
+
+    hp = TrainHParams(warmup_iters=0)
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, TINY.vocab_size, size=(2, 4, TINY.context_length))
+    xs, ys = jnp.asarray(ids), jnp.asarray(np.roll(ids, -1, axis=2))
+
+    step = make_scanned_train_step(TINY, hp, 2, dynamics=True)
+    _, _, metrics = step(params, adamw_init(params), xs, ys)
+    flat = flatten_dynamics(jax.device_get(metrics["dynamics"]))
+    assert flat["grad_norm/layers.1"] > 0
+    assert flat["attn_entropy/layers.0"] > 0  # act taps ride the scan body
+
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    step = make_grad_accum_train_step(TINY, hp, 2, dynamics=True)
+    _, _, metrics = step(params, adamw_init(params), xs, ys)
+    flat = flatten_dynamics(jax.device_get(metrics["dynamics"]))
+    assert flat["grad_norm/layers.1"] > 0
+    assert flat["update_ratio/layers.0"] > 0
+    # The accumulation scan carries loss+grads, not activation taps.
+    assert not any(k.startswith(("act_rms/", "attn_entropy/")) for k in flat)
+
+
+def test_dynamics_record_validates_against_schema():
+    from bpe_transformer_tpu.telemetry import validate_record
+    from bpe_transformer_tpu.telemetry.dynamics import dynamics_record
+
+    record = dynamics_record(
+        50, {"grad_norm/layers.0": 0.5, "first_nonfinite": "params/x"}
+    )
+    assert record["kind"] == "dynamics" and record["step"] == 50
+    assert validate_record(record) == []
+    assert validate_record({"kind": "dynamics"})  # step is required
+
+
+def test_dynamics_every_validation():
+    from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+
+    data = np.zeros(10_000, np.uint16)
+    loop = LoopConfig(steps=2, batch_size=8, log_every=2, dynamics_every=3)
+    with pytest.raises(ValueError, match="multiple of log_every"):
+        train(TINY, TrainHParams(**HP), loop, data)
+    loop = LoopConfig(
+        steps=2, batch_size=8, parallel="sp", dynamics_every=2, log_every=2
+    )
+    with pytest.raises(ValueError, match="dynamics_every"):
+        train(TINY, TrainHParams(**HP), loop, data)
+    with pytest.raises(ValueError, match=">= 0"):
+        train(
+            TINY, TrainHParams(**HP),
+            LoopConfig(steps=2, batch_size=8, dynamics_every=-1), data,
+        )
+
+
 def test_health_stats_rejected_for_sp_and_pp():
     from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
 
@@ -976,3 +1145,245 @@ def test_bad_watchdog_policy_rejected_before_sinks_open(tmp_path):
     with pytest.raises(ValueError, match="watchdog_policy"):
         train(TINY, TrainHParams(**HP), loop, np.zeros(10_000, np.uint16))
     assert not jsonl.exists()
+
+
+# ------------------------------------------- dynamics: loop integration
+
+
+def _counting_train(monkeypatch, byte_data, tmp_path, dynamics_every):
+    """Run a short training with jax.device_get / block_until_ready call
+    counting; returns (records, counts)."""
+    import jax
+
+    from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+
+    counts = {"device_get": 0, "block_until_ready": 0}
+    real_get, real_block = jax.device_get, jax.block_until_ready
+
+    def counting_get(x):
+        counts["device_get"] += 1
+        return real_get(x)
+
+    def counting_block(x):
+        counts["block_until_ready"] += 1
+        return real_block(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    monkeypatch.setattr(jax, "block_until_ready", counting_block)
+    jsonl = tmp_path / f"dyn_{dynamics_every}.jsonl"
+    loop = LoopConfig(
+        steps=8,
+        batch_size=8,
+        log_every=4,
+        eval_every=100,
+        checkpoint_every=100,
+        metrics_jsonl=str(jsonl),
+        dynamics_every=dynamics_every,
+    )
+    train(TINY, TrainHParams(**HP), loop, byte_data, log_fn=lambda *_: None)
+    monkeypatch.setattr(jax, "device_get", real_get)
+    monkeypatch.setattr(jax, "block_until_ready", real_block)
+    return load_records(jsonl), counts
+
+
+def test_dynamics_loop_emits_records_at_zero_extra_fetches(
+    monkeypatch, tmp_path, byte_data
+):
+    """ACCEPTANCE: with --dynamics-every the stream gains kind="dynamics"
+    records at the dynamics cadence — and the number of device fetches /
+    sync barriers is IDENTICAL to a run with the flag off (the dynamics
+    pytree rides the existing log-cadence fetch)."""
+    from bpe_transformer_tpu.telemetry import validate_record
+
+    records_off, counts_off = _counting_train(
+        monkeypatch, byte_data, tmp_path, dynamics_every=0
+    )
+    records_on, counts_on = _counting_train(
+        monkeypatch, byte_data, tmp_path, dynamics_every=4
+    )
+    assert counts_on == counts_off  # zero additional device→host syncs
+
+    dynamics = [r for r in records_on if r.get("kind") == "dynamics"]
+    assert [r["step"] for r in dynamics] == [4, 8]
+    for r in dynamics:
+        assert validate_record(r) == []
+        assert r["grad_norm/layers.0"] > 0
+        assert r["attn_entropy/layers.1"] > 0
+        assert "first_nonfinite" not in r  # clean run
+
+    # Flag off: no dynamics records, and the step records carry no
+    # dynamics-derived keys — the schema is byte-identical to before.
+    assert not [r for r in records_off if r.get("kind") == "dynamics"]
+    steps_off = [r for r in records_off if "kind" not in r and "loss" in r]
+    dyn_prefixes = (
+        "update_ratio/", "act_rms/", "act_absmax/", "attn_entropy/",
+        "nonfinite_params/", "nonfinite_grads/", "act_nonfinite/",
+    )
+    for r in steps_off:
+        assert not any(k.startswith(dyn_prefixes) for k in r)
+        assert "nonfinite_path" not in r
+
+
+def test_dynamics_localizes_nan_seeded_layer(tmp_path, byte_data):
+    """ACCEPTANCE: a run whose params are seeded with a NaN in layer 1's
+    ffn.w1 produces a watchdog nonfinite event AND a report callout naming
+    that tensor path — the documented forensic workflow (resume from a
+    checkpoint at --dynamics-every 1 --log-every 1)."""
+    import jax
+
+    from bpe_transformer_tpu.checkpointing import save_checkpoint
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    w1 = np.asarray(params["layers"][1]["ffn"]["w1"]).copy()
+    w1[0, 0] = np.nan
+    params["layers"][1]["ffn"]["w1"] = jnp.asarray(w1)
+    ckpt = tmp_path / "nan.ckpt"
+    save_checkpoint(ckpt, params=params, opt_state=adamw_init(params), iteration=0)
+
+    jsonl = tmp_path / "nan.jsonl"
+    loop = LoopConfig(
+        steps=4,
+        batch_size=8,
+        log_every=1,
+        eval_every=100,
+        checkpoint_every=100,
+        metrics_jsonl=str(jsonl),
+        dynamics_every=1,
+        watchdog=True,
+        watchdog_policy="raise",
+    )
+    with pytest.raises(NonFiniteError, match=r"params/layers\.1\.ffn\.w1"):
+        train(
+            TINY, TrainHParams(**HP), loop, byte_data,
+            resume_from=ckpt, log_fn=lambda *_: None,
+        )
+    records = load_records(jsonl)
+    event = next(
+        r for r in records if r.get("kind") == "event" and r["name"] == "nonfinite"
+    )
+    assert event["path"] == "params/layers.1.ffn.w1"
+    dynamics = [r for r in records if r.get("kind") == "dynamics"]
+    assert dynamics[0]["first_nonfinite"] == "params/layers.1.ffn.w1"
+    assert dynamics[0]["nonfinite_params/layers.1.ffn.w1"] == 1
+    text = render_report(records)
+    assert "localized to params/layers.1.ffn.w1" in text
+
+
+# ------------------------------------- dynamics: fixture, report, monitor
+
+
+def test_report_dynamics_fixture_pins_section_and_compare(capsys):
+    """The committed dynamics_tiny.jsonl pins the report Dynamics section
+    (per-layer table + localization callout) and still feeds the --compare
+    gate; a stream with NO dynamics records renders no section and exits
+    cleanly."""
+    from bpe_transformer_tpu.telemetry.report import main as report_main
+
+    fixture = str(FIXTURES / "dynamics_tiny.jsonl")
+    assert report_main([fixture]) == 0
+    out = capsys.readouterr().out
+    assert "== dynamics (2 records, steps 50..100) ==" in out
+    assert "layers.0" in out and "layers.1" in out
+    assert "! first non-finite: params/layers.1.ffn.w1 at step 100" in out
+    assert "nonfinite event at step 100 localized to params/layers.1.ffn.w1" in out
+
+    # Self-compare: shared metrics, zero delta, exit 0.
+    assert report_main([fixture, "--compare", fixture]) == 0
+    assert "no regressions" in capsys.readouterr().out
+
+    # A dynamics-free stream: clean exit, no Dynamics section.
+    plain = str(FIXTURES / "telemetry_tiny.jsonl")
+    assert report_main([plain]) == 0
+    assert "== dynamics" not in capsys.readouterr().out
+
+
+def test_monitor_once_renders_dynamics_table(tmp_path):
+    """Satellite: `bpe-tpu monitor <dynamics stream> --once` renders the
+    per-layer table without jax importable."""
+    import subprocess
+    import sys as _sys
+
+    repo = Path(__file__).resolve().parent.parent
+    fixture = repo / "tests" / "fixtures" / "dynamics_tiny.jsonl"
+    proc = subprocess.run(
+        [
+            _sys.executable, "-c",
+            "import sys; sys.modules['jax'] = None\n"
+            "from bpe_transformer_tpu.telemetry.monitor import main\n"
+            f"sys.exit(main([{str(fixture)!r}, '--once']))",
+        ],
+        capture_output=True, text=True, timeout=120,
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": str(repo)},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "per-layer introspection (step 100)" in proc.stdout
+    assert "layers.0" in proc.stdout and "token_embeddings" in proc.stdout
+    assert "nonfinite params/layers.1.ffn.w1" in proc.stdout or "anomalies" in proc.stdout
+
+
+# --------------------------------------------------- chrome trace export
+
+
+def test_trace_events_spans_and_counters():
+    from bpe_transformer_tpu.telemetry.trace import trace_events
+
+    records = [
+        {"kind": "manifest", "run_kind": "train",
+         "time_utc": "2026-08-03T00:00:00+00:00"},
+        {"kind": "span", "name": "setup", "path": "setup", "t": 0.0,
+         "dur_s": 1.0},
+        {"kind": "span", "name": "resume", "path": "setup/resume", "t": 0.2,
+         "dur_s": 0.5, "step": 3},
+        {"kind": "engine", "t": 2.0, "active_slots": 3, "queue_depth": 1,
+         "tokens_per_sec": 500.0, "tokens_total": 10, "ticks": 5,
+         "requests_finished": 2, "compiled_programs": 4},
+        {"kind": "resources", "time_unix": 1785542402.5,
+         "host_rss_bytes": 2**30, "live_buffer_bytes": None,
+         "compile_events": 7, "hbm_bytes_in_use": None,
+         "hbm_peak_bytes_in_use": None, "hbm_bytes_limit": None},
+    ]
+    events = trace_events(records)
+    spans = [e for e in events if e["ph"] == "X"]
+    assert [e["name"] for e in spans] == ["setup", "resume"]
+    # Distinct paths get distinct named lanes; attrs ride through as args.
+    assert spans[0]["tid"] != spans[1]["tid"]
+    assert spans[1]["args"] == {"step": 3}
+    assert spans[1]["ts"] == pytest.approx(0.2e6) and spans[1]["dur"] == pytest.approx(0.5e6)
+    names = {
+        e["args"]["name"]
+        for e in events
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert {"setup", "setup/resume"} <= names
+
+    counters = {e["name"]: e for e in events if e["ph"] == "C"}
+    assert counters["engine"]["args"]["tokens_per_sec"] == 500.0
+    assert counters["engine"]["ts"] == pytest.approx(2e6)
+    # resources re-based against the manifest's time_utc: the fixture
+    # sample is 2.5 s after the 2026-08-03T00:00:00+00:00 epoch... which is
+    # seconds-since-epoch arithmetic — just pin non-negativity and args.
+    assert counters["resources"]["ts"] >= 0
+    assert counters["resources"]["args"] == {
+        "host_rss_bytes": 2**30, "compile_events": 7,
+    }
+
+
+def test_report_trace_cli_writes_chrome_trace(tmp_path, capsys):
+    from bpe_transformer_tpu.telemetry.report import main as report_main
+
+    fixture = str(FIXTURES / "dynamics_tiny.jsonl")
+    out = tmp_path / "trace.json"
+    assert report_main([fixture, "--trace", str(out)]) == 0
+    assert "wrote" in capsys.readouterr().out
+    payload = json.loads(out.read_text())
+    assert payload["traceEvents"]
+    kinds = {e["ph"] for e in payload["traceEvents"]}
+    assert "X" in kinds and "C" in kinds
+
+    # --trace on a bench capture (not a stream) is a crisp usage error.
+    capture = tmp_path / "cap.json"
+    capture.write_text(json.dumps({"metric": "tok/s", "value": 1.0}))
+    assert report_main([str(capture), "--trace", str(tmp_path / "t.json")]) == 2

@@ -14,6 +14,10 @@ Three checks, all static/jax-free (wired into tier-1 via
 3. **Fixture validation** — every record in the committed
    ``tests/fixtures/*.jsonl`` streams must validate against its kind's
    required-field schema (the fixtures are the pinned wire format).
+4. **Trace-exporter assumptions** — every field ``telemetry/trace.py``
+   reads (its ``TRACE_ASSUMPTIONS``) must be a required field of the
+   corresponding kind, so a schema change cannot silently break the
+   Chrome trace export.
 
 Exit 0 when clean; 1 with one line per violation otherwise.
 """
@@ -112,8 +116,36 @@ def check_fixtures() -> list[str]:
     return problems
 
 
+def check_trace_assumptions() -> list[str]:
+    from bpe_transformer_tpu.telemetry.trace import TRACE_ASSUMPTIONS
+
+    problems = []
+    for kind, fields in sorted(TRACE_ASSUMPTIONS.items()):
+        schema = RECORD_SCHEMAS.get(kind)
+        if schema is None:
+            problems.append(
+                f"trace exporter assumes record kind {kind!r}, which is "
+                "not in the schema registry"
+            )
+            continue
+        missing = sorted(fields - schema)
+        if missing:
+            problems.append(
+                f"trace exporter reads {kind!r} field(s) "
+                f"{', '.join(missing)} that the schema does not require — "
+                "align telemetry/trace.py TRACE_ASSUMPTIONS with "
+                "telemetry/schema.py"
+            )
+    return problems
+
+
 def main() -> int:
-    problems = check_source() + check_docs() + check_fixtures()
+    problems = (
+        check_source()
+        + check_docs()
+        + check_fixtures()
+        + check_trace_assumptions()
+    )
     for problem in problems:
         print(f"telemetry-schema: {problem}", file=sys.stderr)
     if not problems:
