@@ -293,6 +293,14 @@ run_job - 300 "$OUT/bench_dynamics.jsonl" \
   env BENCH_DYNAMICS=1 BENCH_NO_CPU_FALLBACK=1 BENCH_DRIVER_FLAG=0 \
   python bench.py
 
+# Kill-resume smoke (resilience layer, PR 5): SIGTERM a short training
+# run midway on the chip and assert the preemption exit code + emergency
+# checkpoint + clean --resume completion — the recovery paths the CPU
+# chaos suite pins, proven against real TPU runtime behavior (slow
+# SIGTERM delivery, device-buffer teardown) once per queue pass history.
+run_job kill_resume 900 "$OUT/kill_resume.jsonl" \
+  bash benchmarks/kill_resume_smoke.sh
+
 # Multi-worker host tokenization (VERDICT r4 #7) is deliberately NOT a
 # queue job: it needs no TPU, and running it here would hold queue.lock
 # through a ~15-min CPU-only bench while a tunnel window closes.  The

@@ -1,0 +1,39 @@
+"""Shared PEP 562 lazy-attribute helper for jax-deferring packages.
+
+Several subpackages (models/, training/, telemetry/) export symbols whose
+modules import jax at load time, while other exports — and the jax-free CLI
+paths that need them (``verify-checkpoint``, ``report``, ``monitor``, the
+``--supervise`` parent) — must stay importable without initializing an
+accelerator runtime.  Each such ``__init__`` declares a name->submodule map
+and installs::
+
+    __getattr__ = lazy_attrs(__name__, {"train": "loop", ...})
+
+instead of hand-rolling the same resolve-and-cache ``__getattr__`` per
+package.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+
+def lazy_attrs(package: str, mapping: dict[str, str]):
+    """A module ``__getattr__`` resolving each name in ``mapping`` from
+    ``package.<submodule>`` on first access and caching it on the package
+    module (so subsequent accesses skip this hook entirely)."""
+
+    def __getattr__(name: str):
+        submodule = mapping.get(name)
+        if submodule is None:
+            raise AttributeError(
+                f"module {package!r} has no attribute {name!r}"
+            )
+        value = getattr(
+            importlib.import_module(f"{package}.{submodule}"), name
+        )
+        setattr(sys.modules[package], name, value)  # cache: resolve once
+        return value
+
+    return __getattr__

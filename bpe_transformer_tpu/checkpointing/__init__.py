@@ -2,16 +2,20 @@
 
 from bpe_transformer_tpu.checkpointing.checkpoint import (
     AsyncCheckpointer,
+    CheckpointCorruptionError,
     load_checkpoint,
     load_checkpoint_sharded,
+    load_checkpoint_with_fallback,
     save_checkpoint,
     save_checkpoint_sharded,
 )
 
 __all__ = [
     "AsyncCheckpointer",
+    "CheckpointCorruptionError",
     "load_checkpoint",
     "load_checkpoint_sharded",
+    "load_checkpoint_with_fallback",
     "save_checkpoint",
     "save_checkpoint_sharded",
 ]

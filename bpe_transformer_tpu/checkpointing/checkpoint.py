@@ -20,6 +20,14 @@ Two formats:
 
 Both are preemption-safe: writes go to a temp file/directory and rename into
 place.  :func:`load_checkpoint` auto-detects the format.
+
+Integrity (resilience/integrity.py): every save stamps per-file CRC32
+checksums — into a ``checksums`` map of the sharded manifest, or an atomic
+JSON sidecar next to a dense ``.ckpt`` — so corruption is detectable by a
+cheap jax-free scan (``bpe-tpu verify-checkpoint``) instead of an opaque
+unpickling crash.  :func:`load_checkpoint_with_fallback` acts on it:
+quarantine the corrupt snapshot (``.corrupt`` suffix) and fall back to the
+newest prior valid one in the same directory.
 """
 
 from __future__ import annotations
@@ -29,12 +37,23 @@ import os
 import pickle
 import threading
 import shutil
+import sys
 import tempfile
 from pathlib import Path
 from typing import Any, BinaryIO
 
 import jax
 import numpy as np
+
+from bpe_transformer_tpu.resilience.integrity import (
+    Crc32Writer,
+    candidate_snapshots,
+    quarantine,
+    sidecar_path,
+    snapshot_step,
+    verify_checkpoint,
+    write_sidecar,
+)
 
 _FORMAT_VERSION = 1
 _SHARDED_FORMAT_VERSION = 2
@@ -92,8 +111,14 @@ def save_checkpoint(
     fd, tmp_name = tempfile.mkstemp(dir=path.parent, prefix=path.name + ".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            pickle.dump(payload, f)
+            # CRC32 computed in the same pass as the write: the sidecar
+            # (written AFTER the rename, so it never describes a file that
+            # isn't in place yet) makes corruption detectable by a cheap
+            # jax-free scan instead of an unpickling crash at resume.
+            writer = Crc32Writer(f)
+            pickle.dump(payload, writer)
         os.replace(tmp_name, path)
+        write_sidecar(path, writer.crc, writer.size)
     except BaseException:
         if os.path.exists(tmp_name):
             os.unlink(tmp_name)
@@ -223,16 +248,31 @@ def _write_sharded_dir(
         tempfile.mkdtemp(dir=out_dir.parent, prefix=out_dir.name + ".tmp")
     )
     try:
-        with open(tmp_dir / "treedef.pkl", "wb") as f:
-            pickle.dump(treedef, f)
+        # Per-file CRC32s stamped into the manifest (computed during the
+        # write, never by re-reading): the integrity layer verifies shards
+        # without loading them, and resume can fall back past a corrupt
+        # snapshot instead of crashing in np.load.
+        checksums: dict[str, dict] = {}
+
+        def _write_checksummed(fname: str, dump) -> None:
+            with open(tmp_dir / fname, "wb") as f:
+                writer = Crc32Writer(f)
+                dump(writer)
+            checksums[fname] = {"crc32": writer.crc, "size": writer.size}
+
+        _write_checksummed("treedef.pkl", lambda w: pickle.dump(treedef, w))
         for record, files in plan:
             for fname, get_array in files:
-                np.save(tmp_dir / fname, get_array())
+                _write_checksummed(
+                    fname,
+                    (lambda get: lambda w: np.save(w, get()))(get_array),
+                )
         manifest = {
             "format_version": _SHARDED_FORMAT_VERSION,
             "iteration": int(iteration),
             "extra": extra or {},
             "leaves": [record for record, _ in plan],
+            "checksums": checksums,
         }
         with open(tmp_dir / _MANIFEST, "w") as f:
             json.dump(manifest, f)
@@ -425,6 +465,123 @@ def load_checkpoint_sharded(
         "iteration": manifest["iteration"],
         "extra": manifest["extra"],
     }
+
+
+# ----------------------------------------------- corruption-tolerant loading
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """No loadable checkpoint: the requested snapshot AND every prior
+    sibling failed verification or loading.  Carries the per-snapshot
+    failure list in ``.failures``."""
+
+    def __init__(self, message: str, failures: list[str]):
+        super().__init__(message)
+        self.failures = failures
+
+
+def _quarantine_snapshot(path: Path) -> Path | None:
+    """Quarantine a corrupt snapshot with the ``.corrupt`` suffix.  A
+    symlink (``latest.ckpt`` in the sharded layout) quarantines its TARGET
+    and removes the dangling link — the evidence is the data, not the
+    pointer."""
+    if path.is_symlink():
+        try:
+            target = path.resolve(strict=False)
+        except OSError:
+            target = None
+        path.unlink()
+        if target is not None and (target.exists() or target.is_symlink()):
+            return quarantine(target)
+        return None
+    if path.exists():
+        return quarantine(path)
+    return None
+
+
+def load_checkpoint_with_fallback(
+    src: str | os.PathLike, loader=None
+) -> tuple[dict, Path]:
+    """Load ``src``, falling back to the newest prior VALID snapshot in the
+    same directory when it is corrupt — quarantining (never deleting) every
+    snapshot that fails on the way.  Returns ``(payload, used_path)``.
+
+    ``loader`` defaults to :func:`load_checkpoint` (auto-detecting); the
+    training loop passes its mesh-placement-aware loader so GSPMD resumes
+    get the same protection.  Verification is the cheap jax-free pass
+    (checksums + manifest shapes).  Two deliberate limits on the fallback:
+
+    * only snapshots with a step number STRICTLY BELOW the requested one
+      are candidates — a user who explicitly resumes from an old snapshot
+      (re-branching before a divergence) must never be silently fast-
+      forwarded to a newer state;
+    * a snapshot whose bytes are PROVABLY intact (checksums verified) but
+      whose load still raises is a caller/config or environment error
+      (wrong mesh, NFS timeout, OOM), not corruption — the error is
+      re-raised untouched instead of quarantining valid multi-GB
+      snapshots one by one.  Only unverifiable (pre-integrity) snapshots
+      get the quarantine-on-load-failure treatment.
+    """
+    loader = loader or load_checkpoint
+    src = Path(src)
+    try:
+        exclude = {src.resolve()}
+    except OSError:
+        exclude = set()
+    siblings = candidate_snapshots(src.parent, exclude=exclude)
+    src_step = snapshot_step(src.name)
+    if src_step is not None:
+        siblings = [
+            p
+            for p in siblings
+            if (snapshot_step(p.name) or 0) < src_step
+        ]
+    attempts = [src] + siblings
+    failures: list[str] = []
+    for path in attempts:
+        result = verify_checkpoint(path)
+        if not result.ok:
+            failures.append(
+                f"{path}: {'; '.join(result.problems) or 'invalid'}"
+            )
+            quarantined = _quarantine_snapshot(path)
+            print(
+                f"checkpoint {path} failed integrity verification"
+                + (f" (quarantined as {quarantined})" if quarantined else "")
+                + f": {'; '.join(result.problems)}",
+                file=sys.stderr,
+            )
+            continue
+        # ok + no warnings == every byte matched a recorded checksum.
+        bytes_verified = not result.warnings
+        try:
+            payload = loader(path)
+        except Exception as exc:  # noqa: BLE001 - triaged below
+            if bytes_verified:
+                # Intact bytes that won't load: the problem is the caller
+                # or the environment, never this snapshot — surface it.
+                raise
+            failures.append(f"{path}: load failed ({exc})")
+            quarantined = _quarantine_snapshot(path)
+            print(
+                f"checkpoint {path} failed to load ({exc})"
+                + (f"; quarantined as {quarantined}" if quarantined else ""),
+                file=sys.stderr,
+            )
+            continue
+        if failures:
+            print(
+                f"resumed from fallback snapshot {path} after "
+                f"{len(failures)} corrupt candidate(s)",
+                file=sys.stderr,
+            )
+        return payload, path
+    raise CheckpointCorruptionError(
+        f"no loadable checkpoint at {src} or among its siblings "
+        f"({len(failures)} candidate(s) failed — corrupt snapshots were "
+        "quarantined with a .corrupt suffix)",
+        failures,
+    )
 
 
 # --------------------------------------------------------- async checkpoints

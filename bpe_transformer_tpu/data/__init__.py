@@ -2,9 +2,16 @@
 
 from bpe_transformer_tpu.data.dataset import (
     BatchLoader,
+    check_dataset_geometry,
     get_batch,
     load_token_file,
     tokenize_to_memmap,
 )
 
-__all__ = ["BatchLoader", "get_batch", "load_token_file", "tokenize_to_memmap"]
+__all__ = [
+    "BatchLoader",
+    "check_dataset_geometry",
+    "get_batch",
+    "load_token_file",
+    "tokenize_to_memmap",
+]

@@ -70,8 +70,53 @@ def tokenize_to_memmap(
 
 
 def load_token_file(path: str | Path, dtype: str = "uint16") -> np.ndarray:
-    """Open a flat binary token file as a read-only memmap."""
-    return np.memmap(path, dtype=np.dtype(dtype), mode="r")
+    """Open a flat binary token file as a read-only memmap.
+
+    Validates the file geometry up front — a missing, empty, or
+    odd-sized file (truncated write, wrong ``--dtype``) raises a clear
+    error here instead of an opaque mmap/index failure mid-run.
+    """
+    path = Path(path)
+    dt = np.dtype(dtype)
+    if not path.exists():
+        raise FileNotFoundError(f"token file {path} does not exist")
+    size = path.stat().st_size
+    if size == 0:
+        raise ValueError(
+            f"token file {path} is empty — tokenization produced no output "
+            "or the write was lost"
+        )
+    if size % dt.itemsize:
+        raise ValueError(
+            f"token file {path} is {size} bytes, not a multiple of the "
+            f"{dt.itemsize}-byte dtype {dt.name} — truncated write or "
+            "mismatched --dtype?"
+        )
+    return np.memmap(path, dtype=dt, mode="r")
+
+
+def check_dataset_geometry(
+    dataset: np.ndarray,
+    context_length: int,
+    batch_size: int,
+    name: str = "dataset",
+) -> None:
+    """Fail fast when a token array cannot serve the requested batch
+    geometry.  ``get_batch`` samples ``(batch_size, context_length + 1)``
+    windows with replacement, so the hard floor is ``context_length + 1``
+    tokens; the training loop calls this up front so an undersized memmap
+    raises a geometry message at step 0, not an index error mid-run.
+    """
+    n = len(dataset)
+    need = context_length + 1
+    if n < need:
+        raise ValueError(
+            f"{name} holds {n} tokens but sampling batches of shape "
+            f"({batch_size}, {context_length}) needs at least "
+            f"context_length + 1 = {need} tokens — the token file is too "
+            "short for this model's context (shrink context_length or "
+            "tokenize more data)"
+        )
 
 
 def get_batch(

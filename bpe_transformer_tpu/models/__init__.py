@@ -1,4 +1,12 @@
-"""Model families: transformer LM as param pytrees + pure forward fns."""
+"""Model families: transformer LM as param pytrees + pure forward fns.
+
+The config surface (`ModelConfig` + presets) is pure stdlib; the forward
+functions import jax.  The jax half resolves lazily (PEP 562, matching
+telemetry/ and the package root) so jax-free CLI paths — ``bpe-tpu
+verify-checkpoint``, ``report``, ``monitor``, the ``--supervise`` parent —
+can import this package (the CLI's preset table lives here) without ever
+initializing an accelerator runtime.
+"""
 
 from bpe_transformer_tpu.models.config import (
     GPT2_MEDIUM,
@@ -9,13 +17,20 @@ from bpe_transformer_tpu.models.config import (
     TS_TEST_CONFIG,
     ModelConfig,
 )
-from bpe_transformer_tpu.models.transformer import (
-    forward,
-    init_params,
-    params_from_state_dict,
-    state_dict_from_params,
-    transformer_block,
+
+from bpe_transformer_tpu._lazy import lazy_attrs
+
+__getattr__ = lazy_attrs(
+    __name__,
+    {
+        "forward": "transformer",
+        "init_params": "transformer",
+        "params_from_state_dict": "transformer",
+        "state_dict_from_params": "transformer",
+        "transformer_block": "transformer",
+    },
 )
+
 
 __all__ = [
     "GPT2_MEDIUM",

@@ -1,0 +1,62 @@
+"""Fault tolerance: ride through preemption, corruption, NaNs, and crashes.
+
+On real TPU pods failure is an operating condition — SIGTERM'd slices,
+torn checkpoint writes, a NaN that poisons the state mid-window, children
+that die and need respawning.  The observability stack (telemetry/) can
+*see* all of these; this package *acts* on them:
+
+- `signals` — SIGTERM/SIGINT -> stop flag -> emergency checkpoint + the
+  distinct ``EXIT_PREEMPTED`` exit code;
+- `integrity` — CRC32 checksums stamped at save time, jax-free
+  ``verify_checkpoint``, quarantine + newest-prior-valid fallback
+  (``bpe-tpu verify-checkpoint``);
+- `rollback` — the crash-loop breaker behind ``on_nonfinite="rollback"``;
+- `retention` — ``--keep-checkpoints N`` GC with latest/corrupt/debris
+  safety rules;
+- `supervisor` — the jax-free respawning parent behind
+  ``bpe-tpu train --supervise``;
+- `faults` — the deterministic chaos harness the test suite drives every
+  recovery path with.
+
+Everything except ``faults.poison_params`` is importable without jax.
+"""
+
+from bpe_transformer_tpu.resilience.faults import (
+    FaultInjector,
+    FaultPlan,
+    corrupt_file,
+)
+from bpe_transformer_tpu.resilience.integrity import (
+    VerifyResult,
+    atomic_write_json,
+    latest_valid_checkpoint,
+    quarantine,
+    verify_checkpoint,
+)
+from bpe_transformer_tpu.resilience.retention import gc_checkpoints
+from bpe_transformer_tpu.resilience.rollback import (
+    RollbackBudget,
+    RollbackExhausted,
+)
+from bpe_transformer_tpu.resilience.signals import (
+    EXIT_PREEMPTED,
+    GracefulShutdown,
+)
+from bpe_transformer_tpu.resilience.supervisor import supervise
+
+__all__ = [
+    "EXIT_PREEMPTED",
+    "FaultInjector",
+    "FaultPlan",
+    "GracefulShutdown",
+    "RollbackBudget",
+    "RollbackExhausted",
+    "VerifyResult",
+    "atomic_write_json",
+    "corrupt_file",
+    "gc_checkpoints",
+    "latest_valid_checkpoint",
+    "quarantine",
+    "supervise",
+    "verify_checkpoint",
+]

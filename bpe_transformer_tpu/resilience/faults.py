@@ -1,0 +1,170 @@
+"""Deterministic fault injection: the chaos half of the resilience layer.
+
+Recovery code that is never exercised is broken code waiting for a pod
+preemption to prove it.  This module injects the real failure modes at
+exact, reproducible points so ``tests/test_resilience.py`` can drive every
+recovery path end-to-end:
+
+* **NaN state at step K** — poisons one parameter leaf after the step
+  crosses K, so the next log boundary detects a genuinely non-finite model
+  (exactly what a bad batch/overflow produces) and the rollback path must
+  actually restore from disk to recover;
+* **kill at step K** — ``SIGKILL`` to self: the hard-preemption case no
+  handler can soften (supervisor respawn territory);
+* **preempt at step K** — ``SIGTERM`` to self: the graceful path
+  (``resilience.signals``);
+* **dataset read failure at step K** — an ``OSError`` out of the batch
+  sampler (flaky network filesystem), the supervisor's crash-restart case;
+* **checkpoint corruption** — :func:`corrupt_file` truncates or bit-flips
+  a named file (dense ``.ckpt``, a shard ``.npy``, a manifest) so the
+  integrity/fallback path sees real damage.
+
+Faults fire ONCE.  In-process that is an instance flag; across supervisor
+respawns (same env, fresh process) set ``once_dir`` and the firing leaves a
+marker file the next process honors — so "kill at step 6" means the FIRST
+pass through step 6, and the respawned child survives it, which is exactly
+the scenario under test.
+
+The training loop asks for a plan via :func:`from_env` (``BT_FAULTS`` JSON)
+— production runs without the env var get a no-op injector and zero
+overhead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+from pathlib import Path
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """What to break, and when (steps are loop iteration numbers)."""
+
+    nan_at_step: int | None = None
+    kill_at_step: int | None = None
+    preempt_at_step: int | None = None
+    fail_read_at_step: int | None = None
+    #: Directory for cross-process fire-once markers (supervisor respawns).
+    once_dir: str | None = None
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        payload = json.loads(text)
+        if not isinstance(payload, dict):
+            raise ValueError("fault plan must be a JSON object")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(payload) - known)
+        if unknown:
+            raise ValueError(f"unknown fault plan fields: {', '.join(unknown)}")
+        return cls(**payload)
+
+
+class FaultInjector:
+    """Runtime for one :class:`FaultPlan` (or a no-op when ``plan`` is
+    None).  The loop calls the hooks unconditionally; every hook is a cheap
+    comparison when nothing is planned."""
+
+    def __init__(self, plan: FaultPlan | None):
+        self.plan = plan
+        self._fired: set[str] = set()
+
+    @classmethod
+    def from_env(cls, var: str = "BT_FAULTS") -> "FaultInjector":
+        text = os.environ.get(var)
+        return cls(FaultPlan.from_json(text) if text else None)
+
+    @property
+    def active(self) -> bool:
+        return self.plan is not None
+
+    # ------------------------------------------------------------- fire-once
+
+    def _should_fire(self, fault: str, at_step: int | None, step: int) -> bool:
+        if at_step is None or step < at_step or fault in self._fired:
+            return False
+        if self.plan.once_dir:
+            marker = Path(self.plan.once_dir) / f"{fault}.fired"
+            if marker.exists():
+                self._fired.add(fault)
+                return False
+            marker.parent.mkdir(parents=True, exist_ok=True)
+            marker.touch()
+        self._fired.add(fault)
+        return True
+
+    # ----------------------------------------------------------------- hooks
+
+    def at_step(self, step: int) -> None:
+        """Called at the top of every loop iteration: process-level faults
+        (the marker is written BEFORE the kill — a SIGKILL leaves no other
+        trace)."""
+        if self.plan is None:
+            return
+        if self._should_fire("preempt", self.plan.preempt_at_step, step):
+            os.kill(os.getpid(), signal.SIGTERM)
+        if self._should_fire("kill", self.plan.kill_at_step, step):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_batch_read(self, step: int) -> None:
+        """Called before each batch sample; raises the planned read error."""
+        if self.plan is None:
+            return
+        if self._should_fire("fail_read", self.plan.fail_read_at_step, step):
+            raise OSError(
+                f"injected dataset read failure at step {step} "
+                "(resilience.faults)"
+            )
+
+    def poison_params(self, params, step: int):
+        """Called after each optimizer update: returns ``params`` with the
+        first leaf overwritten by NaN once ``step`` crosses the plan — a
+        faithful stand-in for a bad-batch overflow that the rollback path
+        must recover from by reloading the last checkpoint."""
+        if self.plan is None or not self._should_fire(
+            "nan", self.plan.nan_at_step, step
+        ):
+            return params
+        # Imported here: the injector itself must stay importable on
+        # jax-free hosts (the supervisor reads the same plan).
+        import jax
+        import numpy as np
+
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        poisoned = np.asarray(jax.device_get(leaves[0])).copy()
+        poisoned.fill(np.nan)
+        return jax.tree_util.tree_unflatten(treedef, [poisoned] + leaves[1:])
+
+
+# ------------------------------------------------------------- file corruption
+
+
+def corrupt_file(
+    path: str | os.PathLike,
+    mode: str = "truncate",
+    nbytes: int = 64,
+) -> None:
+    """Damage a file in place the way real failures do.
+
+    ``mode="truncate"`` drops the trailing ``nbytes`` (torn write / full
+    disk); ``mode="flip"`` XORs a byte mid-file (bit rot / bad DMA) without
+    changing the size — the case only a checksum catches.
+    """
+    path = Path(path)
+    size = path.stat().st_size
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(size - nbytes, 0))
+    elif mode == "flip":
+        if size == 0:
+            raise ValueError(f"cannot bit-flip empty file {path}")
+        offset = size // 2
+        with open(path, "r+b") as f:
+            f.seek(offset)
+            byte = f.read(1)
+            f.seek(offset)
+            f.write(bytes([byte[0] ^ 0xFF]))
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")

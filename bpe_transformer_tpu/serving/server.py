@@ -203,6 +203,7 @@ class ServingEngine:
         self._requests_finished = 0
         self._thread: threading.Thread | None = None
         self._running = False
+        self._draining = False
         self._worker_error: BaseException | None = None
 
     # ------------------------------------------------------------ lifecycle
@@ -218,6 +219,43 @@ class ServingEngine:
         )
         self._thread.start()
         return self
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown, phase 1: stop ADMITTING (new submits raise
+        ``RuntimeError`` -> HTTP 503) but keep the worker running until
+        every queued and in-flight request finishes — the SIGTERM path of
+        ``bpe-tpu serve`` (preemption must not cancel work the engine can
+        still complete).  Returns True when fully drained, False on
+        timeout (the caller's ``close()`` then cancels the stragglers)."""
+        self._draining = True
+        if self._telemetry is not None:
+            self._telemetry.event(
+                "serve_drain",
+                queue_depth=self.scheduler.depth,
+                active_slots=self.engine.active_count,
+            )
+        deadline = self._clock() + timeout_s
+        while True:
+            # The entries registry is the superset of unfinished work:
+            # queue depth and active_count both read 0 for a request the
+            # worker has popped but not yet slotted (it sits in a
+            # multi-second prefill compile exactly when a drain is likely
+            # to ask) — _finish() is the only thing that unregisters.
+            with self._entries_lock:
+                pending = len(self._entries)
+            if (
+                not pending
+                and not self.engine.active_count
+                and not self.scheduler.depth
+            ):
+                return True
+            if (
+                self._worker_error is not None
+                or not self._running
+                or self._clock() >= deadline
+            ):
+                return False
+            time.sleep(min(self._idle_poll_s, 0.05))
 
     def close(self) -> None:
         """Stop the worker; in-flight and queued requests finish as
@@ -258,6 +296,11 @@ class ServingEngine:
             ) from self._worker_error
         if not self._running:
             raise RuntimeError("serving engine is not running (use start())")
+        if self._draining:
+            raise RuntimeError(
+                "serving engine is draining (shutting down); not accepting "
+                "new requests"
+            )
         plen = len(request.prompt_ids)
         ctx = self.engine.config.context_length
         if plen < 1:

@@ -38,35 +38,27 @@ from bpe_transformer_tpu.telemetry.sinks import MetricsLogger
 from bpe_transformer_tpu.telemetry.spans import Telemetry
 from bpe_transformer_tpu.telemetry.watchdog import NonFiniteError, Watchdog
 
+from bpe_transformer_tpu._lazy import lazy_attrs
+
 #: `health`, `dynamics`, and `timing` import jax at module load; they
-#: resolve lazily (PEP 562) so the jax-free members above — most
-#: importantly the report tool — stay importable on hosts with no
-#: accelerator runtime, matching the package root's lazy-subpackage design.
-_LAZY_SUBMODULE = {
-    "flatten_health": "health",
-    "group_norms": "health",
-    "health_metrics": "health",
-    "nonfinite_count": "health",
-    "dynamics_metrics": "dynamics",
-    "dynamics_record": "dynamics",
-    "flatten_dynamics": "dynamics",
-    "StepTimer": "timing",
-    "profile_trace": "timing",
-    "time_fn": "timing",
-}
-
-
-def __getattr__(name: str):
-    submodule = _LAZY_SUBMODULE.get(name)
-    if submodule is None:
-        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
-    import importlib
-
-    value = getattr(
-        importlib.import_module(f"bpe_transformer_tpu.telemetry.{submodule}"), name
-    )
-    globals()[name] = value  # cache: resolve once per process
-    return value
+#: resolve lazily (PEP 562, shared helper in `_lazy`) so the jax-free
+#: members above — most importantly the report tool — stay importable on
+#: hosts with no accelerator runtime, matching models/ and training/.
+__getattr__ = lazy_attrs(
+    __name__,
+    {
+        "flatten_health": "health",
+        "group_norms": "health",
+        "health_metrics": "health",
+        "nonfinite_count": "health",
+        "dynamics_metrics": "dynamics",
+        "dynamics_record": "dynamics",
+        "flatten_dynamics": "dynamics",
+        "StepTimer": "timing",
+        "profile_trace": "timing",
+        "time_fn": "timing",
+    },
+)
 
 __all__ = [
     "MetricsLogger",

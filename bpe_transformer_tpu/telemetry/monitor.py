@@ -27,7 +27,9 @@ import time
 from pathlib import Path
 
 #: Event names worth flagging on the panel (matches report's anomaly list).
-_ANOMALY_EVENTS = ("nonfinite", "watchdog_hang", "serve_worker_error")
+_ANOMALY_EVENTS = (
+    "nonfinite", "watchdog_hang", "serve_worker_error", "recovery_abort",
+)
 
 
 # ----------------------------------------------------------- state folding
@@ -93,6 +95,29 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
                 state["last_anomaly"] = (
                     f"nonfinite {record['first_nonfinite']}"
                 )
+        elif kind == "recovery":
+            # NaN-rollback recovery (training/loop.py): count it and show
+            # the restore so an operator watching live sees the run heal.
+            state["rollbacks"] = state.get("rollbacks", 0) + 1
+            state["anomalies"] += 1
+            state["last_anomaly"] = (
+                f"rollback -> step {record.get('restored_step')}"
+                + (
+                    f" ({record['nonfinite_path']})"
+                    if record.get("nonfinite_path")
+                    else ""
+                )
+            )
+        elif kind == "preemption":
+            state["preempted"] = record.get("signal")
+            state["last_anomaly"] = (
+                f"preempted ({record.get('signal')})"
+                + (
+                    ""
+                    if record.get("checkpoint")
+                    else " WITHOUT checkpoint"
+                )
+            )
         elif kind == "event":
             if record.get("name") in _ANOMALY_EVENTS:
                 state["anomalies"] += 1
@@ -271,6 +296,10 @@ def render_frame(state: dict, source: str) -> str:
 
     status = f"  state  records {state.get('n_records', 0)}"
     status += f"  anomalies {state.get('anomalies', 0)}"
+    if state.get("rollbacks"):
+        status += f"  rollbacks {state['rollbacks']}"
+    if state.get("preempted"):
+        status += f"  [preempted {state['preempted']}]"
     if state.get("last_anomaly"):
         status += f" (last: {state['last_anomaly']})"
     if state.get("footer_clean") is not None:

@@ -273,6 +273,73 @@ def summarize(records: list[dict]) -> dict:
             ),
         }
 
+    # Resilience records (resilience/ + training/loop.py): NaN-rollback
+    # recoveries (kind="recovery") and graceful-preemption markers
+    # (kind="preemption") — the report's Recovery section tells an operator
+    # how much work the run lost and where the non-finite states localized.
+    recoveries = [r for r in records if r.get("kind") == "recovery"]
+    preemptions = [r for r in records if r.get("kind") == "preemption"]
+    recovery_summary = None
+    if recoveries or preemptions:
+        lost = [
+            r["lost_steps"]
+            for r in recoveries
+            if isinstance(r.get("lost_steps"), (int, float))
+        ]
+        recovery_summary = {
+            "rollbacks": len(recoveries),
+            "lost_steps_total": sum(lost) if lost else 0,
+            "nonfinite_paths": sorted(
+                {
+                    r["nonfinite_path"]
+                    for r in recoveries
+                    if r.get("nonfinite_path")
+                }
+            ),
+            "rollback_timeline": [
+                {
+                    "step": r.get("step"),
+                    "restored_step": r.get("restored_step"),
+                    "rollbacks": r.get("rollbacks"),
+                }
+                for r in recoveries
+            ],
+            "preemptions": [
+                {
+                    "step": r.get("step"),
+                    "signal": r.get("signal"),
+                    "checkpoint": r.get("checkpoint"),
+                    "t": r.get("t"),
+                }
+                for r in preemptions
+            ],
+        }
+        for r in recoveries:
+            anomalies.append(
+                f"rollback at step {r.get('step')} -> restored step "
+                f"{r.get('restored_step')}"
+                + (
+                    f" (localized to {r['nonfinite_path']})"
+                    if r.get("nonfinite_path")
+                    else ""
+                )
+            )
+        for r in preemptions:
+            anomalies.append(
+                f"preempted at step {r.get('step')} ({r.get('signal')})"
+                + (
+                    " with emergency checkpoint"
+                    if r.get("checkpoint")
+                    else " WITHOUT a checkpoint"
+                )
+            )
+    for event in events:
+        if event.get("name") == "recovery_abort":
+            anomalies.append(
+                f"recovery ABORTED at step {event.get('step')}: "
+                f"{event.get('error', 'rollback budget exhausted')}"
+            )
+
     # Training-dynamics records (kind="dynamics", telemetry/dynamics.py):
     # per-layer norm trajectories, update-ratio outliers, and the
     # first-non-finite localization callout.
@@ -366,6 +433,7 @@ def summarize(records: list[dict]) -> dict:
         "serving": serving,
         "resources": resource_summary,
         "dynamics": dynamics_summary,
+        "recovery": recovery_summary,
         "spans": span_breakdown,
         "health_last": health_last,
         "events": [e.get("name") for e in events],
@@ -545,6 +613,33 @@ def render_report(records: list[dict]) -> str:
                 f"  ! update-ratio outlier: {outlier['layer']} at "
                 f"{_fmt(outlier['ratio'], 3)} "
                 f"({outlier['x_median']:.1f}x the per-layer median)"
+            )
+
+    rc = s["recovery"]
+    if rc:
+        lines.append("== recovery ==")
+        lines.append(
+            f"  rollbacks {rc['rollbacks']}"
+            f"  lost steps ~{rc['lost_steps_total']}"
+            f"  preemptions {len(rc['preemptions'])}"
+        )
+        for path in rc["nonfinite_paths"]:
+            lines.append(f"  non-finite localized to {path}")
+        for rb in rc["rollback_timeline"]:
+            lines.append(
+                f"  rollback #{rb['rollbacks']}: step {rb['step']} -> "
+                f"restored {rb['restored_step']}"
+            )
+        for pre in rc["preemptions"]:
+            lines.append(
+                f"  preemption at step {pre['step']} ({pre['signal']}"
+                + (f", t={_fmt(pre['t'])}s" if pre.get("t") is not None else "")
+                + ")"
+                + (
+                    f" -> {pre['checkpoint']}"
+                    if pre.get("checkpoint")
+                    else " -> NO emergency checkpoint"
+                )
             )
 
     if s["spans"]:

@@ -51,6 +51,18 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
     # ``first_nonfinite`` localization path — all optional (a grad-accum
     # step has no activation taps; a clean step has no non-finite keys).
     "dynamics": {"kind", "step"},
+    # Graceful-preemption marker (resilience/signals + training/loop.py):
+    # SIGTERM/SIGINT was caught, the loop stopped at a step boundary, and
+    # (when a checkpoint dir is configured) an emergency snapshot was
+    # written — ``checkpoint`` carries its path, null when none could be.
+    "preemption": {"kind", "t", "step", "signal"},
+    # NaN-rollback recovery record (training/loop.py under
+    # on_nonfinite="rollback"): the run reloaded ``restored_step``'s
+    # checkpoint after a non-finite state at ``step`` and is retrying with
+    # the offending data window skipped.  ``rollbacks`` is the running
+    # count; optional ``lost_steps`` and the PR-4 ``nonfinite_path``
+    # localization ride along.
+    "recovery": {"kind", "t", "step", "restored_step", "rollbacks"},
     # Run trailer: record counts + clean verdict (spans.py Telemetry.footer).
     "footer": {"kind", "t", "record_counts"},
     # Step/val metrics (NO kind key): at least a step number plus one

@@ -96,6 +96,13 @@ class Telemetry:
     def _now(self) -> float:
         return self._clock() - self._t0
 
+    def now(self) -> float:
+        """Seconds since this Telemetry was created — the ``t`` axis every
+        span/event record shares.  Public so emitters of custom record
+        kinds (preemption/recovery in the training loop) stamp the same
+        timeline."""
+        return round(self._now(), 6)
+
     # ------------------------------------------------------- span/event API
 
     def start_span(self, name: str, **attrs) -> SpanHandle:

@@ -16,12 +16,16 @@ watchdog covers both:
   never kills the run (the stuck dispatch may still complete; the operator
   or driver decides).
 - **Non-finite policy.**  :meth:`on_nonfinite` implements "dump state +
-  raise or skip": the offending record is emitted as a ``nonfinite`` event
+  act": the offending record is emitted as a ``nonfinite`` event
   (the dump — sinks flush per record, so it survives the crash), then
   policy ``"raise"`` raises :class:`NonFiniteError` (default: stop before
-  the corrupted state trains further or gets checkpointed) while ``"skip"``
+  the corrupted state trains further or gets checkpointed), ``"skip"``
   records and continues (branch for runs that prefer losing a window of
-  steps over losing the job).
+  steps over losing the job), and ``"rollback"`` records and returns —
+  the training loop then reloads the last valid checkpoint, skips the
+  offending data window, and retries under the crash-loop budget of
+  ``resilience.rollback.RollbackBudget`` (the watchdog only owns the
+  evidence dump; the recovery action lives where the state does).
 
 All timing logic is pure and clock-injectable (:meth:`check`), so tests
 drive it without threads or sleeps; the thread is opt-in via
@@ -49,7 +53,7 @@ class NonFiniteError(FloatingPointError):
 
 
 class Watchdog:
-    POLICIES = ("raise", "skip")
+    POLICIES = ("raise", "skip", "rollback")
 
     def __init__(
         self,

@@ -1,13 +1,28 @@
-"""Training: jitted steps, the loop, sampling, and the CLI."""
+"""Training: jitted steps, the loop, sampling, and the CLI.
 
-from bpe_transformer_tpu.training.loop import LoopConfig, train
-from bpe_transformer_tpu.training.sampling import generate_ids, generate_text
-from bpe_transformer_tpu.training.train_step import (
-    TrainHParams,
-    make_eval_step,
-    make_loss_fn,
-    make_train_step,
+Everything here imports jax at module load, so the symbols resolve lazily
+(PEP 562, matching models/ and telemetry/): the CLI module lives in this
+package, and its jax-free commands — ``verify-checkpoint``, ``report``,
+``monitor``, the ``--supervise`` parent — must be importable without
+initializing an accelerator runtime.
+"""
+
+from bpe_transformer_tpu._lazy import lazy_attrs
+
+__getattr__ = lazy_attrs(
+    __name__,
+    {
+        "LoopConfig": "loop",
+        "train": "loop",
+        "generate_ids": "sampling",
+        "generate_text": "sampling",
+        "TrainHParams": "train_step",
+        "make_eval_step": "train_step",
+        "make_loss_fn": "train_step",
+        "make_train_step": "train_step",
+    },
 )
+
 
 __all__ = [
     "LoopConfig",

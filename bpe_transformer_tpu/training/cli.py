@@ -122,7 +122,29 @@ def _maybe_profile_trace(logdir: str | None):
 
 
 def cmd_train(args) -> int:
+    if args.supervise:
+        # Supervised mode: THIS process becomes the jax-free parent — it
+        # never imports jax (the child owns the chip) and respawns the
+        # actual training child on crash/preemption with auto-resume from
+        # the newest valid checkpoint (resilience/supervisor.py).
+        from bpe_transformer_tpu.resilience.supervisor import supervise
+
+        if not args.checkpoint_dir:
+            print(
+                "train --supervise needs --checkpoint-dir (restart-with-"
+                "resume is the whole point)",
+                file=sys.stderr,
+            )
+            return 2
+        return supervise(
+            getattr(args, "_argv", None) or ["train"],
+            args.checkpoint_dir,
+            max_restarts=args.max_restarts,
+            backoff_s=args.restart_backoff,
+        )
+
     from bpe_transformer_tpu.data import load_token_file
+    from bpe_transformer_tpu.resilience.signals import EXIT_PREEMPTED
     from bpe_transformer_tpu.training.loop import LoopConfig, train
     from bpe_transformer_tpu.training.train_step import TrainHParams
 
@@ -155,6 +177,9 @@ def cmd_train(args) -> int:
         watchdog=args.watchdog,
         watchdog_factor=args.watchdog_factor,
         watchdog_policy=args.watchdog_policy,
+        max_rollbacks=args.max_rollbacks,
+        recovery_min_progress=args.recovery_min_progress,
+        keep_checkpoints=args.keep_checkpoints,
         seed=args.seed,
         parallel=args.parallel,
         mesh_axes=mesh_axes,
@@ -177,7 +202,10 @@ def cmd_train(args) -> int:
             resume_from=args.resume,
         )
     print(json.dumps({k: v for k, v in summary.items() if k != "history"}))
-    return 0
+    # Distinct exit code for a SIGTERM/SIGINT stop (emergency checkpoint
+    # already written): supervisors respawn-with-resume on it instead of
+    # treating the run as crashed or finished.
+    return EXIT_PREEMPTED if summary.get("preempted") else 0
 
 
 def _load_inference_state(args, *, need_tokenizer: bool):
@@ -314,9 +342,11 @@ def cmd_serve(args) -> int:
             server = make_http_server(serving, host=args.host, port=args.port)
             host, port = server.server_address[:2]
             # A service is stopped with SIGTERM (kill, container runtimes):
-            # route it through the same clean-shutdown path as Ctrl-C so the
-            # telemetry stream always ends with a footer (a stream without
-            # one reads as a crash in `bpe-tpu report`).
+            # graceful drain — the interrupt gets us out of serve_forever
+            # (no new connections), then the engine finishes every queued
+            # and in-flight request before close() runs, so preemption
+            # never cancels work the engine can still complete and the
+            # telemetry stream always ends with a footer.
             import signal
 
             def _sigterm(signum, frame):
@@ -327,7 +357,7 @@ def cmd_serve(args) -> int:
                 f"serving on http://{host}:{port}  "
                 f"(slots={args.slots}, queue={args.max_queue}; "
                 "POST /generate, GET /healthz /metrics /statusz; "
-                "Ctrl-C/SIGTERM to stop)",
+                "Ctrl-C/SIGTERM drains then stops)",
                 flush=True,
             )
             try:
@@ -336,6 +366,14 @@ def cmd_serve(args) -> int:
                 pass
             finally:
                 server.shutdown()
+                drained = serving.drain(timeout_s=args.drain_timeout)
+                print(
+                    "drained cleanly"
+                    if drained
+                    else f"drain timed out after {args.drain_timeout}s; "
+                    "cancelling stragglers",
+                    flush=True,
+                )
                 server.server_close()
             return 0
     finally:
@@ -358,6 +396,18 @@ def cmd_report(args) -> int:
     for pair in args.threshold or []:
         forwarded += ["--threshold", pair]
     return report_main(forwarded)
+
+
+def cmd_verify_checkpoint(args) -> int:
+    # Jax-free fast path (resilience/integrity.py): checksums + manifest
+    # shape check only — no unpickling, no array loads, safe on a login
+    # host while the pod trains.
+    from bpe_transformer_tpu.resilience.integrity import main as verify_main
+
+    forwarded = [args.path]
+    if args.json:
+        forwarded.append("--json")
+    return verify_main(forwarded)
 
 
 def cmd_monitor(args) -> int:
@@ -453,10 +503,60 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--watchdog-factor", type=float, default=10.0)
     p.add_argument(
         "--watchdog-policy",
-        choices=["raise", "skip"],
+        choices=["raise", "skip", "rollback"],
         default="raise",
         help='"raise": dump state to the telemetry stream then stop; '
-        '"skip": record the event and keep training',
+        '"skip": record the event and keep training; "rollback": reload '
+        "the last valid checkpoint, skip the offending data window, and "
+        "retry (needs --checkpoint-dir; bounded by --max-rollbacks/"
+        "--recovery-min-progress)",
+    )
+    p.add_argument(
+        "--max-rollbacks",
+        type=int,
+        default=3,
+        help="crash-loop breaker for --watchdog-policy rollback: abort "
+        "after this many rollbacks without --recovery-min-progress steps "
+        "of training between them",
+    )
+    p.add_argument(
+        "--recovery-min-progress",
+        type=int,
+        default=1,
+        metavar="STEPS",
+        help="steps of training between rollbacks that reset the "
+        "--max-rollbacks counter",
+    )
+    p.add_argument(
+        "--keep-checkpoints",
+        type=int,
+        default=None,
+        metavar="N",
+        help="retention GC: keep only the newest N step_*.ckpt snapshots "
+        "(latest.ckpt's target is never deleted; *.corrupt quarantines are "
+        "kept as evidence; stranded .tmp/.old crash debris is reclaimed)",
+    )
+    p.add_argument(
+        "--supervise",
+        action="store_true",
+        help="run under a jax-free supervisor parent that respawns a "
+        "crashed/preempted child with exponential backoff and auto-resume "
+        "from the newest valid checkpoint (needs --checkpoint-dir)",
+    )
+    p.add_argument(
+        "--max-restarts",
+        type=int,
+        default=5,
+        help="supervisor budget: consecutive child failures without "
+        "checkpoint progress before giving up (with --supervise)",
+    )
+    p.add_argument(
+        "--restart-backoff",
+        type=float,
+        default=1.0,
+        metavar="SECONDS",
+        help="initial supervisor restart backoff, doubled per consecutive "
+        "failure (with --supervise; preemptions respawn immediately)",
     )
     p.add_argument(
         "--profile-trace",
@@ -597,6 +697,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-jsonl", default=None,
                    help="append serving telemetry (request spans, engine "
                    "records) to this file; summarize with bpe-tpu report")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="on Ctrl-C/SIGTERM: stop accepting, then wait up "
+                   "to this long for queued + in-flight requests to finish "
+                   "before cancelling stragglers (graceful drain)")
     p.add_argument("--special-token", action="append", default=None,
                    help='repeatable; default: ["<|endoftext|>"]')
     p.set_defaults(fn=cmd_serve)
@@ -626,6 +731,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_report)
 
     p = sub.add_parser(
+        "verify-checkpoint",
+        help="verify a checkpoint's integrity (CRC32 checksums + manifest "
+        "shape check; jax-free, loads no arrays); exit 0 = valid, 1 = "
+        "corrupt",
+    )
+    p.add_argument("path", help="dense .ckpt file or sharded checkpoint dir")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable verdict")
+    p.set_defaults(fn=cmd_verify_checkpoint)
+
+    p = sub.add_parser(
         "monitor",
         help="live operational view: tail a metrics.jsonl or poll a "
         "running server's /metrics endpoint; no accelerator needed",
@@ -650,12 +766,25 @@ def main(argv: list[str] | None = None) -> int:
     # platform through jax.config (config wins over the env var once set).
     import os
 
+    raw_argv = list(argv) if argv is not None else sys.argv[1:]
     platforms = os.environ.get("JAX_PLATFORMS")
-    if platforms:
+    command = next((a for a in raw_argv if not a.startswith("-")), None)
+    jax_free = (
+        # Host-side tools that must never initialize a backend — and the
+        # supervisor parent, which must not grab the accelerator its child
+        # needs; the child re-enters main() without --supervise and applies
+        # the config itself.
+        command in ("report", "monitor", "verify-checkpoint")
+        or "--supervise" in raw_argv
+    )
+    if platforms and not jax_free:
         import jax
 
         jax.config.update("jax_platforms", platforms)
-    args = build_parser().parse_args(argv)
+    args = build_parser().parse_args(raw_argv)
+    # The raw argv rides along so `train --supervise` can respawn the exact
+    # command as its child (minus the supervisor-only flags).
+    args._argv = raw_argv
     return args.fn(args)
 
 

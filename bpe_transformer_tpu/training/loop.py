@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import json
 import math
 import shutil
 from pathlib import Path
@@ -82,10 +81,26 @@ class LoopConfig:
     #: (no metric sync within watchdog_factor x the trailing median step
     #: time), and non-finite states detected at a log boundary follow
     #: watchdog_policy — "raise" (dump state to the telemetry stream, then
-    #: raise NonFiniteError) or "skip" (record the event and keep going).
+    #: raise NonFiniteError), "skip" (record the event and keep going), or
+    #: "rollback" (reload the last valid checkpoint, skip the offending
+    #: data window, retry under the max_rollbacks/recovery_min_progress
+    #: crash-loop budget; requires checkpoint_dir, not supported with
+    #: parallel="pp").
     watchdog: bool = False
     watchdog_factor: float = 10.0
     watchdog_policy: str = "raise"
+    #: Crash-loop breaker for watchdog_policy="rollback": abort (raise
+    #: NonFiniteError) after max_rollbacks rollbacks without at least
+    #: recovery_min_progress steps of training between detections — a
+    #: failure that is not batch-local must not crash-loop the pod slice.
+    max_rollbacks: int = 3
+    recovery_min_progress: int = 1
+    #: Retention GC: keep only the newest N step_*.ckpt snapshots (None =
+    #: keep everything).  The snapshot latest.ckpt points at is never
+    #: deleted, quarantined *.corrupt snapshots are left as evidence, and
+    #: stranded .tmp/.old crash debris older than the newest snapshot is
+    #: reclaimed (resilience/retention.py).
+    keep_checkpoints: int | None = None
     seed: int = 0
     #: None -> single device; "dp" -> shard_map psum; "sp" -> context
     #: parallelism (ring attention over a data x seq mesh); "pp" -> GPipe
@@ -132,8 +147,17 @@ def train(
     val_data: np.ndarray | None = None,
     resume_from: str | Path | None = None,
     log_fn=print,
+    fault_injector=None,
 ) -> dict:
-    """Run the loop; returns a summary dict (final/eval losses, throughput)."""
+    """Run the loop; returns a summary dict (final/eval losses, throughput).
+
+    ``fault_injector`` (resilience.faults.FaultInjector) defaults to the
+    ``BT_FAULTS`` env plan — a no-op in production, the chaos harness's
+    entry point in tests.  A run stopped by SIGTERM/SIGINT writes an
+    emergency checkpoint, emits a ``kind="preemption"`` record, and returns
+    with ``summary["preempted"]`` set (the CLI maps it to
+    ``EXIT_PREEMPTED``).
+    """
     # Imported here, not at module top: parallel.train_step reuses the
     # update body from training.train_step, so a top-level import would be
     # circular through the package __init__s.
@@ -146,6 +170,16 @@ def train(
         shard_params,
         shard_sp_batch,
     )
+    from bpe_transformer_tpu.data.dataset import check_dataset_geometry
+    from bpe_transformer_tpu.resilience.faults import FaultInjector
+    from bpe_transformer_tpu.resilience.rollback import (
+        RollbackBudget,
+        RollbackExhausted,
+    )
+    from bpe_transformer_tpu.resilience.signals import GracefulShutdown
+    from bpe_transformer_tpu.telemetry.watchdog import NonFiniteError
+
+    injector = fault_injector if fault_injector is not None else FaultInjector.from_env()
 
     # The telemetry narrator exists from the first line so setup work is
     # spanned; records are buffered until the sinks exist (attach below).
@@ -184,6 +218,41 @@ def train(
         raise ValueError(
             f"watchdog_policy must be one of {Watchdog.POLICIES}, "
             f"got {loop.watchdog_policy!r}"
+        )
+    rollback_mode = loop.watchdog and loop.watchdog_policy == "rollback"
+    if rollback_mode:
+        if loop.checkpoint_dir is None:
+            raise ValueError(
+                'watchdog_policy="rollback" needs checkpoint_dir — recovery '
+                "reloads the last valid snapshot"
+            )
+        if loop.parallel == "pp":
+            raise ValueError(
+                'watchdog_policy="rollback" is not supported with '
+                'parallel="pp" (checkpoints carry the stacked-stage layout); '
+                'use "raise" or "skip"'
+            )
+        if loop.checkpoint_every % loop.log_every:
+            # Detection happens at log boundaries; keeping every checkpoint
+            # boundary ON a log boundary guarantees a poisoned-but-not-yet-
+            # detected state can never be checkpointed (the rollback path
+            # skips the save at the detecting boundary).
+            raise ValueError(
+                f"checkpoint_every={loop.checkpoint_every} must be a "
+                f"multiple of log_every={loop.log_every} under "
+                'watchdog_policy="rollback" — checkpoints must land on '
+                "detection boundaries so a non-finite state is never saved"
+            )
+    # Fail on an undersized token file NOW with a geometry message, not as
+    # an opaque index error on some later batch (data/dataset.py).
+    check_dataset_geometry(
+        train_data, model_config.context_length, loop.batch_size,
+        name="train_data",
+    )
+    if val_data is not None:
+        check_dataset_geometry(
+            val_data, model_config.context_length, loop.batch_size,
+            name="val_data",
         )
 
     mesh = None
@@ -224,53 +293,69 @@ def train(
                     f"divisible by the seq mesh axis ({seq_size})"
                 )
 
-    start_iteration = 0
-    if resume_from is not None:
+    def load_state(src: Path):
+        """Fallback-aware state restore shared by resume and NaN rollback:
+        verify (jax-free checksums) -> load -> ``(params, opt_state,
+        iteration, used_path)``.  A corrupt snapshot is quarantined with a
+        ``.corrupt`` suffix and the newest prior valid sibling is loaded
+        instead of crashing (checkpointing.load_checkpoint_with_fallback)."""
         from bpe_transformer_tpu.checkpointing.checkpoint import (
+            load_checkpoint_with_fallback,
             sharded_checkpoint_exists,
         )
 
-        resume_from = Path(resume_from)
+        src = Path(src)
         # A directory may be a checkpoints PARENT (resume from its latest
         # snapshot) or a sharded checkpoint itself (has a manifest — or a
         # crash-stranded orphan sibling the loader recovers from).
-        if resume_from.is_dir() and not sharded_checkpoint_exists(resume_from):
-            resume_from = resume_from / "latest.ckpt"
+        if src.is_dir() and not sharded_checkpoint_exists(src):
+            src = src / "latest.ckpt"
         gspmd = mesh is not None and loop.parallel not in ("dp", "sp", "pp")
-        if gspmd and sharded_checkpoint_exists(resume_from):
-            # Streaming re-placement: build the target shardings from the
-            # ABSTRACT param tree (no init compute) so each leaf lands on
-            # its mesh devices as it is read — the full FSDP state is never
-            # staged on host in one buffer.
-            from bpe_transformer_tpu.checkpointing import load_checkpoint_sharded
-            from bpe_transformer_tpu.parallel.sharding import param_shardings
-            from jax.sharding import NamedSharding, PartitionSpec
 
-            abstract = jax.eval_shape(
-                lambda: init_params(jax.random.PRNGKey(0), model_config)
-            )
-            pshard = param_shardings(abstract, mesh, loop.parallel)
-            payload = load_checkpoint_sharded(
-                resume_from,
-                shardings={
-                    "params": pshard,
-                    "opt_state": AdamWState(
-                        step=NamedSharding(mesh, PartitionSpec()),
-                        m=pshard,
-                        v=pshard,
-                    ),
-                },
-            )
-        else:
-            payload = load_checkpoint(resume_from)
-        params = payload["params"]
-        opt_state = (
+        def loader(path):
+            if gspmd and sharded_checkpoint_exists(path):
+                # Streaming re-placement: build the target shardings from
+                # the ABSTRACT param tree (no init compute) so each leaf
+                # lands on its mesh devices as it is read — the full FSDP
+                # state is never staged on host in one buffer.
+                from bpe_transformer_tpu.checkpointing import (
+                    load_checkpoint_sharded,
+                )
+                from bpe_transformer_tpu.parallel.sharding import param_shardings
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                abstract = jax.eval_shape(
+                    lambda: init_params(jax.random.PRNGKey(0), model_config)
+                )
+                pshard = param_shardings(abstract, mesh, loop.parallel)
+                return load_checkpoint_sharded(
+                    path,
+                    shardings={
+                        "params": pshard,
+                        "opt_state": AdamWState(
+                            step=NamedSharding(mesh, PartitionSpec()),
+                            m=pshard,
+                            v=pshard,
+                        ),
+                    },
+                )
+            return load_checkpoint(path)
+
+        payload, used = load_checkpoint_with_fallback(src, loader=loader)
+        loaded_params = payload["params"]
+        loaded_opt = (
             AdamWState(*payload["opt_state"])
             if payload["opt_state"] is not None
-            else adamw_init(params)
+            else adamw_init(loaded_params)
         )
-        start_iteration = payload["iteration"]
-        log_fn(f"resumed from {resume_from} at iteration {start_iteration}")
+        return loaded_params, loaded_opt, payload["iteration"], used
+
+    start_iteration = 0
+    if resume_from is not None:
+        params, opt_state, start_iteration, used_path = load_state(
+            Path(resume_from)
+        )
+        log_fn(f"resumed from {used_path} at iteration {start_iteration}")
     else:
         params = init_params(jax.random.PRNGKey(loop.seed), model_config)
         opt_state = None  # built after placement
@@ -534,6 +619,111 @@ def train(
     prev_sync_iteration = start_iteration
     excluded_steps = 0
     clean_exit = False
+    #: Graceful preemption: SIGTERM/SIGINT sets a flag the loop polls each
+    #: step boundary (emergency checkpoint + kind="preemption" record +
+    #: distinct exit code downstream).  install() is a no-op off the main
+    #: thread — the flag then simply never trips.
+    stop = GracefulShutdown()
+    stop.install()
+    preempted: str | None = None
+    rollback_budget = (
+        RollbackBudget(loop.max_rollbacks, loop.recovery_min_progress)
+        if rollback_mode
+        else None
+    )
+    #: Advanced by each NaN rollback: mixes into the per-iteration batch
+    #: seed so the retry samples DIFFERENT data over the replayed window —
+    #: "skip the offending batch" without tracking which batch offended.
+    #: Zero (the default) preserves the exact historical seeding, so
+    #: resume determinism is untouched on runs that never roll back.
+    batch_salt = 0
+
+    def batch_rng(it: int) -> np.random.Generator:
+        if batch_salt:
+            return np.random.default_rng((loop.seed, it, batch_salt))
+        return np.random.default_rng((loop.seed, it))
+
+    def save_snapshot(sync: bool = False) -> Path:
+        """Write one checkpoint at the current iteration (step file +
+        latest pointer + retention GC) — shared by the periodic cadence and
+        the preemption emergency path (``sync=True`` bypasses the async
+        saver: the process is about to exit)."""
+        ckpt_handle = telemetry.start_span(
+            "checkpoint",
+            step=iteration,
+            async_save=async_saver is not None and not sync,
+        )
+        ckpt_path = Path(loop.checkpoint_dir) / f"step_{iteration:08d}.ckpt"
+        latest = Path(loop.checkpoint_dir) / "latest.ckpt"
+        state_kwargs = dict(
+            params=params,
+            opt_state=opt_state,
+            iteration=iteration,
+            extra={
+                "val_loss": None if math.isnan(val_loss) else val_loss,
+                "train_loss": None if math.isnan(last_loss) else last_loss,
+                # Self-describing checkpoints: eval/generate can recover
+                # the architecture without the user re-passing --preset (a
+                # mismatched preset crashes deep in RoPE with a shape
+                # error).
+                "model_config": dataclasses.asdict(model_config),
+            },
+        )
+
+        def update_latest(ckpt_path=ckpt_path, latest=latest):
+            from bpe_transformer_tpu.resilience.integrity import sidecar_path
+            from bpe_transformer_tpu.resilience.retention import gc_checkpoints
+
+            # A prior run of the other format may have left latest
+            # as a symlink/dir; clear before re-pointing.
+            if latest.is_symlink() or latest.exists():
+                if latest.is_dir() and not latest.is_symlink():
+                    shutil.rmtree(latest)
+                else:
+                    latest.unlink()
+            if sharded_ckpt:
+                latest.symlink_to(ckpt_path.name)
+            else:
+                # latest.ckpt is a byte copy — don't pay device_get
+                # + pickle twice.  The checksum sidecar travels with it so
+                # the copy is independently verifiable.
+                shutil.copyfile(ckpt_path, latest)
+                side = sidecar_path(ckpt_path)
+                if side.exists():
+                    shutil.copyfile(side, sidecar_path(latest))
+            if loop.keep_checkpoints:
+                gc_checkpoints(
+                    Path(loop.checkpoint_dir), loop.keep_checkpoints,
+                    log_fn=log_fn,
+                )
+
+        # A synchronous multi-GB save is legitimate silence;
+        # detection suspends and the deadline re-arms on exit.
+        with wd_pause():
+            if async_saver is not None and not sync:
+                # Device→host snapshot happens now; serialization +
+                # IO overlap with the next training steps.
+                async_saver.save(
+                    ckpt_path,
+                    sharded=sharded_ckpt,
+                    on_complete=update_latest,
+                    **state_kwargs,
+                )
+            elif sharded_ckpt:
+                # GSPMD-sharded states stream shard-by-shard into a
+                # checkpoint DIRECTORY — the full tree is never
+                # staged on host in one buffer (FSDP-scale
+                # requirement).
+                save_checkpoint_sharded(ckpt_path, **state_kwargs)
+                update_latest()
+            else:
+                save_checkpoint(ckpt_path, **state_kwargs)
+                update_latest()
+        # The span covers the synchronous portion (async saves
+        # return after the device->host snapshot); discount it from
+        # the throughput window — save time is not step time.
+        timer.exclude(ckpt_handle.end())
+        return ckpt_path
 
     # finally-close so an interrupt/OOM mid-run still flushes the JSONL
     # handle and finishes the wandb run.
@@ -544,9 +734,19 @@ def train(
         # sink/manifest/watchdog setup is not step time.
         timer.snapshot()
         while iteration < loop.steps:
+            # Chaos hooks (no-ops without a BT_FAULTS plan), then the
+            # preemption poll: a SIGTERM/SIGINT that arrived since the last
+            # boundary stops the loop HERE — before more compute — and the
+            # epilogue below writes the emergency checkpoint.
+            injector.at_step(iteration)
+            if stop.triggered:
+                preempted = stop.signame or "signal"
+                break
+            injector.on_batch_read(iteration)
             # Per-iteration seeding (not one stream advanced per step) so a
             # resumed run samples the SAME batch at the same iteration as an
-            # uninterrupted one — preemption-safe determinism.
+            # uninterrupted one — preemption-safe determinism (batch_rng
+            # folds in the post-rollback salt).
             if stride > 1:
                 n = min(stride, loop.steps - iteration)
                 batches = [
@@ -554,7 +754,7 @@ def train(
                         train_data,
                         loop.batch_size,
                         model_config.context_length,
-                        np.random.default_rng((loop.seed, iteration + j)),
+                        batch_rng(iteration + j),
                     )
                     for j in range(n)
                 ]
@@ -577,7 +777,7 @@ def train(
                     x, y = place((x, y))
             else:
                 n = 1
-                step_rng = np.random.default_rng((loop.seed, iteration))
+                step_rng = batch_rng(iteration)
                 x, y = get_batch(
                     train_data, loop.batch_size, model_config.context_length, step_rng
                 )
@@ -608,6 +808,11 @@ def train(
                 params, opt_state, metrics = step_fn(params, opt_state, x, y)
                 timer.update(tokens_per_step * n)
             iteration += n
+            if injector.active:
+                # Chaos: a planned NaN lands in the params HERE (a faithful
+                # stand-in for a bad-batch overflow) so the log-boundary
+                # detection and rollback path below face the real thing.
+                params = injector.poison_params(params, iteration)
 
             is_last = iteration == loop.steps
             if iteration % loop.log_every == 0 or is_last:
@@ -679,6 +884,94 @@ def train(
                         telemetry.event(
                             "nonfinite", step=iteration, fields=bad_fields
                         )
+                    if rollback_mode:
+                        # NaN rollback recovery: reload the last valid
+                        # checkpoint, advance the data window past the
+                        # offending batches, retry — under the crash-loop
+                        # budget (a failure that survives a fresh window is
+                        # not batch-local; escalate instead of looping).
+                        detect_step = iteration
+                        nonfinite_path = record.get("nonfinite_path")
+                        try:
+                            rollbacks = rollback_budget.note(detect_step)
+                        except RollbackExhausted as exc:
+                            telemetry.event(
+                                "recovery_abort",
+                                step=detect_step,
+                                rollbacks=rollback_budget.total,
+                                error=str(exc),
+                            )
+                            raise NonFiniteError(
+                                str(exc), record=record
+                            ) from exc
+                        handle = telemetry.start_span(
+                            "rollback", step=detect_step
+                        )
+                        with wd_pause():
+                            if async_saver is not None:
+                                # A snapshot of the poisoned state must
+                                # never land; join before reloading.
+                                async_saver.wait()
+                            try:
+                                params, opt_state, restored, used = (
+                                    load_state(Path(loop.checkpoint_dir))
+                                )
+                            except Exception as exc:  # noqa: BLE001
+                                telemetry.event(
+                                    "recovery_abort",
+                                    step=detect_step,
+                                    error=repr(exc),
+                                )
+                                raise NonFiniteError(
+                                    "rollback failed: no valid checkpoint "
+                                    f"to restore ({exc}); state dumped to "
+                                    "the telemetry stream",
+                                    record=record,
+                                ) from exc
+                            if mesh is not None and loop.parallel not in (
+                                "dp", "sp", "pp",
+                            ):
+                                # A dense fallback snapshot arrives as host
+                                # arrays; re-place onto the GSPMD mesh
+                                # (no-op for the streaming-loaded case).
+                                params = shard_params(
+                                    params, mesh, loop.parallel
+                                )
+                        timer.exclude(handle.end())
+                        batch_salt += 1
+                        telemetry.emit(
+                            {
+                                "kind": "recovery",
+                                "t": telemetry.now(),
+                                "step": detect_step,
+                                "restored_step": restored,
+                                "rollbacks": rollbacks,
+                                "lost_steps": detect_step - restored,
+                                **(
+                                    {"nonfinite_path": nonfinite_path}
+                                    if nonfinite_path
+                                    else {}
+                                ),
+                            }
+                        )
+                        log_fn(
+                            f"rollback #{rollbacks}: non-finite at step "
+                            f"{detect_step}"
+                            + (
+                                f" (localized to {nonfinite_path})"
+                                if nonfinite_path
+                                else ""
+                            )
+                            + f"; restored {used} at step {restored}, "
+                            "data window advanced"
+                        )
+                        iteration = restored
+                        prev_sync_iteration = iteration
+                        excluded_steps = 0
+                        # Discard the poisoned window's timings: recovery
+                        # time is not step time.
+                        timer.snapshot()
+                        continue
 
             if val_data is not None and (
                 iteration % loop.eval_every == 0 or is_last
@@ -695,70 +988,62 @@ def train(
             if loop.checkpoint_dir is not None and (
                 iteration % loop.checkpoint_every == 0 or is_last
             ):
-                ckpt_handle = telemetry.start_span(
-                    "checkpoint", step=iteration, async_save=async_saver is not None
-                )
-                ckpt_path = Path(loop.checkpoint_dir) / f"step_{iteration:08d}.ckpt"
-                latest = Path(loop.checkpoint_dir) / "latest.ckpt"
-                state_kwargs = dict(
-                    params=params,
-                    opt_state=opt_state,
-                    iteration=iteration,
-                    extra={
-                        "val_loss": None if math.isnan(val_loss) else val_loss,
-                        "train_loss": last_loss,
-                        # Self-describing checkpoints: eval/generate can
-                        # recover the architecture without the user
-                        # re-passing --preset (a mismatched preset crashes
-                        # deep in RoPE with a shape error).
-                        "model_config": dataclasses.asdict(model_config),
-                    },
-                )
+                save_snapshot()
 
-                def update_latest(ckpt_path=ckpt_path, latest=latest):
-                    # A prior run of the other format may have left latest
-                    # as a symlink/dir; clear before re-pointing.
-                    if latest.is_symlink() or latest.exists():
-                        if latest.is_dir() and not latest.is_symlink():
-                            shutil.rmtree(latest)
-                        else:
-                            latest.unlink()
-                    if sharded_ckpt:
-                        latest.symlink_to(ckpt_path.name)
-                    else:
-                        # latest.ckpt is a byte copy — don't pay device_get
-                        # + pickle twice.
-                        shutil.copyfile(ckpt_path, latest)
-
-                # A synchronous multi-GB save is legitimate silence;
-                # detection suspends and the deadline re-arms on exit.
-                with wd_pause():
-                    if async_saver is not None:
-                        # Device→host snapshot happens now; serialization +
-                        # IO overlap with the next training steps.
-                        async_saver.save(
-                            ckpt_path,
-                            sharded=sharded_ckpt,
-                            on_complete=update_latest,
-                            **state_kwargs,
-                        )
-                    elif sharded_ckpt:
-                        # GSPMD-sharded states stream shard-by-shard into a
-                        # checkpoint DIRECTORY — the full tree is never
-                        # staged on host in one buffer (FSDP-scale
-                        # requirement).
-                        save_checkpoint_sharded(ckpt_path, **state_kwargs)
-                        update_latest()
-                    else:
-                        save_checkpoint(ckpt_path, **state_kwargs)
-                        update_latest()
-                # The span covers the synchronous portion (async saves
-                # return after the device->host snapshot); discount it from
-                # the throughput window — save time is not step time.
-                timer.exclude(ckpt_handle.end())
+        if preempted is not None:
+            # Graceful preemption epilogue: an emergency snapshot at the
+            # exact stop boundary (so --resume loses zero completed steps),
+            # then a kind="preemption" record BEFORE the footer — the
+            # stream tells the story even if the slice vanishes next.
+            emergency = None
+            state_poisoned = False
+            if loop.checkpoint_dir is not None:
+                if async_saver is not None:
+                    async_saver.wait()
+                # A SIGTERM can land between a NaN-producing step and the
+                # log boundary that would have detected it; an un-checked
+                # emergency save would then make the poisoned state the
+                # NEWEST snapshot (which rollback-on-resume would restore
+                # over and over until its budget died).  The save already
+                # pays a full device_get — pay the isfinite pass too and
+                # keep the prior clean snapshot as the resume target.
+                state_poisoned = any(
+                    not bool(np.all(np.isfinite(np.asarray(jax.device_get(leaf)))))
+                    for leaf in jax.tree_util.tree_leaves(params)
+                )
+                if not state_poisoned:
+                    emergency = save_snapshot(sync=True)
+            telemetry.emit(
+                {
+                    "kind": "preemption",
+                    "t": telemetry.now(),
+                    "step": iteration,
+                    "signal": preempted,
+                    "checkpoint": str(emergency) if emergency else None,
+                    **(
+                        {"skipped_nonfinite_state": True}
+                        if state_poisoned
+                        else {}
+                    ),
+                }
+            )
+            log_fn(
+                f"preempted by {preempted} at step {iteration}"
+                + (f"; emergency checkpoint {emergency}" if emergency else "")
+                + (
+                    "; emergency save SKIPPED (non-finite state — prior "
+                    "snapshot remains the resume target)"
+                    if state_poisoned
+                    else ""
+                )
+            )
+        # Preemption is a DELIBERATE shutdown: the stream is complete and
+        # footered (the footer's preempted field + the preemption record
+        # distinguish it from a finished run).
         clean_exit = True
 
     finally:
+        stop.uninstall()
         try:
             if async_saver is not None:
                 # Join the in-flight write so a finished run always has its
@@ -777,6 +1062,7 @@ def train(
                 watchdog_nonfinite_events=(
                     wd.nonfinite_events if wd is not None else 0
                 ),
+                **({"preempted": preempted} if preempted else {}),
             )
             # Even if the background write failed, flush the metric sinks —
             # the recorded history matters most when the run just crashed.
@@ -789,7 +1075,15 @@ def train(
         "final_val_loss": None if math.isnan(val_loss) else val_loss,
         "history": history,
     }
+    if preempted is not None:
+        summary["preempted"] = preempted
+        summary["stopped_at_step"] = iteration
+    if rollback_budget is not None and rollback_budget.total:
+        summary["rollbacks"] = rollback_budget.total
     if loop.checkpoint_dir is not None:
-        with open(Path(loop.checkpoint_dir) / "summary.json", "w") as f:
-            json.dump(summary, f, indent=2)
+        from bpe_transformer_tpu.resilience.integrity import atomic_write_json
+
+        # tmp + os.replace (like the checkpoint writers): a kill during the
+        # final write can't leave a truncated summary.json behind.
+        atomic_write_json(Path(loop.checkpoint_dir) / "summary.json", summary)
     return summary

@@ -1,0 +1,958 @@
+"""Chaos suite: every resilience recovery path exercised end-to-end.
+
+The fault-injection harness (resilience/faults.py) drives real failures at
+deterministic points — NaN'd parameters, self-SIGTERM/SIGKILL, corrupted
+checkpoint files, failing dataset reads — and these tests assert the
+system *recovers*: emergency checkpoints on preemption, checksum-verified
+fallback resume past corruption, bounded NaN rollback, and supervised
+respawn with crash-loop breaking.
+
+In-process signal/rollback/fallback tests are tier-1; tests that spawn
+full CLI child processes (each paying a fresh jax import + compile) are
+marked ``slow``.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import stat
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from bpe_transformer_tpu.checkpointing import (
+    CheckpointCorruptionError,
+    load_checkpoint,
+    load_checkpoint_with_fallback,
+    save_checkpoint,
+    save_checkpoint_sharded,
+)
+from bpe_transformer_tpu.models import ModelConfig
+from bpe_transformer_tpu.resilience import (
+    EXIT_PREEMPTED,
+    FaultInjector,
+    FaultPlan,
+    GracefulShutdown,
+    RollbackBudget,
+    RollbackExhausted,
+    atomic_write_json,
+    corrupt_file,
+    gc_checkpoints,
+    latest_valid_checkpoint,
+    quarantine,
+    supervise,
+    verify_checkpoint,
+)
+from bpe_transformer_tpu.telemetry.watchdog import NonFiniteError
+from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+from bpe_transformer_tpu.training.cli import main as cli_main
+
+REPO = Path(__file__).resolve().parent.parent
+
+TINY = ModelConfig(
+    vocab_size=128, context_length=16, d_model=32,
+    num_layers=2, num_heads=2, d_ff=64,
+)
+HP = TrainHParams(warmup_iters=2, cosine_cycle_iters=50)
+
+
+@pytest.fixture(scope="module")
+def ramp_data():
+    return np.tile(np.arange(TINY.vocab_size, dtype=np.uint16), 200)
+
+
+def _read_jsonl(path):
+    return [json.loads(line) for line in Path(path).read_text().splitlines()]
+
+
+def _quiet(*_a, **_k):
+    pass
+
+
+# ---------------------------------------------------------------- integrity
+
+
+def test_dense_checkpoint_sidecar_and_verify(tmp_path):
+    """Dense saves stamp a CRC32 sidecar; verify passes clean files, fails
+    a bit flip (size unchanged — only a checksum catches it) and a
+    truncation."""
+    path = tmp_path / "step_00000002.ckpt"
+    save_checkpoint(path, params={"w": np.arange(8.0)}, iteration=2)
+    assert (tmp_path / "step_00000002.ckpt.crc32.json").exists()
+    assert verify_checkpoint(path).ok
+
+    corrupt_file(path, mode="flip")
+    result = verify_checkpoint(path)
+    assert not result.ok
+    assert any("crc32 mismatch" in p for p in result.problems)
+
+    save_checkpoint(path, params={"w": np.arange(8.0)}, iteration=2)
+    corrupt_file(path, mode="truncate", nbytes=16)
+    result = verify_checkpoint(path)
+    assert not result.ok
+    assert any("truncated" in p for p in result.problems)
+
+
+def test_dense_checkpoint_without_sidecar_passes_with_warning(tmp_path):
+    """A pre-integrity checkpoint (no sidecar) is NOT treated as corrupt —
+    absence of evidence only warns."""
+    path = tmp_path / "old.ckpt"
+    save_checkpoint(path, params={"w": np.ones(3)}, iteration=1)
+    (tmp_path / "old.ckpt.crc32.json").unlink()
+    result = verify_checkpoint(path)
+    assert result.ok
+    assert result.warnings
+
+
+def test_sharded_manifest_checksums_and_verify(tmp_path):
+    """Sharded saves stamp per-file CRC32s into the manifest; a truncated
+    shard is detected BY NAME, and a mangled manifest fails outright."""
+    path = tmp_path / "sh.ckpt"
+    save_checkpoint_sharded(
+        path, params={"w": np.arange(12.0).reshape(3, 4), "b": np.ones(3)},
+        iteration=7,
+    )
+    manifest = json.loads((path / "manifest.json").read_text())
+    assert "treedef.pkl" in manifest["checksums"]
+    assert sum(1 for f in manifest["checksums"] if f.endswith(".npy")) == 2
+    assert verify_checkpoint(path).ok
+
+    corrupt_file(path / "leaf_00001.npy", mode="truncate", nbytes=8)
+    result = verify_checkpoint(path)
+    assert not result.ok
+    assert any("leaf_00001.npy" in p for p in result.problems)
+
+    (path / "manifest.json").write_text("{not json")
+    assert not verify_checkpoint(path).ok
+
+
+def test_verify_missing_checkpoint(tmp_path):
+    result = verify_checkpoint(tmp_path / "nope.ckpt")
+    assert not result.ok and result.format == "missing"
+
+
+def test_quarantine_moves_snapshot_and_sidecar(tmp_path):
+    path = tmp_path / "step_00000004.ckpt"
+    save_checkpoint(path, params={"w": np.ones(2)}, iteration=4)
+    moved = quarantine(path)
+    assert moved.name == "step_00000004.ckpt.corrupt"
+    assert not path.exists()
+    assert moved.exists()
+    assert moved.with_name(moved.name + ".crc32.json").exists()
+    # Quarantined snapshots are invisible to discovery.
+    assert latest_valid_checkpoint(tmp_path) is None
+
+
+def test_load_fallback_quarantines_and_uses_prior_snapshot(tmp_path):
+    """A corrupt newest snapshot falls back to the newest PRIOR valid one;
+    the corrupt copy is quarantined (never deleted)."""
+    for step in (2, 4):
+        save_checkpoint(
+            tmp_path / f"step_{step:08d}.ckpt",
+            params={"w": np.full(4, float(step))},
+            iteration=step,
+        )
+    corrupt_file(tmp_path / "step_00000004.ckpt", mode="flip")
+    payload, used = load_checkpoint_with_fallback(
+        tmp_path / "step_00000004.ckpt"
+    )
+    assert used.name == "step_00000002.ckpt"
+    assert payload["iteration"] == 2
+    assert (tmp_path / "step_00000004.ckpt.corrupt").exists()
+
+    # Everything corrupt -> a structured error, with the bad snapshots
+    # quarantined along the way.
+    corrupt_file(tmp_path / "step_00000002.ckpt", mode="truncate")
+    with pytest.raises(CheckpointCorruptionError):
+        load_checkpoint_with_fallback(tmp_path / "step_00000002.ckpt")
+
+
+def test_fallback_never_fast_forwards_past_requested_snapshot(tmp_path):
+    """An explicitly requested OLD snapshot that fails must not silently
+    resume from a NEWER sibling (re-branching before a divergence is a
+    deliberate act); only strictly-prior snapshots are candidates."""
+    for step in (2, 4, 9):
+        save_checkpoint(
+            tmp_path / f"step_{step:08d}.ckpt",
+            params={"w": np.full(2, float(step))}, iteration=step,
+        )
+    corrupt_file(tmp_path / "step_00000004.ckpt", mode="flip")
+    payload, used = load_checkpoint_with_fallback(
+        tmp_path / "step_00000004.ckpt"
+    )
+    assert used.name == "step_00000002.ckpt"  # prior, never step_9
+    assert payload["iteration"] == 2
+
+
+def test_load_failure_of_verified_snapshot_reraises_without_quarantine(
+    tmp_path,
+):
+    """Intact bytes that fail to LOAD are a caller/config/environment
+    error, not corruption: the error surfaces and nothing is renamed —
+    a one-flag typo must not serially quarantine valid snapshots."""
+    for step in (2, 4):
+        save_checkpoint(
+            tmp_path / f"step_{step:08d}.ckpt",
+            params={"w": np.ones(2)}, iteration=step,
+        )
+
+    def exploding_loader(path):
+        raise RuntimeError("mesh mismatch: pp axis is 2, checkpoint has 4")
+
+    with pytest.raises(RuntimeError, match="mesh mismatch"):
+        load_checkpoint_with_fallback(
+            tmp_path / "step_00000004.ckpt", loader=exploding_loader
+        )
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert not any(".corrupt" in n for n in names)
+
+
+def test_verify_fast_mode_skips_crc_but_catches_truncation(tmp_path):
+    path = tmp_path / "step_00000002.ckpt"
+    save_checkpoint(path, params={"w": np.arange(64.0)}, iteration=2)
+    assert verify_checkpoint(path, deep=False).ok
+    corrupt_file(path, mode="flip")
+    # Fast mode trades bit-rot detection for O(stat) cost...
+    assert verify_checkpoint(path, deep=False).ok
+    assert not verify_checkpoint(path).ok
+    # ...but still catches truncation via the size record.
+    corrupt_file(path, mode="truncate", nbytes=8)
+    assert not verify_checkpoint(path, deep=False).ok
+
+
+def test_resume_falls_back_past_corrupt_snapshot(ramp_data, tmp_path):
+    """ACCEPTANCE (b): train -> corrupt the newest snapshot AND the latest
+    copy -> resume detects it by checksum, falls back to the prior
+    snapshot, and the resumed run completes."""
+    ckpt = tmp_path / "ckpt"
+    loop = LoopConfig(
+        steps=10, batch_size=4, log_every=5, eval_every=1000,
+        checkpoint_every=5, checkpoint_dir=str(ckpt),
+    )
+    train(TINY, HP, loop, ramp_data, log_fn=_quiet)
+    assert (ckpt / "step_00000005.ckpt").exists()
+    corrupt_file(ckpt / "step_00000010.ckpt", mode="flip")
+    corrupt_file(ckpt / "latest.ckpt", mode="truncate")
+
+    summary = train(
+        TINY, HP, dataclasses.replace(loop, steps=15), ramp_data,
+        resume_from=ckpt, log_fn=_quiet,
+    )
+    assert summary["history"][-1]["step"] == 15
+    # Restart point was the fallback snapshot: steps 6-10 were retrained.
+    assert summary["history"][0]["step"] == 10
+    corrupted = {p.name for p in ckpt.iterdir() if ".corrupt" in p.name}
+    assert any("latest.ckpt.corrupt" in n for n in corrupted)
+    assert any("step_00000010.ckpt.corrupt" in n for n in corrupted)
+
+
+# ---------------------------------------------------------- verify-ckpt CLI
+
+
+def test_verify_checkpoint_cli_smoke(tmp_path, capsys):
+    path = tmp_path / "m.ckpt"
+    save_checkpoint(path, params={"w": np.ones(4)}, iteration=3)
+    assert cli_main(["verify-checkpoint", str(path)]) == 0
+    assert "OK" in capsys.readouterr().out
+
+    corrupt_file(path, mode="flip")
+    assert cli_main(["verify-checkpoint", str(path), "--json"]) == 1
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["ok"] is False
+    assert verdict["format"] == "dense"
+
+
+def test_verify_checkpoint_cli_is_jax_free(tmp_path):
+    """The fast path never imports jax — safe on a login host while the
+    pod trains (and fast: no backend init)."""
+    path = tmp_path / "m.ckpt"
+    save_checkpoint(path, params={"w": np.ones(4)}, iteration=3)
+    code = textwrap.dedent(
+        f"""
+        import sys
+        from bpe_transformer_tpu.training.cli import main
+        rc = main(["verify-checkpoint", {str(path)!r}])
+        assert rc == 0, rc
+        assert "jax" not in sys.modules, "verify-checkpoint imported jax"
+        """
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+# --------------------------------------------------------------- preemption
+
+
+def test_graceful_shutdown_flag_and_double_signal():
+    stop = GracefulShutdown()
+    assert stop.install()
+    try:
+        assert not stop.triggered
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert stop.triggered
+        assert stop.signame == "SIGTERM"
+        # The second signal escalates: cooperative window is over.
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGTERM)
+    finally:
+        stop.uninstall()
+
+
+def test_preemption_writes_emergency_checkpoint_and_resumes(
+    ramp_data, tmp_path
+):
+    """ACCEPTANCE (a), in-process: SIGTERM mid-run -> stop at the next step
+    boundary, emergency checkpoint, kind="preemption" record, footered
+    stream — and --resume continues from the exact stop step."""
+    ckpt = tmp_path / "ckpt"
+    jsonl = tmp_path / "m.jsonl"
+    loop = LoopConfig(
+        steps=20, batch_size=4, log_every=2, eval_every=1000,
+        checkpoint_every=20, checkpoint_dir=str(ckpt),
+        metrics_jsonl=str(jsonl),
+    )
+    injector = FaultInjector(FaultPlan(preempt_at_step=6))
+    summary = train(
+        TINY, HP, loop, ramp_data, log_fn=_quiet, fault_injector=injector
+    )
+    assert summary["preempted"] == "SIGTERM"
+    stop_step = summary["stopped_at_step"]
+    # Stopped within one log window of the signal, never before it.
+    assert 6 <= stop_step <= 6 + loop.log_every
+
+    records = _read_jsonl(jsonl)
+    pre = [r for r in records if r.get("kind") == "preemption"]
+    assert len(pre) == 1
+    assert pre[0]["signal"] == "SIGTERM"
+    assert pre[0]["step"] == stop_step
+    emergency = Path(pre[0]["checkpoint"])
+    assert emergency.exists()
+    assert verify_checkpoint(emergency).ok
+    footer = records[-1]
+    assert footer["kind"] == "footer"
+    assert footer["clean"] is True and footer["preempted"] == "SIGTERM"
+
+    resumed = train(
+        TINY, HP, loop, ramp_data, resume_from=ckpt, log_fn=_quiet
+    )
+    assert "preempted" not in resumed
+    assert resumed["history"][-1]["step"] == 20
+    # Zero completed steps lost: the resume started at the stop step.
+    assert load_checkpoint(ckpt / "latest.ckpt")["iteration"] == 20
+
+
+def test_preemption_skips_emergency_save_of_poisoned_state(
+    ramp_data, tmp_path
+):
+    """A SIGTERM landing between a NaN-producing step and the detection
+    boundary must NOT snapshot the poisoned state — the prior clean
+    snapshot stays the newest resume target (else rollback-on-resume would
+    restore the NaN over and over until its budget died)."""
+    ckpt = tmp_path / "ckpt"
+    jsonl = tmp_path / "m.jsonl"
+    loop = LoopConfig(
+        steps=40, batch_size=4, log_every=1000, eval_every=1000,
+        checkpoint_every=4, checkpoint_dir=str(ckpt),
+        metrics_jsonl=str(jsonl),
+    )
+    # NaN fires after step 5; SIGTERM at the step-6 boundary — before any
+    # log boundary could detect the poison.
+    injector = FaultInjector(FaultPlan(nan_at_step=5, preempt_at_step=6))
+    summary = train(
+        TINY, HP, loop, ramp_data, log_fn=_quiet, fault_injector=injector
+    )
+    assert summary["preempted"] == "SIGTERM"
+    pre = [r for r in _read_jsonl(jsonl) if r.get("kind") == "preemption"][0]
+    assert pre["checkpoint"] is None
+    assert pre["skipped_nonfinite_state"] is True
+    # The clean step-4 snapshot is still the newest resume target.
+    assert load_checkpoint(ckpt / "latest.ckpt")["iteration"] == 4
+    assert latest_valid_checkpoint(ckpt) is not None
+
+
+def test_preemption_without_checkpoint_dir_still_records(ramp_data, tmp_path):
+    jsonl = tmp_path / "m.jsonl"
+    loop = LoopConfig(
+        steps=20, batch_size=4, log_every=2, eval_every=1000,
+        checkpoint_every=1000, metrics_jsonl=str(jsonl),
+    )
+    injector = FaultInjector(FaultPlan(preempt_at_step=4))
+    summary = train(
+        TINY, HP, loop, ramp_data, log_fn=_quiet, fault_injector=injector
+    )
+    assert summary["preempted"] == "SIGTERM"
+    pre = [r for r in _read_jsonl(jsonl) if r.get("kind") == "preemption"]
+    assert pre and pre[0]["checkpoint"] is None
+
+
+# ----------------------------------------------------------- NaN rollback
+
+
+def test_nan_rollback_recovers_and_localizes(ramp_data, tmp_path):
+    """ACCEPTANCE (c): an injected NaN under on_nonfinite="rollback"
+    reloads the last checkpoint, advances the data window, and the run
+    reaches its final step — with kind="recovery" records naming the
+    tensor path (PR-4 dynamics localization)."""
+    ckpt = tmp_path / "ckpt"
+    jsonl = tmp_path / "m.jsonl"
+    loop = LoopConfig(
+        steps=24, batch_size=4, log_every=4, eval_every=1000,
+        checkpoint_every=8, checkpoint_dir=str(ckpt),
+        metrics_jsonl=str(jsonl), dynamics_every=4,
+        watchdog=True, watchdog_policy="rollback", max_rollbacks=3,
+    )
+    injector = FaultInjector(FaultPlan(nan_at_step=10))
+    summary = train(
+        TINY, HP, loop, ramp_data, log_fn=_quiet, fault_injector=injector
+    )
+    assert summary["history"][-1]["step"] == 24
+    assert np.isfinite(summary["final_train_loss"])
+    assert summary["rollbacks"] == 1
+
+    records = _read_jsonl(jsonl)
+    rec = [r for r in records if r.get("kind") == "recovery"]
+    assert len(rec) == 1
+    assert rec[0]["restored_step"] == 8
+    assert rec[0]["step"] == 12
+    assert rec[0]["lost_steps"] == 4
+    assert rec[0]["nonfinite_path"].startswith("params/")
+    # The dump-then-act contract: the nonfinite event landed too.
+    assert any(
+        r.get("kind") == "event" and r.get("name") == "nonfinite"
+        for r in records
+    )
+    footer = records[-1]
+    assert footer["clean"] is True
+
+
+def test_rollback_budget_breaker():
+    budget = RollbackBudget(max_rollbacks=2, min_progress_steps=5)
+    assert budget.note(10) == 1          # first: always allowed
+    assert budget.note(12) == 2          # only 2 steps of progress
+    with pytest.raises(RollbackExhausted):
+        budget.note(13)                  # third without progress: trip
+    # Progress resets the consecutive counter.
+    budget = RollbackBudget(max_rollbacks=2, min_progress_steps=5)
+    budget.note(10)
+    budget.note(12)
+    assert budget.note(40) == 3          # 28 steps of progress: forgiven
+    # max_rollbacks=0 means the first detection aborts.
+    with pytest.raises(RollbackExhausted):
+        RollbackBudget(max_rollbacks=0).note(1)
+
+
+def test_rollback_exhaustion_aborts_loop(ramp_data, tmp_path):
+    """The loop-level breaker: with max_rollbacks=0 the first non-finite
+    detection escalates to NonFiniteError (after dumping evidence)."""
+    loop = LoopConfig(
+        steps=24, batch_size=4, log_every=4, eval_every=1000,
+        checkpoint_every=8, checkpoint_dir=str(tmp_path / "ckpt"),
+        metrics_jsonl=str(tmp_path / "m.jsonl"),
+        watchdog=True, watchdog_policy="rollback", max_rollbacks=0,
+    )
+    injector = FaultInjector(FaultPlan(nan_at_step=10))
+    with pytest.raises(NonFiniteError, match="rollback budget exhausted"):
+        train(
+            TINY, HP, loop, ramp_data, log_fn=_quiet,
+            fault_injector=injector,
+        )
+    events = [
+        r for r in _read_jsonl(tmp_path / "m.jsonl")
+        if r.get("kind") == "event" and r.get("name") == "recovery_abort"
+    ]
+    assert events
+
+
+def test_rollback_without_any_checkpoint_aborts(ramp_data, tmp_path):
+    """NaN before the first checkpoint: nothing to restore -> escalate
+    rather than loop."""
+    loop = LoopConfig(
+        steps=24, batch_size=4, log_every=4, eval_every=1000,
+        checkpoint_every=1000, checkpoint_dir=str(tmp_path / "ckpt"),
+        watchdog=True, watchdog_policy="rollback",
+    )
+    injector = FaultInjector(FaultPlan(nan_at_step=2))
+    with pytest.raises(NonFiniteError, match="no valid checkpoint"):
+        train(
+            TINY, HP, loop, ramp_data, log_fn=_quiet,
+            fault_injector=injector,
+        )
+
+
+def test_rollback_config_validation(ramp_data, tmp_path):
+    base = dict(steps=8, batch_size=4, watchdog=True,
+                watchdog_policy="rollback")
+    with pytest.raises(ValueError, match="needs checkpoint_dir"):
+        train(TINY, HP, LoopConfig(**base), ramp_data, log_fn=_quiet)
+    with pytest.raises(ValueError, match="multiple of log_every"):
+        train(
+            TINY, HP,
+            LoopConfig(**base, checkpoint_dir=str(tmp_path), log_every=4,
+                       checkpoint_every=6),
+            ramp_data, log_fn=_quiet,
+        )
+    with pytest.raises(ValueError, match='parallel="pp"'):
+        train(
+            TINY, HP,
+            LoopConfig(**base, checkpoint_dir=str(tmp_path), parallel="pp",
+                       mesh_axes={"pp": 2}),
+            ramp_data, log_fn=_quiet,
+        )
+
+
+# ---------------------------------------------------------------- retention
+
+
+def test_gc_keeps_newest_protects_latest_and_corrupt(tmp_path):
+    for step in (2, 4, 6, 8):
+        save_checkpoint(
+            tmp_path / f"step_{step:08d}.ckpt",
+            params={"w": np.ones(2)}, iteration=step,
+        )
+    # latest points (symlink) at an OLD snapshot — must survive GC anyway.
+    (tmp_path / "latest.ckpt").symlink_to("step_00000004.ckpt")
+    quarantine(tmp_path / "step_00000002.ckpt")
+    # Stranded crash debris, older than every snapshot.
+    debris = tmp_path / "step_00000004.ckpt.tmpXYZ"
+    debris.write_bytes(b"partial")
+    old = time.time() - 3600
+    os.utime(debris, (old, old))
+
+    removed = gc_checkpoints(tmp_path, keep=1)
+    names = {p.name for p in tmp_path.iterdir()}
+    assert "step_00000008.ckpt" in names          # newest kept
+    assert "step_00000004.ckpt" in names          # latest's target kept
+    assert "step_00000006.ckpt" not in names      # rotated out
+    assert "step_00000002.ckpt.corrupt" in names  # evidence kept
+    assert "step_00000004.ckpt.tmpXYZ" not in names  # debris reclaimed
+    assert {p.name for p in removed} >= {
+        "step_00000006.ckpt", "step_00000004.ckpt.tmpXYZ",
+    }
+
+
+def test_loop_retention_gc(ramp_data, tmp_path):
+    ckpt = tmp_path / "ckpt"
+    loop = LoopConfig(
+        steps=15, batch_size=4, log_every=5, eval_every=1000,
+        checkpoint_every=5, checkpoint_dir=str(ckpt), keep_checkpoints=2,
+    )
+    train(TINY, HP, loop, ramp_data, log_fn=_quiet)
+    snapshots = sorted(
+        p.name for p in ckpt.iterdir()
+        if p.name.startswith("step_") and p.name.endswith(".ckpt")
+    )
+    assert snapshots == ["step_00000010.ckpt", "step_00000015.ckpt"]
+    assert (ckpt / "latest.ckpt").exists()
+    assert load_checkpoint(ckpt / "latest.ckpt")["iteration"] == 15
+
+
+# ------------------------------------------------------- atomic JSON writes
+
+
+def test_atomic_write_json_replaces_and_survives_failure(tmp_path):
+    target = tmp_path / "summary.json"
+    atomic_write_json(target, {"ok": 1})
+    assert json.loads(target.read_text()) == {"ok": 1}
+
+    class Boom:
+        """json.dump raises mid-serialization."""
+
+        def __iter__(self):
+            raise RuntimeError("boom")
+
+    with pytest.raises(TypeError):
+        atomic_write_json(target, {"bad": Boom()})
+    # Original intact, no tmp litter.
+    assert json.loads(target.read_text()) == {"ok": 1}
+    assert [p.name for p in tmp_path.iterdir()] == ["summary.json"]
+
+
+# ------------------------------------------------------- dataset validation
+
+
+def test_token_file_geometry_validation(tmp_path):
+    from bpe_transformer_tpu.data import check_dataset_geometry, load_token_file
+
+    empty = tmp_path / "empty.bin"
+    empty.write_bytes(b"")
+    with pytest.raises(ValueError, match="empty"):
+        load_token_file(empty)
+
+    odd = tmp_path / "odd.bin"
+    odd.write_bytes(b"\x00" * 7)
+    with pytest.raises(ValueError, match="not a multiple"):
+        load_token_file(odd, "uint16")
+
+    with pytest.raises(FileNotFoundError):
+        load_token_file(tmp_path / "missing.bin")
+
+    with pytest.raises(ValueError, match="context_length \\+ 1"):
+        check_dataset_geometry(np.zeros(10, np.uint16), 16, 4)
+
+
+def test_train_rejects_undersized_dataset_up_front(tmp_path):
+    tiny = np.zeros(TINY.context_length, dtype=np.uint16)  # one short
+    with pytest.raises(ValueError, match="too short"):
+        train(
+            TINY, HP, LoopConfig(steps=4, batch_size=4), tiny,
+            log_fn=_quiet,
+        )
+
+
+def test_injected_dataset_read_failure_crashes_cleanly(ramp_data, tmp_path):
+    """The fail-read fault surfaces as the injected OSError (supervisor
+    respawn territory) and the telemetry stream still gets its footer."""
+    jsonl = tmp_path / "m.jsonl"
+    injector = FaultInjector(FaultPlan(fail_read_at_step=3))
+    with pytest.raises(OSError, match="injected dataset read failure"):
+        train(
+            TINY, HP,
+            LoopConfig(steps=8, batch_size=4, log_every=2,
+                       metrics_jsonl=str(jsonl)),
+            ramp_data, log_fn=_quiet, fault_injector=injector,
+        )
+    footer = _read_jsonl(jsonl)[-1]
+    assert footer["kind"] == "footer" and footer["clean"] is False
+
+
+# --------------------------------------------------------------- supervisor
+
+
+def _stub_child(tmp_path, script_body: str) -> list[str]:
+    """A jax-free stand-in for the training child: the supervisor only
+    sees argv + exit codes, so the protocol is testable in milliseconds."""
+    script = tmp_path / "child.py"
+    script.write_text(textwrap.dedent(script_body))
+    script.chmod(script.stat().st_mode | stat.S_IXUSR)
+    return [sys.executable, str(script)]
+
+
+def test_supervisor_respawns_until_success_with_auto_resume(tmp_path):
+    """ACCEPTANCE (d), protocol level: crash -> preemption -> success, each
+    respawn auto-resuming from the newest VALID snapshot (the corrupt
+    newer one is skipped)."""
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    for step in (2, 4):
+        save_checkpoint(
+            ckpt / f"step_{step:08d}.ckpt",
+            params={"w": np.ones(2)}, iteration=step,
+        )
+    # Truncation, not a bit flip: the supervisor scans in FAST mode
+    # (sizes only — a deep CRC sweep per respawn would triple the restart
+    # I/O on multi-GB snapshots); bit rot is the child's deep re-verify's
+    # job at load time.
+    corrupt_file(ckpt / "step_00000004.ckpt", mode="truncate")
+
+    state = tmp_path / "runs"
+    child = _stub_child(
+        tmp_path,
+        f"""
+        import json, sys
+        from pathlib import Path
+        state = Path({str(state)!r})
+        state.mkdir(exist_ok=True)
+        n = len(list(state.glob("run_*")))
+        (state / f"run_{{n}}.json").write_text(json.dumps(sys.argv[1:]))
+        sys.exit([82, {EXIT_PREEMPTED}, 0][n])
+        """,
+    )
+    rc = supervise(
+        ["train", "--steps", "9", "--checkpoint-dir", str(ckpt)],
+        ckpt,
+        max_restarts=3,
+        backoff_s=0.01,
+        child_cmd=child,
+        log=_quiet,
+        sleep=lambda _s: None,
+    )
+    assert rc == 0
+    runs = sorted(state.glob("run_*.json"))
+    assert len(runs) == 3
+    for run in runs:
+        argv = json.loads(run.read_text())
+        # Auto-resume targets the newest snapshot that VERIFIES — the
+        # corrupt step_4 is skipped in favor of step_2.
+        assert argv[argv.index("--resume") + 1].endswith("step_00000002.ckpt")
+        assert "--supervise" not in argv
+
+
+def test_supervisor_preserves_user_warm_start_resume(tmp_path):
+    """With no supervisor snapshot yet, a user-supplied --resume (a
+    warm-start from elsewhere) must reach the first child unchanged — and
+    be replaced only once the supervisor has its own newer snapshot."""
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    warm = tmp_path / "pretrained.ckpt"
+    state = tmp_path / "runs"
+    child = _stub_child(
+        tmp_path,
+        f"""
+        import json, sys
+        from pathlib import Path
+        state = Path({str(state)!r}); state.mkdir(exist_ok=True)
+        n = len(list(state.glob("run_*")))
+        (state / f"run_{{n}}.json").write_text(json.dumps(sys.argv[1:]))
+        if n == 0:
+            # First run "trains a bit": leave a valid snapshot behind.
+            sys.path.insert(0, {str(REPO)!r})
+            import numpy as np
+            from bpe_transformer_tpu.checkpointing import save_checkpoint
+            save_checkpoint(
+                Path({str(ckpt)!r}) / "step_00000006.ckpt",
+                params={{"w": np.ones(2)}}, iteration=6,
+            )
+            sys.exit(1)
+        sys.exit(0)
+        """,
+    )
+    rc = supervise(
+        ["train", "--resume", str(warm)], ckpt,
+        max_restarts=2, backoff_s=0.01,
+        child_cmd=child, log=_quiet, sleep=lambda _s: None,
+    )
+    assert rc == 0
+    first = json.loads((state / "run_0.json").read_text())
+    second = json.loads((state / "run_1.json").read_text())
+    assert first[first.index("--resume") + 1] == str(warm)
+    assert second[second.index("--resume") + 1].endswith(
+        "step_00000006.ckpt"
+    )
+
+
+def test_supervisor_crash_loop_breaker(tmp_path):
+    """A child that always crashes without checkpoint progress exhausts
+    max_restarts and the supervisor propagates its exit code."""
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    state = tmp_path / "runs"
+    child = _stub_child(
+        tmp_path,
+        f"""
+        import sys
+        from pathlib import Path
+        state = Path({str(state)!r}); state.mkdir(exist_ok=True)
+        (state / f"run_{{len(list(state.glob('run_*')))}}").touch()
+        sys.exit(7)
+        """,
+    )
+    rc = supervise(
+        ["train"], ckpt, max_restarts=2, backoff_s=0.01,
+        child_cmd=child, log=_quiet, sleep=lambda _s: None,
+    )
+    assert rc == 7
+    assert len(list(state.glob("run_*"))) == 3  # initial + 2 restarts
+
+
+def test_supervisor_forwards_stop_signal_and_does_not_respawn(tmp_path):
+    """Under docker/k8s the preemption SIGTERM lands on the supervisor
+    (often PID 1): it must forward the signal to the child (whose graceful
+    path runs) and then STOP — a signalled supervisor is being told to
+    exit, not to restart."""
+    import threading
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    state = tmp_path / "runs"
+    child = _stub_child(
+        tmp_path,
+        f"""
+        import signal, sys, time
+        from pathlib import Path
+        state = Path({str(state)!r}); state.mkdir(exist_ok=True)
+        (state / f"run_{{len(list(state.glob('run_*')))}}").touch()
+        signal.signal(signal.SIGTERM, lambda *a: sys.exit({EXIT_PREEMPTED}))
+        time.sleep(60)
+        sys.exit(0)
+        """,
+    )
+    threading.Timer(
+        1.5, lambda: os.kill(os.getpid(), signal.SIGTERM)
+    ).start()
+    rc = supervise(
+        ["train"], ckpt, max_restarts=3, backoff_s=0.01,
+        child_cmd=child, log=_quiet,
+    )
+    assert rc == EXIT_PREEMPTED
+    assert len(list(state.glob("run_*"))) == 1  # no respawn after the stop
+
+
+def test_supervisor_flag_stripping():
+    from bpe_transformer_tpu.resilience.supervisor import (
+        strip_supervisor_flags,
+    )
+
+    argv = [
+        "train", "--supervise", "--max-restarts", "4",
+        "--restart-backoff=0.5", "--steps", "10",
+    ]
+    assert strip_supervisor_flags(argv) == ["train", "--steps", "10"]
+
+
+# ---------------------------------------------------- report/monitor surface
+
+
+def test_report_recovery_section_pinned_by_fixture():
+    from bpe_transformer_tpu.telemetry.report import (
+        load_records,
+        render_report,
+        summarize,
+    )
+
+    records = load_records(REPO / "tests" / "fixtures" / "recovery_tiny.jsonl")
+    s = summarize(records)
+    rc = s["recovery"]
+    assert rc["rollbacks"] == 1
+    assert rc["lost_steps_total"] == 4
+    assert rc["nonfinite_paths"] == ["params/layers.0.attn.k_proj"]
+    assert rc["preemptions"][0]["signal"] == "SIGTERM"
+    text = render_report(records)
+    assert "== recovery ==" in text
+    assert "rollback #1: step 12 -> restored 8" in text
+    assert "preemption at step 18 (SIGTERM" in text
+    assert any("preempted at step 18" in a for a in s["anomalies"])
+
+
+def test_monitor_folds_recovery_and_preemption():
+    from bpe_transformer_tpu.telemetry.monitor import fold_records, render_frame
+    from bpe_transformer_tpu.telemetry.report import load_records
+
+    records = load_records(REPO / "tests" / "fixtures" / "recovery_tiny.jsonl")
+    state = fold_records(records)
+    assert state["rollbacks"] == 1
+    assert state["preempted"] == "SIGTERM"
+    frame = render_frame(state, "fixture")
+    assert "rollbacks 1" in frame
+    assert "[preempted SIGTERM]" in frame
+
+
+def test_new_record_kinds_registered():
+    from bpe_transformer_tpu.telemetry.schema import (
+        RECORD_SCHEMAS,
+        validate_record,
+    )
+
+    assert "preemption" in RECORD_SCHEMAS
+    assert "recovery" in RECORD_SCHEMAS
+    assert validate_record(
+        {"kind": "recovery", "t": 1.0, "step": 8, "restored_step": 4,
+         "rollbacks": 1}
+    ) == []
+    assert validate_record({"kind": "preemption", "t": 1.0, "step": 8}) != []
+
+
+# ------------------------------------------------- process-level chaos (slow)
+
+
+def _spawn_cli_train(ckpt_dir, jsonl, data_path, steps, extra=()):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "bpe_transformer_tpu.training.cli",
+            "train",
+            "--data", str(data_path),
+            "--model-config", str(Path(ckpt_dir).parent / "model.json"),
+            "--steps", str(steps),
+            "--batch-size", "4",
+            "--log-every", "2",
+            "--eval-every", "1000",
+            "--checkpoint-every", "50",
+            "--checkpoint-dir", str(ckpt_dir),
+            "--metrics-jsonl", str(jsonl),
+            "--warmup", "2",
+            *extra,
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+@pytest.fixture()
+def cli_workspace(tmp_path, ramp_data):
+    (tmp_path / "tokens.bin").write_bytes(ramp_data.tobytes())
+    TINY.to_json(tmp_path / "model.json")
+    return tmp_path
+
+
+@pytest.mark.slow
+def test_cli_sigterm_exit_code_and_resume(cli_workspace):
+    """ACCEPTANCE (a), process level: SIGTERM a real CLI run mid-training
+    -> EXIT_PREEMPTED + emergency checkpoint; a resume run completes with
+    exit 0."""
+    ckpt = cli_workspace / "ckpt"
+    jsonl = cli_workspace / "m.jsonl"
+    proc = _spawn_cli_train(ckpt, jsonl, cli_workspace / "tokens.bin", 4000)
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline and not jsonl.exists():
+            time.sleep(0.2)
+        # Let a couple of log windows land so the kill is mid-run.
+        while time.time() < deadline:
+            if jsonl.exists() and len(jsonl.read_text().splitlines()) >= 4:
+                break
+            time.sleep(0.2)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == EXIT_PREEMPTED, out
+    pre = [r for r in _read_jsonl(jsonl) if r.get("kind") == "preemption"]
+    assert pre and Path(pre[0]["checkpoint"]).exists()
+    kill_step = pre[0]["step"]
+
+    resume = _spawn_cli_train(
+        ckpt, jsonl, cli_workspace / "tokens.bin", kill_step + 6,
+        extra=("--resume", str(ckpt)),
+    )
+    out2, _ = resume.communicate(timeout=240)
+    assert resume.returncode == 0, out2
+    summary = json.loads(out2.strip().splitlines()[-1])
+    assert summary["steps"] == kill_step + 6
+
+
+@pytest.mark.slow
+def test_supervisor_end_to_end_kill_and_resume(cli_workspace):
+    """ACCEPTANCE (d), process level: BT_FAULTS SIGKILLs the first child at
+    step 12; the supervisor respawns with auto-resume (once_dir marker
+    keeps the fault from re-firing) and the run completes."""
+    ckpt = cli_workspace / "ckpt"
+    once = cli_workspace / "once"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "BT_FAULTS": json.dumps(
+            {"kill_at_step": 12, "once_dir": str(once)}
+        ),
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "bpe_transformer_tpu.training.cli",
+            "train", "--supervise",
+            "--data", str(cli_workspace / "tokens.bin"),
+            "--model-config", str(cli_workspace / "model.json"),
+            "--steps", "16", "--batch-size", "4",
+            "--log-every", "2", "--eval-every", "1000",
+            "--checkpoint-every", "10",
+            "--checkpoint-dir", str(ckpt),
+            "--warmup", "2",
+            "--max-restarts", "3", "--restart-backoff", "0.1",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert (once / "kill.fired").exists()
+    summary = json.loads((ckpt / "summary.json").read_text())
+    assert summary["history"][-1]["step"] == 16
+    assert load_checkpoint(ckpt / "latest.ckpt")["iteration"] == 16

@@ -356,6 +356,31 @@ def test_deadline_and_cancel_results(setup):
     assert expired.result().token_ids == ()
 
 
+def test_drain_finishes_inflight_and_rejects_new(setup):
+    """Graceful shutdown (the serve SIGTERM path): drain() stops admission
+    but every already-submitted request runs to completion — preemption
+    must not cancel work the engine can still finish."""
+    params, prompts = setup
+    with ServingEngine(params, CFG, slots=2, min_bucket=8) as serving:
+        handles = [
+            serving.submit(
+                Request(prompt_ids=tuple(p), max_new_tokens=6,
+                        temperature=0.0)
+            )
+            for p in prompts[:3]
+        ]
+        assert serving.drain(timeout_s=60.0)
+        for handle in handles:
+            result = handle.result(timeout=5)
+            assert result.finish_reason in ("length", "stop")
+            assert result.token_ids
+        with pytest.raises(RuntimeError, match="draining"):
+            serving.submit(Request(prompt_ids=(1, 2), max_new_tokens=2))
+    # An idle engine drains immediately even with a zero timeout.
+    with ServingEngine(params, CFG, slots=1, min_bucket=8) as idle:
+        assert idle.drain(timeout_s=0.0)
+
+
 def test_worker_death_unblocks_all_callers(setup, monkeypatch):
     """An engine failure mid-loop must fail every registered request
     ("error") instead of leaving callers parked on done.wait() forever,

@@ -5,10 +5,12 @@ documented in ``telemetry/schema.py`` (and its prose table in the docs).
 Three checks, all static/jax-free (wired into tier-1 via
 ``tests/test_telemetry.py``, runnable standalone):
 
-1. **Source sweep** — grep ``bpe_transformer_tpu/`` (plus ``bench.py`` and
-   ``benchmarks/``) for every ``"kind": "..."`` / ``kind="..."`` literal an
-   emitter writes; each must be a key of ``RECORD_SCHEMAS``.  A new record
-   kind cannot ship undocumented.
+1. **Source sweep** — grep ``bpe_transformer_tpu/`` (every subpackage: the
+   ``resilience/`` emitters' preemption/recovery kinds included, plus
+   ``bench.py``, ``benchmarks/`` and ``tools/``) for every
+   ``"kind": "..."`` / ``kind="..."`` literal an emitter writes; each must
+   be a key of ``RECORD_SCHEMAS``.  A new record kind cannot ship
+   undocumented.
 2. **Docs sweep** — every documented kind must appear in the
    ``ARCHITECTURE.md`` and ``README.md`` record-kind tables.
 3. **Fixture validation** — every record in the committed
