@@ -3,8 +3,13 @@
 The headline bench (bench.py) reports one number for the whole update; this
 script decomposes it so an MFU gap can be attributed to a specific stage
 (forward, backward, optimizer, attention impl, CE chunking) instead of
-guessed at.  Each variant is timed in its own jit with a value-fetch
-barrier, warm steps first.
+guessed at.  Measurement is `telemetry.attribution`'s shared path —
+``time_call`` (value-fetch barrier, warm first) for the sub-stage jits and
+``StepProbe`` (non-donating AOT step copies + XLA cost analysis) for the
+full update — so these bench rows and the loop's ``kind="attribution"``
+telemetry records can never disagree about method.  Full-step rows carry
+the static roofline verdict (flops, bytes moved, arithmetic intensity,
+compute- vs memory-bound) alongside the measured ms.
 
 Rows (one JSON line each, stdout):
     {"stage": "full_step" | "forward" | "value_and_grad" | ..., "ms": N,
@@ -29,26 +34,6 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from _accel import accelerator_up  # noqa: E402  (benchmarks/_accel.py)
-
-
-
-def time_call(fn, *args, iters: int = 10, warmup: int = 2) -> float:
-    """Mean wall ms per call; a scalar fetch from the result is the barrier
-    (block_until_ready has proven unreliable on the relayed backend)."""
-    import jax
-
-    def sync(out):
-        leaf = jax.tree_util.tree_leaves(out)[0]
-        jax.device_get(jax.numpy.ravel(leaf)[0])
-
-    for _ in range(warmup):
-        out = fn(*args)
-    sync(out)
-    start = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    sync(out)
-    return (time.perf_counter() - start) / iters * 1e3
 
 
 def main() -> int:
@@ -79,10 +64,13 @@ def main() -> int:
     import bpe_transformer_tpu.models as models
     from bpe_transformer_tpu.models import init_params
     from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.telemetry.attribution import (
+        StepProbe,
+        time_call,
+    )
     from bpe_transformer_tpu.training.train_step import (
         TrainHParams,
         make_loss_fn,
-        make_train_step,
     )
 
     name_to_attr = {
@@ -114,21 +102,24 @@ def main() -> int:
             flush=True,
         )
 
-    def step_ms(config) -> float:
-        # make_train_step donates params/opt_state, so the timed loop must
-        # thread the returned state back in (reusing the donated input
-        # buffers raises on the real chip).
+    def step_row(config) -> tuple[float, dict]:
+        # The shared attribution probe: a NON-donating AOT copy of the
+        # update (no state threading needed — the loop's buffers stay
+        # valid) timed with the same fenced path the telemetry records
+        # use, plus the program's XLA cost-model roofline verdict.
         params = init_params(jax.random.PRNGKey(0), config)
         opt_state = adamw_init(params)
-        step = make_train_step(config, TrainHParams())
-        for _ in range(2):
-            params, opt_state, metrics = step(params, opt_state, x, y)
-        jax.device_get(metrics["loss"])
-        start = time.perf_counter()
-        for _ in range(args.iters):
-            params, opt_state, metrics = step(params, opt_state, x, y)
-        jax.device_get(metrics["loss"])
-        return (time.perf_counter() - start) / args.iters * 1e3
+        probe = StepProbe(
+            config, TrainHParams(), batch_size=args.batch, iters=args.iters
+        )
+        cost = probe.program_costs(params, opt_state)[0]
+        measured = probe.measure(params, opt_state)
+        return measured["device_step_s"] * 1e3, {
+            "flops": cost["flops"],
+            "bytes_accessed": cost["bytes_accessed"],
+            "arithmetic_intensity": cost["arithmetic_intensity"],
+            "bound": cost["bound"],
+        }
 
     if args.decode:
         from bench_decode import PROMPT_LEN  # shared geometry: these rows
@@ -183,8 +174,10 @@ def main() -> int:
     y = jnp.asarray(np.roll(ids, -1, axis=1))
 
     # 1. The full update as shipped.
-    emit("full_step", step_ms(base), attention=base.attention_impl,
-         flash_block=base.flash_block_size, loss_chunk=base.loss_chunk_size)
+    ms, cost = step_row(base)
+    emit("full_step", ms, attention=base.attention_impl,
+         flash_block=base.flash_block_size, loss_chunk=base.loss_chunk_size,
+         **cost)
 
     # 2. Forward-only and grad-only splits (optimizer cost = full - valgrad).
     params = init_params(jax.random.PRNGKey(0), base)
@@ -201,19 +194,23 @@ def main() -> int:
         over = {"attention_impl": attn}
         if block:
             over["flash_block_size"] = block
+        ms, cost = step_row(dataclasses.replace(base, **over))
         emit(
-            "full_step", step_ms(dataclasses.replace(base, **over)),
+            "full_step", ms,
             attention=attn, flash_block=block, loss_chunk=base.loss_chunk_size,
+            **cost,
         )
 
     # 4. CE chunking policy.
     for chunk in (None, 512):
         if chunk == base.loss_chunk_size:
             continue
+        ms, cost = step_row(dataclasses.replace(base, loss_chunk_size=chunk))
         emit(
-            "full_step", step_ms(dataclasses.replace(base, loss_chunk_size=chunk)),
+            "full_step", ms,
             attention=base.attention_impl, flash_block=base.flash_block_size,
             loss_chunk=chunk,
+            **cost,
         )
     return 0
 

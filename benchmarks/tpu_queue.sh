@@ -293,6 +293,17 @@ run_job - 300 "$OUT/bench_dynamics.jsonl" \
   env BENCH_DYNAMICS=1 BENCH_NO_CPU_FALLBACK=1 BENCH_DRIVER_FLAG=0 \
   python bench.py
 
+# Performance attribution (PR 6): the XLA cost-model roofline of the
+# headline config's compiled step + the measured compute/collective/host
+# split on the real chip — the instrument every MFU optimisation that
+# follows gates against.  --json emits one machine row (with "platform",
+# so the CPU-fallback guard applies); the stream lands in the mirror-safe
+# scratch for bpe-tpu report.
+run_job attribution 900 "$OUT/attribution.jsonl" \
+  python -m bpe_transformer_tpu.training.cli profile \
+  --preset tinystories-4l --batch 32 --measure 10 \
+  --metrics-jsonl "$MIR/attribution_stream.jsonl" --json
+
 # Kill-resume smoke (resilience layer, PR 5): SIGTERM a short training
 # run midway on the chip and assert the preemption exit code + emergency
 # checkpoint + clean --resume completion — the recovery paths the CPU
@@ -337,6 +348,43 @@ if [ -e "$DYN_CAP" ] && [ -e "$HEADLINE_CAP" ]; then
     0) log "dynamics overhead vs plain headline: within the 2% budget";;
     *) log "dynamics overhead self-report failed (non-fatal)";;
   esac
+fi
+# Attribution self-report (jax-free, CPU-only): surface the measured
+# compute/collective/host-gap fractions next to the headline capture's
+# numbers in the queue log — the "where the missing MFU goes" line an
+# operator reads first after each pass.
+if [ -s "$OUT/attribution.jsonl" ]; then
+  ATTR_LINE=$(env JAX_PLATFORMS=cpu python - "$OUT/attribution.jsonl" <<'PY'
+import json, sys
+
+row = None
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if not ln:
+        continue
+    try:
+        r = json.loads(ln)
+    except json.JSONDecodeError:
+        continue
+    if r.get("metric") == "attribution":
+        row = r  # newest row wins
+if row is None:
+    sys.exit(0)
+
+
+def pct(v):
+    return f"{v:.0%}" if isinstance(v, (int, float)) else "n/a"
+
+
+print(
+    f"compute={pct(row.get('compute_frac'))} "
+    f"collective={pct(row.get('collective_frac'))} "
+    f"host_gap={pct(row.get('host_gap_frac'))} "
+    f"device_ms={(row.get('device_step_s') or 0) * 1e3:.2f}"
+)
+PY
+)
+  [ -n "$ATTR_LINE" ] && log "attribution self-report: $ATTR_LINE"
 fi
 log "queue pass complete"
 # Same size guard as the restore: never shrink the mirrored history.

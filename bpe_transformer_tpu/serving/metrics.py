@@ -101,6 +101,19 @@ class ServingMetrics:
             phase: LatencyHistogram()
             for phase in ("queue_wait", "prefill", "decode")
         }
+        #: Per-prefill-bucket work accounting: bucket length ->
+        #: [requests, prompt tokens, seconds, compiles] — the /metrics
+        #: per-bucket token-throughput series (bounded label set: the
+        #: engine's bucket ladder is fixed at construction).  A bucket's
+        #: FIRST admission pays its XLA compile; that sample is counted as
+        #: a request + compile but its tokens/seconds are excluded, so a
+        #: low-volume bucket's throughput gauge reflects steady-state
+        #: prefill, not one multi-second compile amortized forever.
+        self.prefill_buckets: dict[int, list] = {}
+        #: Cumulative decode work: tokens sampled across ticks and the
+        #: wall seconds those ticks took (throughput = tokens / seconds).
+        self.decode_tokens = 0
+        self.decode_seconds = 0.0
         self._max_errors = max_errors
         self._errors: list[dict] = []
 
@@ -123,6 +136,35 @@ class ServingMetrics:
             hist = self.phases.get(phase)
             if hist is not None:
                 hist.observe(seconds)
+
+    def on_prefill(
+        self,
+        bucket: int,
+        prompt_tokens: int,
+        seconds: float,
+        compiled: bool = False,
+    ) -> None:
+        """Account one admission's prefill against its length bucket.
+        ``compiled=True`` marks an admission that paid an XLA compile: it
+        counts as a request (and a compile) but its tokens/seconds stay
+        out of the throughput accumulator — compile wall lives in the
+        process-wide ``compile_time_seconds_total`` gauge instead."""
+        with self._lock:
+            counts = self.prefill_buckets.setdefault(
+                int(bucket), [0, 0, 0.0, 0]
+            )
+            counts[0] += 1
+            if compiled:
+                counts[3] += 1
+            else:
+                counts[1] += int(prompt_tokens)
+                counts[2] += max(float(seconds), 0.0)
+
+    def on_decode_tick(self, tokens: int, seconds: float) -> None:
+        """Account one batched decode tick (tokens sampled, wall time)."""
+        with self._lock:
+            self.decode_tokens += int(tokens)
+            self.decode_seconds += max(float(seconds), 0.0)
 
     def record_error(self, error: str, **attrs) -> None:
         """Append to the last-error ring buffer (oldest evicted)."""
@@ -161,6 +203,27 @@ class ServingMetrics:
                 "phase_p95_s": {
                     p: h.percentile(0.95) for p, h in self.phases.items()
                 },
+                "prefill_bucket_work": {
+                    bucket: {
+                        "requests": counts[0],
+                        "tokens": counts[1],
+                        "seconds": round(counts[2], 6),
+                        "compiles": counts[3],
+                        "tokens_per_sec": (
+                            round(counts[1] / counts[2], 3)
+                            if counts[2] > 0
+                            else None
+                        ),
+                    }
+                    for bucket, counts in sorted(self.prefill_buckets.items())
+                },
+                "decode_tokens": self.decode_tokens,
+                "decode_seconds": round(self.decode_seconds, 6),
+                "decode_tokens_per_sec": (
+                    round(self.decode_tokens / self.decode_seconds, 3)
+                    if self.decode_seconds > 0
+                    else None
+                ),
             }
 
 
@@ -209,6 +272,12 @@ def render_prometheus(
             phase: (hist.cumulative(), hist.sum, hist.count)
             for phase, hist in metrics.phases.items()
         }
+        bucket_data = {
+            bucket: tuple(counts)
+            for bucket, counts in sorted(metrics.prefill_buckets.items())
+        }
+        decode_tokens = metrics.decode_tokens
+        decode_seconds = metrics.decode_seconds
     emit("uptime_seconds", "gauge", "Seconds since the serving engine started.",
          [({}, round(metrics.uptime_s(), 3))])
     emit("requests_submitted_total", "counter",
@@ -241,6 +310,38 @@ def render_prometheus(
             f"{prefix}_request_phase_seconds_{suffix}{{{label_str}}} {value}"
         )
 
+    # Per-bucket prefill work + aggregate decode throughput: which rungs of
+    # the bucket ladder the traffic actually lands on, and what the chip
+    # delivers per phase (a scraper rate()s the counters; the _per_sec
+    # gauges are the cumulative ratio for humans and the jax-free monitor).
+    emit("prefill_requests_total", "counter",
+         "Admissions prefilled per prompt-length bucket.",
+         [({"bucket": b}, c[0]) for b, c in bucket_data.items()])
+    emit("prefill_tokens_total", "counter",
+         "Prompt tokens prefilled per prompt-length bucket.",
+         [({"bucket": b}, c[1]) for b, c in bucket_data.items()])
+    emit("prefill_seconds_total", "counter",
+         "Wall seconds spent in prefill per prompt-length bucket "
+         "(compile-paying admissions excluded; see compile_time gauge).",
+         [({"bucket": b}, round(c[2], 6)) for b, c in bucket_data.items()])
+    emit("prefill_compiles_total", "counter",
+         "Admissions that paid an XLA prefill compile, per bucket.",
+         [({"bucket": b}, c[3]) for b, c in bucket_data.items()])
+    emit("prefill_tokens_per_sec", "gauge",
+         "Cumulative prefill token throughput per bucket.",
+         [({"bucket": b}, round(c[1] / c[2], 3))
+          for b, c in bucket_data.items() if c[2] > 0])
+    emit("decode_tokens_total", "counter",
+         "Tokens sampled by batched decode ticks.",
+         [({}, decode_tokens)])
+    emit("decode_seconds_total", "counter",
+         "Wall seconds spent in batched decode ticks.",
+         [({}, round(decode_seconds, 6))])
+    if decode_seconds > 0:
+        emit("decode_tokens_per_sec", "gauge",
+             "Cumulative decode token throughput.",
+             [({}, round(decode_tokens / decode_seconds, 3))])
+
     if engine_stats:
         emit("queue_depth", "gauge", "Requests waiting in the admission queue.",
              [({}, engine_stats.get("queue_depth"))])
@@ -261,6 +362,9 @@ def render_prometheus(
         emit("compile_events_total", "counter",
              "Process-wide XLA compile events (jit cache misses).",
              [({}, resources.get("compile_events"))])
+        emit("compile_time_seconds_total", "counter",
+             "Cumulative wall seconds spent in XLA backend compiles.",
+             [({}, resources.get("compile_time_s"))])
         emit("host_rss_bytes", "gauge", "Host resident set size.",
              [({}, resources.get("host_rss_bytes"))])
         emit("live_buffer_bytes", "gauge",

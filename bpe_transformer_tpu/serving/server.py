@@ -23,6 +23,7 @@ reads for training runs.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import queue
@@ -96,7 +97,7 @@ class _Entry:
     __slots__ = (
         "request", "tokens", "stream", "done", "result", "slot",
         "t_submit", "t_decode_start", "queue_wait_s", "prefill_s",
-        "cancel_requested",
+        "cancel_requested", "bucket",
     )
 
     def __init__(self, request: Request, t_submit: float):
@@ -111,6 +112,7 @@ class _Entry:
         self.queue_wait_s = 0.0
         self.prefill_s = 0.0
         self.cancel_requested = False
+        self.bucket: int | None = None  # prefill bucket, set at admission
 
 
 class RequestHandle:
@@ -200,6 +202,11 @@ class ServingEngine:
         self._entries: dict[str, _Entry] = {}
         self._entries_lock = threading.Lock()
         self._slot_entries: dict[int, _Entry] = {}
+        #: Per-request trace ring (newest last): the finished requests'
+        #: phase timelines behind /statusz "recent_requests" — the same
+        #: numbers the serve/* spans carry, queryable from a live server
+        #: without tailing the JSONL.
+        self._recent: collections.deque = collections.deque(maxlen=32)
         self._requests_finished = 0
         self._thread: threading.Thread | None = None
         self._running = False
@@ -400,7 +407,8 @@ class ServingEngine:
     def statusz(self) -> dict:
         """The ``GET /statusz`` payload: run manifest, uptime, compile
         accounting (per-engine program count + process-wide compile
-        events), per-slot state, queue depth, and the last-error ring."""
+        events), per-slot state, queue depth, the recent-request trace
+        ring (per-request phase timelines), and the last-error ring."""
         resources = sample_resources()
         return {
             "manifest": self.manifest,
@@ -413,6 +421,10 @@ class ServingEngine:
             "worker_alive": self._thread is not None
             and self._worker_error is None,
             "slot_states": self.engine.slot_states(),
+            # Newest-last ring of finished request timelines (request_id +
+            # queue_wait/prefill/decode + bucket): the per-request trace
+            # view, live, without tailing the telemetry JSONL.
+            "recent_requests": list(self._recent),
             "resources": resources,
             "last_errors": self.metrics.last_errors(),
         }
@@ -553,6 +565,8 @@ class ServingEngine:
         request = entry.request
         t0 = self._clock()
         entry.queue_wait_s = t0 - entry.t_submit
+        entry.bucket = self.engine.bucket_for(len(request.prompt_ids))
+        programs_before = self.engine.compiled_programs()
         event = self.engine.admit(
             request.prompt_ids,
             max_new_tokens=request.max_new_tokens,
@@ -566,6 +580,14 @@ class ServingEngine:
         entry.prefill_s = now - t0
         entry.t_decode_start = now
         entry.slot = event.slot
+        self.metrics.on_prefill(
+            entry.bucket,
+            len(request.prompt_ids),
+            entry.prefill_s,
+            # A bucket's first admission pays its XLA compile — keep that
+            # wall out of the bucket's steady-state throughput gauge.
+            compiled=self.engine.compiled_programs() > programs_before,
+        )
         self._span("queue_wait", entry.t_submit, entry.queue_wait_s, request)
         self._span("prefill", t0, entry.prefill_s, request)
         entry.tokens.append(event.token)
@@ -576,6 +598,7 @@ class ServingEngine:
             self._slot_entries[event.slot] = entry
 
     def _deliver(self, events: list[TickEvent], tick_s: float) -> None:
+        self.metrics.on_decode_tick(len(events), tick_s)
         for event in events:
             entry = self._slot_entries.get(event.slot)
             if entry is None:
@@ -610,6 +633,23 @@ class ServingEngine:
         )
         self._requests_finished += 1
         self.metrics.on_finish(reason)
+        # Per-request trace: the finished timeline joins the /statusz ring.
+        # Same numbers as the serve/* spans and Result.timings() — one
+        # measurement, three surfaces.
+        self._recent.append(
+            {
+                "request_id": entry.request.request_id,
+                "finish_reason": reason,
+                "n_tokens": len(entry.tokens),
+                "prompt_len": len(entry.request.prompt_ids),
+                "bucket": entry.bucket,
+                "slot": entry.slot,
+                "t_submit": round(entry.t_submit - self._t0, 6),
+                "queue_wait_s": round(entry.queue_wait_s, 6),
+                "prefill_s": round(entry.prefill_s, 6),
+                "decode_s": round(decode_s, 6),
+            }
+        )
         with self._entries_lock:
             self._entries.pop(entry.request.request_id, None)
         entry.stream.put(_STREAM_END)
@@ -695,7 +735,8 @@ def make_http_server(
       counters, queue depth, slot occupancy, per-phase latency
       histograms, compile + HBM/RSS accounting (`serving/metrics.py`).
     * ``GET /statusz`` — JSON operator page: run manifest, uptime,
-      compile counters, per-slot state, last-error ring buffer.
+      compile counters, per-slot state, recent per-request phase
+      timelines, last-error ring buffer.
 
     ``port=0`` binds an ephemeral port (tests); the caller owns
     ``serve_forever()`` / ``shutdown()``.

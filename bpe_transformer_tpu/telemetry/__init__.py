@@ -16,6 +16,10 @@ Subsumes and extends the old ``utils.metrics`` / ``utils.profiling`` pair
   norms, update-to-param ratios, activation stats, NaN/Inf localization),
   same in-graph/zero-extra-sync contract, emitted as ``kind="dynamics"``
   records;
+- `attribution` — performance attribution: XLA cost-model roofline
+  verdicts per compiled program and the measured compute / collective /
+  host-gap split of step time, emitted as ``kind="attribution"`` records
+  (``--attribution-every`` / ``bpe-tpu profile``);
 - `trace` — Chrome trace-event export of the span stream
   (``bpe-tpu report --trace``, jax-free);
 - `watchdog` — hung-step detection against the trailing median step time
@@ -54,6 +58,11 @@ __getattr__ = lazy_attrs(
         "dynamics_metrics": "dynamics",
         "dynamics_record": "dynamics",
         "flatten_dynamics": "dynamics",
+        "StepProbe": "attribution",
+        "program_cost": "attribution",
+        "roofline": "attribution",
+        "serving_program_costs": "attribution",
+        "time_call": "attribution",
         "StepTimer": "timing",
         "profile_trace": "timing",
         "time_fn": "timing",
@@ -64,6 +73,7 @@ __all__ = [
     "MetricsLogger",
     "NonFiniteError",
     "RECORD_SCHEMAS",
+    "StepProbe",
     "StepTimer",
     "Telemetry",
     "Watchdog",
@@ -79,9 +89,13 @@ __all__ = [
     "nonfinite_count",
     "nonfinite_fields",
     "profile_trace",
+    "program_cost",
     "record_compile_events",
+    "roofline",
     "run_manifest",
     "sample_resources",
+    "serving_program_costs",
+    "time_call",
     "time_fn",
     "validate_record",
 ]

@@ -6,8 +6,10 @@ Two sources, one panel:
 - **a telemetry stream** (``bpe-tpu monitor run/metrics.jsonl``): tail the
   unified JSONL the training loop / serving engine writes, folding every
   record kind (metric | span | event | engine | resources | dynamics |
-  manifest | footer) into the latest operational state — a dynamics-enabled
-  training run gets a live per-layer grad-norm/update-ratio table;
+  attribution | manifest | footer) into the latest operational state — a
+  dynamics-enabled training run gets a live per-layer grad-norm/
+  update-ratio table, an attribution-enabled one a live compute/
+  collective/host-gap split;
 - **a live server** (``bpe-tpu monitor --url host:port``): poll
   ``GET /metrics`` on a ``bpe-tpu serve`` process and parse the Prometheus
   exposition back into the same state.
@@ -70,9 +72,25 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
         elif kind == "resources":
             for key in ("host_rss_bytes", "live_buffer_bytes",
                         "hbm_bytes_in_use", "hbm_peak_bytes_in_use",
-                        "hbm_bytes_limit", "compile_events"):
+                        "hbm_bytes_limit", "compile_events",
+                        "compile_time_s"):
                 if record.get(key) is not None:
                     state[key] = record[key]
+        elif kind == "attribution":
+            # Latest performance-attribution split (telemetry/attribution):
+            # fractions + the top compiled program's roofline verdict, so a
+            # live operator sees WHERE step time goes, not just how much.
+            for key in ("compute_frac", "collective_frac", "host_gap_frac"):
+                if record.get(key) is not None:
+                    state[key] = record[key]
+            state["attribution_step"] = record.get("step")
+            programs = record.get("programs")
+            if isinstance(programs, list) and programs:
+                top = programs[0]
+                if isinstance(top, dict) and top.get("bound"):
+                    state["bound_verdict"] = (
+                        f"{top.get('name', '?')} {top['bound']}"
+                    )
         elif kind == "dynamics":
             # Latest per-layer introspection sample (telemetry/dynamics.py):
             # keep the whole flat record, merged so a partial sample (e.g.
@@ -154,6 +172,13 @@ def fold_prometheus(samples: dict, prefix: str = "bpe_tpu") -> dict:
         for name, value in samples.items()
         if name.startswith(f"{prefix}_requests_finished_total")
     )
+    # Per-bucket prefill throughput gauges: parse the bucket label back
+    # out of e.g. `bpe_tpu_prefill_tokens_per_sec{bucket="16"}`.
+    prefill_tps = {}
+    for name, value in samples.items():
+        head = f'{prefix}_prefill_tokens_per_sec{{bucket="'
+        if name.startswith(head) and name.endswith('"}'):
+            prefill_tps[name[len(head):-2]] = value
     state = {
         "run_kind": "serve",
         "n_records": len(samples),
@@ -169,6 +194,9 @@ def fold_prometheus(samples: dict, prefix: str = "bpe_tpu") -> dict:
         "tokens_total": get("tokens_generated_total"),
         "compiled_programs": get("engine_compiled_programs"),
         "compile_events": get("compile_events_total"),
+        "compile_time_s": get("compile_time_seconds_total"),
+        "decode_tokens_per_sec": get("decode_tokens_per_sec"),
+        "prefill_tps_by_bucket": prefill_tps or None,
         "host_rss_bytes": get("host_rss_bytes"),
         "live_buffer_bytes": get("live_buffer_bytes"),
         "hbm_bytes_in_use": get("hbm_bytes_in_use"),
@@ -244,9 +272,25 @@ def render_frame(state: dict, source: str) -> str:
             parts.append(f"rejected {_num(state['requests_rejected'])}")
         if state.get("serve_tokens_per_sec") is not None:
             parts.append(f"tok/s {_num(state['serve_tokens_per_sec'], 6)}")
+        if state.get("decode_tokens_per_sec") is not None:
+            parts.append(
+                f"decode tok/s {_num(state['decode_tokens_per_sec'], 6)}"
+            )
         if state.get("tokens_total") is not None:
             parts.append(f"tokens {_num(state['tokens_total'])}")
         lines.append("  serve  " + "  ".join(parts))
+        if state.get("prefill_tps_by_bucket"):
+            lines.append(
+                "  bkt    prefill tok/s  "
+                + "  ".join(
+                    f"{bucket}={_num(tps, 5)}"
+                    for bucket, tps in sorted(
+                        state["prefill_tps_by_bucket"].items(),
+                        key=lambda kv: int(kv[0]) if str(kv[0]).isdigit()
+                        else 0,
+                    )
+                )
+            )
 
     mem_parts = []
     if state.get("hbm_bytes_in_use") is not None:
@@ -263,6 +307,18 @@ def render_frame(state: dict, source: str) -> str:
         mem_parts.append(f"rss {_mib(state['host_rss_bytes'])}")
     if mem_parts:
         lines.append("  mem    " + "  ".join(mem_parts))
+
+    if state.get("compute_frac") is not None:
+        parts = [f"compute {state['compute_frac']:.0%}"]
+        if state.get("collective_frac") is not None:
+            parts.append(f"collective {state['collective_frac']:.0%}")
+        if state.get("host_gap_frac") is not None:
+            parts.append(f"host gap {state['host_gap_frac']:.0%}")
+        if state.get("attribution_step") is not None:
+            parts.append(f"(step {_num(state['attribution_step'])})")
+        if state.get("bound_verdict"):
+            parts.append(f"[{state['bound_verdict']}]")
+        lines.append("  attr   " + "  ".join(parts))
 
     dyn = state.get("dynamics")
     if dyn:
@@ -287,6 +343,10 @@ def render_frame(state: dict, source: str) -> str:
     compile_parts = []
     if state.get("compile_events") is not None:
         compile_parts.append(f"compile events {_num(state['compile_events'])}")
+    if state.get("compile_time_s") is not None:
+        compile_parts.append(
+            f"compile time {_num(state['compile_time_s'], 4)}s"
+        )
     if state.get("compiled_programs") is not None:
         compile_parts.append(
             f"engine programs {_num(state['compiled_programs'])}"

@@ -10,7 +10,11 @@ header, step-metric records, span/event records, and a footer.
 
 Sections: run manifest, loss-curve stats, throughput/MFU trajectory, a
 serving summary (engine records + per-request queue_wait/prefill/decode
-span percentiles, for ``bpe-tpu serve`` streams), a dynamics summary
+span percentiles, total-request p50/p95/p99 with the slow tail attributed
+to its dominant phase, for ``bpe-tpu serve`` streams), an attribution
+summary (``kind="attribution"`` records: the compute/collective/host-gap
+step split, the MFU ceiling if only compute remained, and the XLA
+cost-model roofline verdict per compiled program), a dynamics summary
 (per-layer norm trajectories, update-ratio outliers, first-non-finite
 localization — ``kind="dynamics"`` records, `telemetry.dynamics`), span
 breakdown, health summary, and an anomaly list (non-finite records, loss
@@ -209,9 +213,38 @@ def summarize(records: list[dict]) -> dict:
             if footer is not None and isinstance(footer.get("requests"), int)
             else len(phase_durs["decode"]) or len(phase_durs["queue_wait"])
         )
+        # Per-request assembly (request_id propagated through every serve/*
+        # span): total request latency percentiles, and WHICH phase the
+        # slow tail spends its time in — "p99 is decode-bound" is the
+        # attribution a latency SLO needs, not just three marginal
+        # histograms.
+        by_request: dict[str, dict[str, float]] = {}
+        for s in serve_spans:
+            rid = s.get("request_id")
+            dur = s.get("dur_s")
+            phase = str(s.get("path", "")).split("/", 1)[-1]
+            if rid and isinstance(dur, (int, float)):
+                req = by_request.setdefault(str(rid), {})
+                req[phase] = req.get(phase, 0.0) + dur
+        totals = {rid: sum(ph.values()) for rid, ph in by_request.items()}
+        slow_dominant = None
+        if totals:
+            p95_total = _pctl(list(totals.values()), 0.95)
+            tail = [
+                by_request[rid]
+                for rid, total in totals.items()
+                if p95_total is not None and total >= p95_total
+            ]
+            if tail:
+                phase_mass: dict[str, float] = {}
+                for phases_of_req in tail:
+                    for phase, dur in phases_of_req.items():
+                        phase_mass[phase] = phase_mass.get(phase, 0.0) + dur
+                slow_dominant = max(phase_mass, key=phase_mass.get)
         serving = {
             "n_engine_records": len(engines),
             "requests": requests,
+            "requests_traced": len(by_request),
             "tokens_per_sec": _stats(
                 [r.get("tokens_per_sec") for r in engines]
             ),
@@ -230,10 +263,18 @@ def summarize(records: list[dict]) -> dict:
                     "n": len([d for d in durs if isinstance(d, (int, float))]),
                     "p50_s": _pctl(durs, 0.50),
                     "p95_s": _pctl(durs, 0.95),
+                    "p99_s": _pctl(durs, 0.99),
                     "max_s": _pctl(durs, 1.0),
                 }
                 for phase, durs in phase_durs.items()
             },
+            "total": {
+                "n": len(totals),
+                "p50_s": _pctl(list(totals.values()), 0.50),
+                "p95_s": _pctl(list(totals.values()), 0.95),
+                "p99_s": _pctl(list(totals.values()), 0.99),
+            },
+            "slow_dominant_phase": slow_dominant,
         }
 
     health_last = {}
@@ -404,6 +445,57 @@ def summarize(records: list[dict]) -> dict:
                 f"(first dynamics record at step {localization['step']})"
             )
 
+    # Performance-attribution records (kind="attribution",
+    # telemetry/attribution.py): the measured compute/collective/host-gap
+    # split of step time plus the one-off XLA cost-model roofline rows —
+    # the report's MFU-gap decomposition.
+    attributions = [r for r in records if r.get("kind") == "attribution"]
+    attribution_summary = None
+    if attributions:
+        programs = next(
+            (
+                r["programs"]
+                for r in attributions
+                if isinstance(r.get("programs"), list)
+            ),
+            [],
+        )
+        mfu_vals = [r.get("mfu") for r in steps if "mfu" in r]
+        mfu_last = mfu_vals[-1] if mfu_vals else None
+        compute_last = attributions[-1].get("compute_frac")
+        mfu_compute_bound = None
+        if (
+            isinstance(mfu_last, (int, float))
+            and isinstance(compute_last, (int, float))
+            and compute_last > 0
+        ):
+            # What MFU the pure-compute portion of the step achieves: the
+            # ceiling this run reaches if collectives + host gaps vanish —
+            # anything beyond it needs kernel/layout work, not overlap.
+            mfu_compute_bound = mfu_last / compute_last
+        attribution_summary = {
+            "n": len(attributions),
+            "step_range": [
+                attributions[0].get("step"), attributions[-1].get("step")
+            ],
+            "compute_frac": _stats(
+                [r.get("compute_frac") for r in attributions]
+            ),
+            "collective_frac": _stats(
+                [r.get("collective_frac") for r in attributions]
+            ),
+            "host_gap_frac": _stats(
+                [r.get("host_gap_frac") for r in attributions]
+            ),
+            "wall_step_s": _stats([r.get("wall_step_s") for r in attributions]),
+            "device_step_s": _stats(
+                [r.get("device_step_s") for r in attributions]
+            ),
+            "mfu_last": mfu_last,
+            "mfu_if_compute_only": mfu_compute_bound,
+            "programs": programs,
+        }
+
     return {
         "manifest": manifest,
         "n_manifests": len(manifests),
@@ -432,6 +524,7 @@ def summarize(records: list[dict]) -> dict:
         },
         "serving": serving,
         "resources": resource_summary,
+        "attribution": attribution_summary,
         "dynamics": dynamics_summary,
         "recovery": recovery_summary,
         "spans": span_breakdown,
@@ -548,8 +641,23 @@ def render_report(records: list[dict]) -> str:
             if ph["n"]:
                 lines.append(
                     f"  {phase:<11s} n={ph['n']:<4d} p50 {_fmt(ph['p50_s'])}s"
-                    f"  p95 {_fmt(ph['p95_s'])}s  max {_fmt(ph['max_s'])}s"
+                    f"  p95 {_fmt(ph['p95_s'])}s"
+                    f"  p99 {_fmt(ph.get('p99_s'))}s"
+                    f"  max {_fmt(ph['max_s'])}s"
                 )
+        total = sv.get("total") or {}
+        if total.get("n"):
+            lines.append(
+                f"  {'request':<11s} n={total['n']:<4d} "
+                f"p50 {_fmt(total['p50_s'])}s"
+                f"  p95 {_fmt(total['p95_s'])}s"
+                f"  p99 {_fmt(total['p99_s'])}s"
+                + (
+                    f"  (slow tail dominated by {sv['slow_dominant_phase']})"
+                    if sv.get("slow_dominant_phase")
+                    else ""
+                )
+            )
 
     rs = s["resources"]
     if rs:
@@ -579,6 +687,70 @@ def render_report(records: list[dict]) -> str:
             lines.append(
                 f"  compile events {_fmt(ce.get('first'))} -> {_fmt(ce.get('last'))}"
             )
+
+    at = s["attribution"]
+    if at:
+        lines.append(
+            f"== attribution ({at['n']} records, steps "
+            f"{at['step_range'][0]}..{at['step_range'][1]}) =="
+        )
+
+        def frac(stats_d):
+            mean = (stats_d or {}).get("mean")
+            return f"{mean:.1%}" if isinstance(mean, (int, float)) else "n/a"
+
+        wall = (at["wall_step_s"] or {}).get("mean")
+        device = (at["device_step_s"] or {}).get("mean")
+        lines.append(
+            f"  step time: compute {frac(at['compute_frac'])}"
+            f"  collective {frac(at['collective_frac'])}"
+            f"  host gap {frac(at['host_gap_frac'])}"
+            + (
+                f"   (wall {wall * 1e3:,.2f} ms, device {device * 1e3:,.2f} ms)"
+                if isinstance(wall, (int, float))
+                and isinstance(device, (int, float))
+                else ""
+            )
+        )
+        if at["mfu_last"] is not None and at["mfu_if_compute_only"] is not None:
+            lines.append(
+                f"  mfu {_fmt(at['mfu_last'], 3)} -> "
+                f"{_fmt(at['mfu_if_compute_only'], 3)} ceiling if "
+                "collective + host gap were zero (beyond that: kernels/"
+                "layout, not overlap)"
+            )
+        if at["programs"]:
+            lines.append(
+                f"  {'program':<18s}{'GFLOPs':>10s}{'MB moved':>10s}"
+                f"{'AI f/B':>9s}  verdict"
+            )
+            ranked = sorted(
+                at["programs"],
+                key=lambda p: -(p.get("flops") or 0),
+            )
+            for prog in ranked:
+                flops = prog.get("flops")
+                nbytes = prog.get("bytes_accessed")
+                ai = prog.get("arithmetic_intensity")
+                lines.append(
+                    f"  {str(prog.get('name', '?')):<18s}"
+                    + (
+                        f"{flops / 1e9:>10,.2f}"
+                        if isinstance(flops, (int, float))
+                        else f"{'-':>10s}"
+                    )
+                    + (
+                        f"{nbytes / 2**20:>10,.1f}"
+                        if isinstance(nbytes, (int, float))
+                        else f"{'-':>10s}"
+                    )
+                    + (
+                        f"{ai:>9,.1f}"
+                        if isinstance(ai, (int, float))
+                        else f"{'-':>9s}"
+                    )
+                    + f"  {prog.get('bound', 'unknown')}"
+                )
 
     dy = s["dynamics"]
     if dy:
@@ -697,6 +869,15 @@ COMPARE_METRICS: dict = {
     "serve_queue_wait_p95_s": (
         lambda s: ((s["serving"] or {}).get("phases", {})
                    .get("queue_wait", {}).get("p95_s")), "lower"),
+    "serve_request_p99_s": (
+        lambda s: ((s["serving"] or {}).get("total", {}) or {}).get("p99_s"),
+        "lower"),
+    "collective_frac": (
+        lambda s: ((s.get("attribution") or {}).get("collective_frac", {})
+                   or {}).get("mean"), "lower"),
+    "host_gap_frac": (
+        lambda s: ((s.get("attribution") or {}).get("host_gap_frac", {})
+                   or {}).get("mean"), "lower"),
     "hbm_peak_bytes": (
         lambda s: (s["resources"] or {}).get("hbm_peak_bytes_in_use", {}).get("max")
         if s.get("resources") else None, "lower"),

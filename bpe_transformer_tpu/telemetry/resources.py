@@ -36,8 +36,12 @@ import sys
 import threading
 import time
 
-#: Process-wide compile-event count (monitoring listener + manual records).
+#: Process-wide compile-event count (monitoring listener + manual records)
+#: and the cumulative seconds those compiles took — the latter is what the
+#: serving ``/metrics`` compile-time gauge exposes (a recompile storm is
+#: visible as a climbing count; how much wall it stole needs the sum).
 _compile_events = 0
+_compile_time_s = 0.0
 _compile_lock = threading.Lock()
 _listener_installed = False
 
@@ -47,13 +51,14 @@ _listener_installed = False
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 
-def record_compile_events(n: int = 1) -> int:
-    """Manually add ``n`` compile events to the process-wide counter (for
-    compile paths jax's monitoring stream doesn't cover); returns the new
-    total."""
-    global _compile_events
+def record_compile_events(n: int = 1, duration_s: float = 0.0) -> int:
+    """Manually add ``n`` compile events (and their wall time) to the
+    process-wide counters (for compile paths jax's monitoring stream
+    doesn't cover); returns the new event total."""
+    global _compile_events, _compile_time_s
     with _compile_lock:
         _compile_events += n
+        _compile_time_s += max(duration_s, 0.0)
         return _compile_events
 
 
@@ -61,6 +66,13 @@ def compile_events() -> int:
     """Process-wide compile-event count so far (see module docstring)."""
     with _compile_lock:
         return _compile_events
+
+
+def compile_time_s() -> float:
+    """Cumulative wall seconds spent in XLA backend compiles so far (fed
+    by the same ``jax.monitoring`` duration events as the counter)."""
+    with _compile_lock:
+        return _compile_time_s
 
 
 def install_compile_counter() -> bool:
@@ -84,7 +96,7 @@ def install_compile_counter() -> bool:
 
             def _on_duration(event: str, duration: float, **_kwargs) -> None:
                 if event == _COMPILE_EVENT:
-                    record_compile_events(1)
+                    record_compile_events(1, duration_s=duration)
 
             monitoring.register_event_duration_secs_listener(_on_duration)
         except Exception:
@@ -168,6 +180,10 @@ def sample_resources(**extra) -> dict:
         "host_rss_bytes": host_rss_bytes(),
         "live_buffer_bytes": live_buffer_bytes(),
         "compile_events": compile_events(),
+        # Cumulative wall seconds in XLA compiles (not schema-required:
+        # older streams predate the field) — the /metrics compile-time
+        # gauge and the trace counter track read it.
+        "compile_time_s": round(compile_time_s(), 3),
     }
     mem = device_memory_stats()
     record["hbm_bytes_in_use"] = mem["bytes_in_use"] if mem else None

@@ -63,6 +63,18 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
     # count; optional ``lost_steps`` and the PR-4 ``nonfinite_path``
     # localization ride along.
     "recovery": {"kind", "t", "step", "restored_step", "rollbacks"},
+    # Performance-attribution sample (telemetry/attribution.py), emitted
+    # every --attribution-every steps (and by ``bpe-tpu profile``): the
+    # measured compute / collective / host-gap split of wall step time
+    # (fractions sum to ~1.0; ``collective_frac`` is null where the
+    # collective is not separable — GSPMD strategies), plus, on the first
+    # record of a run, the static XLA cost-model roofline rows under an
+    # optional ``programs`` list (name, flops, bytes_accessed,
+    # arithmetic_intensity, ridge_flops_per_byte, bound verdict).
+    "attribution": {
+        "kind", "t", "step", "wall_step_s", "device_step_s",
+        "compute_frac", "collective_frac", "host_gap_frac",
+    },
     # Run trailer: record counts + clean verdict (spans.py Telemetry.footer).
     "footer": {"kind", "t", "record_counts"},
     # Step/val metrics (NO kind key): at least a step number plus one

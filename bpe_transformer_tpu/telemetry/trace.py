@@ -2,15 +2,17 @@
 
 ``bpe-tpu report --trace out.json`` turns the unified telemetry stream's
 ``kind="span"`` records into Chrome trace-event *complete* events (``"ph":
-"X"``) and the periodic ``kind="engine"`` / ``kind="resources"`` snapshots
-into *counter* tracks (``"ph": "C"``), producing a file chrome://tracing
-and https://ui.perfetto.dev open directly.  Jax-free, like the rest of the
-report tooling.
+"X"``) and the periodic ``kind="engine"`` / ``kind="resources"`` /
+``kind="attribution"`` snapshots into *counter* tracks (``"ph": "C"``),
+producing a file chrome://tracing and https://ui.perfetto.dev open
+directly.  Jax-free, like the rest of the report tooling.
 
 Layout: every distinct span ``path`` gets its own named thread lane
 (first-seen order, so ``setup`` sorts above ``setup/resume`` — parents
-open before children), which keeps concurrent serving requests from
-garbling one another while the nesting stays readable from the lane names.
+open before children) — EXCEPT serving spans carrying a ``request_id``,
+which land in a per-request ``request/<id>`` lane so each request reads
+as one queue→prefill→decode timeline instead of interleaving with its
+neighbors in shared phase lanes.
 
 Timeline assumptions (declared in :data:`TRACE_ASSUMPTIONS`, cross-checked
 against the schema registry by ``tools/check_telemetry_schema.py``): span
@@ -35,10 +37,12 @@ TRACE_ASSUMPTIONS: dict[str, set[str]] = {
     "span": {"name", "path", "t", "dur_s"},
     "engine": {"kind", "t"},
     "resources": {"kind", "time_unix"},
+    "attribution": {"kind", "t"},
 }
 
 #: Counter series pulled from each periodic record kind.
 _ENGINE_COUNTERS = ("active_slots", "queue_depth", "tokens_per_sec")
+_ATTRIBUTION_COUNTERS = ("compute_frac", "collective_frac", "host_gap_frac")
 _RESOURCE_COUNTERS = (
     "host_rss_bytes",
     "live_buffer_bytes",
@@ -47,6 +51,12 @@ _RESOURCE_COUNTERS = (
 )
 
 _PID = 1
+
+#: Per-request serving lanes are capped: beyond this many distinct
+#: request_ids the remaining serve/* spans fall back to the shared phase
+#: lanes (serve/queue_wait|prefill|decode) — an hours-long serving stream
+#: must not explode into one Perfetto row per request.
+_MAX_REQUEST_LANES = 64
 
 
 def _manifest_epoch_unix(records: list[dict]) -> float | None:
@@ -99,6 +109,7 @@ def trace_events(records: list[dict]) -> list[dict]:
             )
         return tid
 
+    request_lanes: set[str] = set()
     epoch_unix = _manifest_epoch_unix(records)
     first_resources_unix = next(
         (
@@ -119,6 +130,20 @@ def trace_events(records: list[dict]) -> list[dict]:
             ):
                 continue
             path = str(record.get("path") or record.get("name") or "?")
+            # Per-request serving lanes: serve/* spans carry a request_id,
+            # and giving each request its own lane turns three overlapping
+            # phase lanes into one readable queue->prefill->decode timeline
+            # per request (concurrent requests no longer garble a shared
+            # serve/decode lane).  Capped at _MAX_REQUEST_LANES distinct
+            # requests; overflow stays in the shared phase lanes.
+            rid = record.get("request_id")
+            if rid and path.startswith("serve/"):
+                lane = f"request/{rid}"
+                if lane in request_lanes:
+                    path = lane
+                elif len(request_lanes) < _MAX_REQUEST_LANES:
+                    request_lanes.add(lane)
+                    path = lane
             args = {
                 k: v
                 for k, v in record.items()
@@ -151,6 +176,25 @@ def trace_events(records: list[dict]) -> list[dict]:
                         "ph": "C",
                         "pid": _PID,
                         "name": "engine",
+                        "ts": round(t * 1e6, 1),
+                        "args": series,
+                    }
+                )
+        elif kind == "attribution":
+            t = record.get("t")
+            if not isinstance(t, (int, float)):
+                continue
+            series = {
+                k: record[k]
+                for k in _ATTRIBUTION_COUNTERS
+                if isinstance(record.get(k), (int, float))
+            }
+            if series:
+                events.append(
+                    {
+                        "ph": "C",
+                        "pid": _PID,
+                        "name": "attribution",
                         "ts": round(t * 1e6, 1),
                         "args": series,
                     }

@@ -174,6 +174,7 @@ def cmd_train(args) -> int:
         wandb_project=args.wandb_project,
         health_stats=args.health_stats,
         dynamics_every=args.dynamics_every,
+        attribution_every=args.attribution_every,
         watchdog=args.watchdog,
         watchdog_factor=args.watchdog_factor,
         watchdog_policy=args.watchdog_policy,
@@ -380,6 +381,143 @@ def cmd_serve(args) -> int:
         logger.close()
 
 
+def cmd_profile(args) -> int:
+    """Performance attribution without a training job: the XLA cost-model
+    roofline of the compiled train step (and, with ``--serve``, the
+    serving bucket ladder), plus the measured compute / collective /
+    host-gap split when ``--measure N > 0`` — emitted to stdout and,
+    with ``--metrics-jsonl``, as a ``kind="attribution"`` telemetry
+    stream ``bpe-tpu report`` renders.  CPU-runnable (degraded: the
+    roofline verdicts read ``unknown`` without a TPU peak-table entry)."""
+    import jax
+
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.optim import adamw_init
+    from bpe_transformer_tpu.telemetry import (
+        MetricsLogger,
+        Telemetry,
+        run_manifest,
+    )
+    from bpe_transformer_tpu.telemetry.attribution import (
+        StepProbe,
+        serving_program_costs,
+    )
+    from bpe_transformer_tpu.training.train_step import TrainHParams
+    from bpe_transformer_tpu.utils.flops import (
+        peak_flops_per_chip,
+        peak_hbm_bytes_per_sec,
+    )
+
+    if args.checkpoint:
+        payload, model_config, _ = _load_inference_state(
+            args, need_tokenizer=False
+        )
+        params = payload["params"]
+    else:
+        model_config = _load_model_config(args)
+        params = init_params(jax.random.PRNGKey(args.seed), model_config)
+    opt_state = adamw_init(params)
+    device = jax.devices()[0]
+
+    probe = StepProbe(
+        model_config,
+        TrainHParams(),
+        batch_size=args.batch,
+        iters=max(args.measure, 1),
+        seed=args.seed,
+    )
+    rows = list(probe.program_costs(params, opt_state))
+    if args.serve:
+        rows += serving_program_costs(
+            params, model_config, slots=args.slots
+        )
+
+    peak_f = peak_flops_per_chip(device.device_kind)
+    peak_bw = peak_hbm_bytes_per_sec(device.device_kind)
+    header = f"== cost model ({device.device_kind}"
+    if peak_f and peak_bw:
+        header += (
+            f", peak {peak_f / 1e12:,.0f} TF/s / {peak_bw / 1e9:,.0f} GB/s"
+            f", ridge {peak_f / peak_bw:,.1f} flops/B"
+        )
+    print(header + ") ==")
+    print(f"  {'program':<18s}{'GFLOPs':>10s}{'MB moved':>10s}"
+          f"{'AI f/B':>9s}  verdict")
+
+    def fmt(value, width, scale=1.0, digits=2):
+        if value is None:
+            return f"{'-':>{width}s}"
+        return f"{value / scale:>{width},.{digits}f}"
+
+    for row in rows:
+        print(
+            f"  {row['name']:<18s}"
+            + fmt(row["flops"], 10, 1e9)
+            + fmt(row["bytes_accessed"], 10, 2**20, 1)
+            + fmt(row["arithmetic_intensity"], 9, 1.0, 1)
+            + f"  {row['bound']}"
+        )
+
+    record = None
+    if args.measure > 0:
+        wall = probe.loop_wall_step_s(params, opt_state, iters=args.measure)
+        record = probe.attribution_record(
+            params, opt_state, step=0, wall_step_s=wall, t=0.0,
+            include_programs=True,
+        )
+        record["programs"] = rows  # include the serving ladder if analyzed
+        print(f"== measured split ({args.measure} iters) ==")
+        coll = record["collective_frac"]
+        print(
+            f"  wall {record['wall_step_s'] * 1e3:,.2f} ms/step  "
+            f"device {record['device_step_s'] * 1e3:,.2f} ms  "
+            f"compute {record['compute_frac']:.0%}  collective "
+            + (f"{coll:.0%}" if coll is not None else "n/a")
+            + f"  host gap {record['host_gap_frac']:.0%}"
+        )
+
+    if args.metrics_jsonl:
+        logger = MetricsLogger(jsonl_path=args.metrics_jsonl)
+        try:
+            telemetry = Telemetry(sink=logger.log)
+            telemetry.emit(
+                run_manifest(
+                    kind="profile",
+                    model_config=model_config,
+                    extra={"batch": args.batch, "measure": args.measure},
+                )
+            )
+            if record is not None:
+                record["t"] = telemetry.now()
+                telemetry.emit(record)
+            telemetry.footer(clean=True)
+        finally:
+            logger.close()
+        print(f"wrote attribution stream -> {args.metrics_jsonl}")
+
+    if args.json:
+        summary = {
+            "metric": "attribution",
+            "config": args.preset or "custom",
+            "batch": args.batch,
+            "platform": device.platform,
+            "device_kind": device.device_kind,
+            "programs": rows,
+        }
+        if record is not None:
+            summary.update(
+                {
+                    k: record[k]
+                    for k in (
+                        "wall_step_s", "device_step_s", "compute_frac",
+                        "collective_frac", "host_gap_frac",
+                    )
+                }
+            )
+        print(json.dumps(summary))
+    return 0
+
+
 def cmd_report(args) -> int:
     # Pure host-side file parsing (telemetry.report imports no jax): safe on
     # a laptop reading a metrics.jsonl pulled off a TPU pod.
@@ -492,6 +630,18 @@ def build_parser() -> argparse.ArgumentParser:
         "attention entropy, and NaN/Inf localization by tensor path — "
         "computed inside the jitted step and fetched with the existing "
         "log sync, zero extra host syncs",
+    )
+    p.add_argument(
+        "--attribution-every",
+        type=int,
+        default=0,
+        metavar="N",
+        help='emit kind="attribution" performance-attribution records '
+        "every N steps (0 = off; N must be a multiple of --log-every): "
+        "the measured compute / collective / host-gap split of wall step "
+        "time plus one-off XLA cost-model roofline verdicts for the "
+        "compiled step — the probe runs only at attribution boundaries, "
+        "untouched steps pay zero extra host syncs",
     )
     p.add_argument(
         "--watchdog",
@@ -705,6 +855,37 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--special-token", action="append", default=None,
                    help='repeatable; default: ["<|endoftext|>"]')
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "profile",
+        help="performance attribution without a training job: XLA "
+        "cost-model roofline of the compiled train step (and serving "
+        "bucket ladder with --serve) + the measured compute/collective/"
+        "host-gap split; CPU-runnable (cost model only degrades to "
+        "'unknown' verdicts)",
+    )
+    p.add_argument("--preset", default=None, choices=sorted(PRESETS))
+    p.add_argument("--model-config", default=None, help="JSON config path")
+    p.add_argument("--checkpoint", default=None,
+                   help="profile a real checkpoint's weights instead of "
+                   "randomly initialized params")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--measure", type=int, default=10, metavar="ITERS",
+                   help="timed iterations for the measured split "
+                   "(0 = static cost model only)")
+    p.add_argument("--serve", action="store_true",
+                   help="also cost-model the serving program ladder "
+                   "(one prefill per bucket + the decode tick)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="slot-pool capacity for --serve analysis")
+    p.add_argument("--metrics-jsonl", default=None,
+                   help='write a manifest + kind="attribution" telemetry '
+                   "stream bpe-tpu report can render")
+    p.add_argument("--json", action="store_true",
+                   help="print a machine-readable summary line (bench "
+                   "queue evidence rows)")
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_profile, default_preset="tinystories-4l")
 
     p = sub.add_parser(
         "report",

@@ -77,6 +77,15 @@ class LoopConfig:
     #: be a multiple of log_every.  Not supported with parallel="sp"/"pp"
     #: (same constraint as health_stats).
     dynamics_every: int = 0
+    #: Emit kind="attribution" performance-attribution records every N
+    #: steps (0 = off; telemetry.attribution): the measured compute /
+    #: collective / host-gap split of wall step time plus, once per run,
+    #: XLA cost-model roofline verdicts for the compiled step.  The probe
+    #: (a non-donating AOT copy of the update) compiles and runs ONLY at
+    #: attribution boundaries — untouched steps pay zero extra host syncs
+    #: — so N must be a multiple of log_every.  Not supported with
+    #: parallel="sp"/"pp" (same constraint as dynamics_every).
+    attribution_every: int = 0
     #: Enable the telemetry watchdog: a background thread flags hung steps
     #: (no metric sync within watchdog_factor x the trailing median step
     #: time), and non-finite states detected at a log boundary follow
@@ -211,6 +220,24 @@ def train(
                 f"dynamics_every={loop.dynamics_every} must be a multiple "
                 f"of log_every={loop.log_every} — dynamics records ride "
                 "the log-cadence metric fetch (no extra host syncs)"
+            )
+    if loop.attribution_every < 0:
+        raise ValueError(
+            f"attribution_every must be >= 0, got {loop.attribution_every}"
+        )
+    if loop.attribution_every:
+        if loop.parallel in ("sp", "pp"):
+            raise ValueError(
+                f'attribution_every is not supported with parallel='
+                f'"{loop.parallel}" (sp/pp build their own update bodies); '
+                "drop --attribution-every or use a dp/GSPMD strategy"
+            )
+        if loop.attribution_every % loop.log_every:
+            raise ValueError(
+                f"attribution_every={loop.attribution_every} must be a "
+                f"multiple of log_every={loop.log_every} — attribution "
+                "probes run at log boundaries so untouched steps pay zero "
+                "extra host syncs"
             )
     if loop.watchdog and loop.watchdog_policy not in Watchdog.POLICIES:
         # Validate BEFORE any sink opens: a bad policy must not leak an open
@@ -631,6 +658,9 @@ def train(
         if rollback_mode
         else None
     )
+    #: Built lazily at the FIRST attribution boundary (the probe pays an
+    #: AOT compile; a run that never reaches its cadence pays nothing).
+    attribution_probe = None
     #: Advanced by each NaN rollback: mixes into the per-iteration batch
     #: seed so the retry samples DIFFERENT data over the replayed window —
     #: "skip the offending batch" without tracking which batch offended.
@@ -867,6 +897,62 @@ def train(
                     f"lr {record['lr']:.2e}  gnorm {record['grad_norm']:.3f}  "
                     f"tok/s {record['tokens_per_sec']:,.0f}"
                 )
+                if (
+                    loop.attribution_every
+                    and iteration % loop.attribution_every == 0
+                ):
+                    # Exact-cadence only (no is_last catch-up like
+                    # dynamics): the probe pays a real AOT compile, and a
+                    # run whose steps never reach the cadence must pay
+                    # nothing — no surprise multi-minute compile at the
+                    # final step of a short run.
+                    # Performance attribution (telemetry.attribution): a
+                    # non-donating AOT copy of the step is fenced-timed to
+                    # split this window's wall step time into compute /
+                    # collective / host-gap, with the XLA cost-model
+                    # roofline riding the first record.  Probe compile and
+                    # measure time are excluded from throughput and
+                    # watchdog-paused — untouched steps never see it.
+                    from bpe_transformer_tpu.telemetry.attribution import (
+                        StepProbe,
+                    )
+
+                    attr_handle = telemetry.start_span(
+                        "attribution_probe",
+                        step=iteration,
+                        compile_probe=attribution_probe is None,
+                    )
+                    with wd_pause():
+                        if attribution_probe is None:
+                            attribution_probe = StepProbe(
+                                model_config,
+                                hparams,
+                                batch_size=loop.batch_size,
+                                mesh=mesh,
+                                parallel=loop.parallel,
+                                accum_steps=accum,
+                                inner_steps=stride,
+                                seed=loop.seed,
+                            )
+                        attr_record = attribution_probe.attribution_record(
+                            params,
+                            opt_state,
+                            step=iteration,
+                            wall_step_s=step_wall_s,
+                            t=telemetry.now(),
+                        )
+                    timer.exclude(attr_handle.end())
+                    telemetry.emit(attr_record)
+                    log_fn(
+                        f"step {iteration:>6d}  attribution: compute "
+                        f"{attr_record['compute_frac']:.0%}  collective "
+                        + (
+                            f"{attr_record['collective_frac']:.0%}"
+                            if attr_record["collective_frac"] is not None
+                            else "n/a"
+                        )
+                        + f"  host gap {attr_record['host_gap_frac']:.0%}"
+                    )
                 if wd is not None:
                     # A window of only warmup steps has no meaningful step
                     # time; beat without a sample rather than seeding the

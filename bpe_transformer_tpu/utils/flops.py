@@ -16,22 +16,74 @@ einsums (QK^T and AV, 2 matmuls of 2*S*d FLOPs per token per layer).
 
 from __future__ import annotations
 
+import warnings
+
 from bpe_transformer_tpu.models.config import ModelConfig
 
 #: Peak dense FLOPs/sec per chip, bf16, by device_kind substring.  Sources:
-#: public TPU spec sheets (v4 275 TF, v5e 197 TF, v5p 459 TF, v6e 918 TF,
-#: v3 123 TF per chip).  Matching is substring-based on
-#: ``jax.devices()[0].device_kind`` (e.g. "TPU v4").
+#: public TPU spec sheets (v4 275 TF, v4i 138 TF, v5e 197 TF, v5p 459 TF,
+#: v6e/Trillium 918 TF, v3 123 TF per chip).  Matching is substring-based
+#: on ``jax.devices()[0].device_kind`` (e.g. "TPU v4") with the longest/
+#: most-specific patterns first, so "v5p" never falls through to "v5".
 _PEAK_FLOPS_BY_KIND: tuple[tuple[str, float], ...] = (
+    ("trillium", 918e12),
+    ("v6e", 918e12),
     ("v6", 918e12),
     ("v5p", 459e12),
     ("v5e", 197e12),
     ("v5 lite", 197e12),
     ("v5litepod", 197e12),
+    ("v4i", 138e12),
+    ("v4 lite", 138e12),
     ("v4", 275e12),
     ("v3", 123e12),
     ("v2", 46e12),
 )
+
+#: Peak HBM bandwidth per chip, bytes/sec, same spec sheets (v2 700 GB/s,
+#: v3 900, v4 1228, v4i 614, v5e 819, v5p 2765, v6e 1640) — the second
+#: axis of the roofline `telemetry.attribution` classifies compiled
+#: programs against (ridge point = peak FLOPs / peak bytes).
+_PEAK_HBM_BW_BY_KIND: tuple[tuple[str, float], ...] = (
+    ("trillium", 1640e9),
+    ("v6e", 1640e9),
+    ("v6", 1640e9),
+    ("v5p", 2765e9),
+    ("v5e", 819e9),
+    ("v5 lite", 819e9),
+    ("v5litepod", 819e9),
+    ("v4i", 614e9),
+    ("v4 lite", 614e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+
+#: device_kinds already warned about — warn ONCE per kind per process, not
+#: once per logged step (a training loop asks every log boundary).
+_warned_unknown_kinds: set[str] = set()
+
+
+def _lookup_peak(
+    table: tuple[tuple[str, float], ...], device_kind: str, what: str
+) -> float | None:
+    kind = device_kind.lower()
+    for pattern, peak in table:
+        if pattern in kind:
+            return peak
+    non_tpu = any(s in kind for s in ("cpu", "gpu", "cuda", "nvidia", "rocm"))
+    if device_kind not in _warned_unknown_kinds and not non_tpu:
+        # CPU/GPU backends legitimately have no TPU peak entry (MFU is a
+        # TPU-first metric here); an unrecognized TPU generation, though,
+        # silently disables MFU/roofline — say so once instead.
+        _warned_unknown_kinds.add(device_kind)
+        warnings.warn(
+            f"no {what} table entry for device_kind {device_kind!r}; "
+            "MFU/roofline accounting disabled for it — extend "
+            "bpe_transformer_tpu/utils/flops.py",
+            stacklevel=3,
+        )
+    return None
 
 
 def matmul_param_count(config: ModelConfig) -> int:
@@ -62,12 +114,15 @@ def train_step_flops(config: ModelConfig, batch: int, seq: int | None = None) ->
 
 
 def peak_flops_per_chip(device_kind: str) -> float | None:
-    """Peak bf16 FLOPs/sec for a TPU device_kind string, or None if unknown."""
-    kind = device_kind.lower()
-    for pattern, peak in _PEAK_FLOPS_BY_KIND:
-        if pattern in kind:
-            return peak
-    return None
+    """Peak bf16 FLOPs/sec for a TPU device_kind string, or None if unknown
+    (warned once per kind — a silent None quietly disables MFU)."""
+    return _lookup_peak(_PEAK_FLOPS_BY_KIND, device_kind, "peak-FLOPs")
+
+
+def peak_hbm_bytes_per_sec(device_kind: str) -> float | None:
+    """Peak HBM bandwidth in bytes/sec for a TPU device_kind string, or
+    None if unknown (warned once per kind, shared with the FLOPs lookup)."""
+    return _lookup_peak(_PEAK_HBM_BW_BY_KIND, device_kind, "HBM-bandwidth")
 
 
 def mfu(

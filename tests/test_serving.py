@@ -470,7 +470,8 @@ def test_report_serving_fixture_pinned():
     assert "== serving ==" in report
     assert "requests 3" in report and "compiled_programs 4" in report
     assert "tokens/sec mean 233.333  (peak 250)" in report
-    assert "decode      n=3    p50 1.3s  p95 2.2s  max 2.2s" in report
+    assert "decode      n=3    p50 1.3s  p95 2.2s  p99 2.2s  max 2.2s" in report
+    assert "slow tail dominated by decode" in report
     assert "anomalies (0)" in report and "clean footer" in report
 
 
@@ -858,3 +859,75 @@ def test_cli_serve_http_smoke(tmp_path, setup):
     assert "== serving ==" in report.stdout
     for phase in ("serve/queue_wait", "serve/prefill", "serve/decode"):
         assert phase in report.stdout, report.stdout
+
+
+# -------------------------------- per-request traces & per-bucket metrics
+
+
+def test_per_bucket_prefill_and_decode_throughput_metrics(setup):
+    """/metrics grows per-bucket prefill token-throughput, cumulative
+    decode throughput, and a compile-time gauge; stats() carries the same
+    aggregates (prefill_bucket_work / decode_*) without shadowing the
+    engine's bucket-ladder list.  A bucket's compile-paying first
+    admission is counted as a request + compile but excluded from the
+    throughput accumulator, so the gauge reflects steady-state prefill."""
+    params, prompts = setup
+    with ServingEngine(params, CFG, slots=2, min_bucket=8) as serving:
+        serving.generate(prompts[0], max_new_tokens=3, temperature=0.0)  # b=8, cold
+        serving.generate(prompts[1], max_new_tokens=3, temperature=0.0)  # b=8, warm
+        serving.generate(prompts[2], max_new_tokens=3, temperature=0.0)  # b=16, cold
+        stats = serving.stats()
+        prom = _parse_prom(serving.prometheus_metrics())
+
+    # The bucket-ladder list survives (no key shadowing by the snapshot).
+    assert stats["prefill_buckets"] == [8, 16, 32]
+    work = stats["prefill_bucket_work"]
+    # Bucket 8: cold + warm — only the warm admission's tokens/seconds
+    # enter the throughput accumulator; the cold one shows as a compile.
+    assert work[8]["requests"] == 2 and work[8]["compiles"] == 1
+    assert work[8]["tokens"] == len(prompts[1])
+    assert work[8]["tokens_per_sec"] > 0
+    # Bucket 16: only a cold admission so far — no throughput sample yet.
+    assert work[16]["requests"] == 1 and work[16]["compiles"] == 1
+    assert work[16]["tokens"] == 0 and work[16]["tokens_per_sec"] is None
+    assert stats["decode_tokens"] > 0
+    assert stats["decode_seconds"] > 0
+    assert stats["decode_tokens_per_sec"] > 0
+
+    assert prom['bpe_tpu_prefill_requests_total{bucket="8"}'] == 2
+    assert prom['bpe_tpu_prefill_compiles_total{bucket="8"}'] == 1
+    assert prom['bpe_tpu_prefill_tokens_total{bucket="8"}'] == len(prompts[1])
+    assert prom['bpe_tpu_prefill_tokens_total{bucket="16"}'] == 0
+    assert prom['bpe_tpu_prefill_seconds_total{bucket="8"}'] >= 0
+    assert prom["bpe_tpu_decode_tokens_total"] > 0
+    assert prom["bpe_tpu_decode_seconds_total"] > 0
+    assert prom["bpe_tpu_decode_tokens_per_sec"] > 0
+    # Cumulative XLA compile time: this engine paid real compiles.
+    assert prom["bpe_tpu_compile_time_seconds_total"] > 0
+
+
+def test_statusz_recent_requests_ring_traces_phases(setup):
+    """/statusz exposes a per-request trace ring: each finished request's
+    queue_wait/prefill/decode timeline with its request_id, bucket, and
+    finish reason — the live per-request view (the JSONL spans carry the
+    same numbers for the offline one)."""
+    params, prompts = setup
+    with ServingEngine(params, CFG, slots=2, min_bucket=8) as serving:
+        r1 = serving.generate(prompts[0], max_new_tokens=3, temperature=0.0)
+        r2 = serving.generate(prompts[2], max_new_tokens=2, temperature=0.0)
+        page = serving.statusz()
+
+    recent = page["recent_requests"]
+    assert [r["request_id"] for r in recent] == [r1.request_id, r2.request_id]
+    first = recent[0]
+    assert first["finish_reason"] == "length"
+    assert first["n_tokens"] == 3
+    assert first["prompt_len"] == len(prompts[0])
+    assert first["bucket"] == 8
+    assert first["queue_wait_s"] >= 0
+    assert first["prefill_s"] > 0
+    assert first["decode_s"] >= 0
+    # The ring agrees with the Result the caller saw (one measurement,
+    # two surfaces).
+    assert first["prefill_s"] == pytest.approx(r1.prefill_s, abs=1e-6)
+    json.dumps(page)  # statusz stays one JSON document

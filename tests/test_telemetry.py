@@ -1387,3 +1387,354 @@ def test_report_trace_cli_writes_chrome_trace(tmp_path, capsys):
     capture = tmp_path / "cap.json"
     capture.write_text(json.dumps({"metric": "tok/s", "value": 1.0}))
     assert report_main([str(capture), "--trace", str(tmp_path / "t.json")]) == 2
+
+
+# ------------------------------------------- attribution: cost model, probe
+
+
+def test_time_call_and_program_cost_cpu_smoke():
+    """The shared measurement path (telemetry.attribution): XLA
+    cost_analysis of an AOT-compiled program yields positive flops/bytes
+    on CPU too (the cost model is tier-1-testable), and time_call returns
+    a positive mean ms."""
+    import jax
+
+    from bpe_transformer_tpu.telemetry.attribution import (
+        program_cost,
+        time_call,
+    )
+
+    def f(a, b):
+        return (a @ b).sum()
+
+    x = jnp.ones((64, 128))
+    y = jnp.ones((128, 32))
+    compiled = jax.jit(f).lower(x, y).compile()
+    cost = program_cost(compiled)
+    assert cost["flops"] and cost["flops"] > 0
+    assert cost["bytes_accessed"] and cost["bytes_accessed"] > 0
+    assert time_call(compiled, x, y, iters=2, warmup=1) > 0
+
+
+def test_roofline_verdicts_and_unknown_device():
+    from bpe_transformer_tpu.telemetry.attribution import roofline
+
+    # TPU v4: peak 275 TF/s over 1228 GB/s -> ridge ~223.9 flops/byte.
+    high = roofline(1e12, 1e9, "TPU v4", name="matmul")  # AI 1000
+    low = roofline(1e9, 1e9, "TPU v4", name="gather")  # AI 1
+    assert high["bound"] == "compute-bound"
+    assert low["bound"] == "memory-bound"
+    assert high["ridge_flops_per_byte"] == pytest.approx(223.9, abs=0.1)
+    # No peak-table entry (CPU): intensity still reported, verdict honest.
+    unknown = roofline(1e12, 1e9, "cpu")
+    assert unknown["bound"] == "unknown"
+    assert unknown["arithmetic_intensity"] == 1000.0
+    # Degenerate counters: no crash, no fake verdict.
+    assert roofline(None, None, "TPU v4")["bound"] == "unknown"
+
+
+def test_peak_tables_and_warn_once_on_unknown_kind():
+    import warnings
+
+    from bpe_transformer_tpu.utils import flops as flops_mod
+
+    assert flops_mod.peak_flops_per_chip("TPU v5p") == 459e12
+    assert flops_mod.peak_flops_per_chip("TPU v6e") == 918e12
+    assert flops_mod.peak_hbm_bytes_per_sec("TPU v4") == 1228e9
+    # Unknown TPU generation: None + exactly ONE warning per kind (a
+    # silent None quietly disables MFU/roofline for the whole run).
+    flops_mod._warned_unknown_kinds.discard("TPU v99")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert flops_mod.peak_flops_per_chip("TPU v99") is None
+        assert flops_mod.peak_flops_per_chip("TPU v99") is None
+    assert len([w for w in caught if "TPU v99" in str(w.message)]) == 1
+    # CPU/GPU backends are not TPU generations — no warning noise there.
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert flops_mod.peak_flops_per_chip("cpu") is None
+    assert not caught
+
+
+def test_attribution_every_validation():
+    from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+
+    data = np.zeros(10_000, np.uint16)
+    with pytest.raises(ValueError, match="attribution_every"):
+        train(
+            TINY, TrainHParams(**HP),
+            LoopConfig(steps=2, batch_size=8, attribution_every=-1),
+            data,
+        )
+    with pytest.raises(ValueError, match="multiple of log_every"):
+        train(
+            TINY, TrainHParams(**HP),
+            LoopConfig(
+                steps=4, batch_size=8, log_every=4, attribution_every=3
+            ),
+            data,
+        )
+
+
+def _counting_attr_train(monkeypatch, byte_data, tmp_path, attribution_every):
+    """Like _counting_train, parameterized on attribution_every."""
+    import jax
+
+    from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+
+    counts = {"device_get": 0, "block_until_ready": 0}
+    real_get, real_block = jax.device_get, jax.block_until_ready
+
+    def counting_get(x):
+        counts["device_get"] += 1
+        return real_get(x)
+
+    def counting_block(x):
+        counts["block_until_ready"] += 1
+        return real_block(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    monkeypatch.setattr(jax, "block_until_ready", counting_block)
+    jsonl = tmp_path / f"attr_{attribution_every}.jsonl"
+    loop = LoopConfig(
+        steps=8,
+        batch_size=8,
+        log_every=4,
+        eval_every=100,
+        checkpoint_every=100,
+        metrics_jsonl=str(jsonl),
+        attribution_every=attribution_every,
+    )
+    train(TINY, TrainHParams(**HP), loop, byte_data, log_fn=lambda *_: None)
+    monkeypatch.setattr(jax, "device_get", real_get)
+    monkeypatch.setattr(jax, "block_until_ready", real_block)
+    return load_records(jsonl), counts
+
+
+def test_attribution_loop_emits_records_at_bounded_fetch_cost(
+    monkeypatch, tmp_path, byte_data
+):
+    """ACCEPTANCE: --attribution-every emits kind="attribution" records
+    whose compute+collective+host fractions sum to ~1.0 — and the ONLY
+    extra host syncs vs a plain run are the probe's own fenced timings at
+    the single attribution boundary (StepProbe.FETCHES_PER_MEASURE per
+    timed variant); untouched steps pay zero."""
+    from bpe_transformer_tpu.telemetry import validate_record
+    from bpe_transformer_tpu.telemetry.attribution import StepProbe
+
+    records_off, counts_off = _counting_attr_train(
+        monkeypatch, byte_data, tmp_path, attribution_every=0
+    )
+    records_on, counts_on = _counting_attr_train(
+        monkeypatch, byte_data, tmp_path, attribution_every=8
+    )
+    # One boundary (step 8), one single-device variant -> exactly
+    # FETCHES_PER_MEASURE extra value fetches; no extra sync barriers.
+    assert counts_on["device_get"] == (
+        counts_off["device_get"] + StepProbe.FETCHES_PER_MEASURE
+    )
+    assert counts_on["block_until_ready"] == counts_off["block_until_ready"]
+
+    attributions = [
+        r for r in records_on if r.get("kind") == "attribution"
+    ]
+    assert [r["step"] for r in attributions] == [8]
+    record = attributions[0]
+    assert validate_record(record) == []
+    total = (
+        record["compute_frac"]
+        + (record["collective_frac"] or 0.0)
+        + record["host_gap_frac"]
+    )
+    assert total == pytest.approx(1.0, abs=0.02)
+    assert record["device_step_s"] > 0
+    # Single device: the collective split is exactly zero, not null.
+    assert record["collective_frac"] == 0.0
+    # The first record carries the static cost-model rows.
+    programs = record["programs"]
+    assert programs and programs[0]["name"] == "train_step"
+    assert programs[0]["flops"] > 0
+    assert programs[0]["bound"] in (
+        "compute-bound", "memory-bound", "unknown"
+    )
+    # The probe's compile+measure time is spanned (and thus excluded from
+    # the throughput window by the loop).
+    assert any(
+        r.get("kind") == "span" and r.get("name") == "attribution_probe"
+        for r in records_on
+    )
+    # Flag off: no attribution records at all.
+    assert not [r for r in records_off if r.get("kind") == "attribution"]
+
+
+# ------------------------------- attribution: fixture, report, monitor, trace
+
+
+def test_report_attribution_fixture_pins_section_and_compare(
+    tmp_path, capsys
+):
+    """The committed attribution_tiny.jsonl pins the report's attribution
+    section (step-time split, MFU ceiling, per-program roofline verdicts)
+    and feeds the --compare gate: a stream whose collective_frac grew
+    regresses with exit 3."""
+    from bpe_transformer_tpu.telemetry.report import main as report_main
+
+    fixture = str(FIXTURES / "attribution_tiny.jsonl")
+    assert report_main([fixture]) == 0
+    out = capsys.readouterr().out
+    assert "== attribution (2 records, steps 50..100) ==" in out
+    assert "compute 64.0%" in out
+    assert "collective 10.5%" in out
+    assert "host gap 25.5%" in out
+    assert "mfu 0.13 -> 0.197 ceiling" in out
+    assert "train_step" in out and "compute-bound" in out
+    assert "decode_tick[8]" in out and "memory-bound" in out
+
+    # Self-compare: shared metrics (incl. the new fraction gates), exit 0.
+    assert report_main([fixture, "--compare", fixture]) == 0
+    out = capsys.readouterr().out
+    assert "collective_frac" in out and "host_gap_frac" in out
+    assert "no regressions" in out
+
+    # A stream whose collective fraction doubled: gate trips (exit 3).
+    regressed = tmp_path / "attr_regressed.jsonl"
+    regressed.write_text(
+        Path(fixture).read_text()
+        .replace('"collective_frac": 0.11', '"collective_frac": 0.3')
+        .replace('"collective_frac": 0.1,', '"collective_frac": 0.28,')
+    )
+    assert report_main([str(regressed), "--compare", fixture]) == 3
+    assert "collective_frac" in capsys.readouterr().out
+
+
+def test_monitor_folds_attribution_into_live_state():
+    from bpe_transformer_tpu.telemetry.monitor import (
+        fold_records,
+        render_frame,
+    )
+
+    records = load_records(FIXTURES / "attribution_tiny.jsonl")
+    state = fold_records(records)
+    assert state["compute_frac"] == 0.66  # latest record wins
+    assert state["collective_frac"] == 0.1
+    assert state["host_gap_frac"] == 0.24
+    assert state["attribution_step"] == 100
+    assert state["bound_verdict"] == "train_step compute-bound"
+    frame = render_frame(state, "fixture")
+    assert "attr" in frame
+    assert "compute 66%" in frame
+    assert "[train_step compute-bound]" in frame
+
+
+def test_trace_attribution_counters_and_request_lanes(tmp_path):
+    """The Chrome trace export grows an attribution counter track, and
+    serving spans carrying a request_id land in per-request lanes (one
+    queue->prefill->decode timeline per request)."""
+    from bpe_transformer_tpu.telemetry.trace import trace_events
+
+    events = trace_events(load_records(FIXTURES / "attribution_tiny.jsonl"))
+    counters = [
+        e for e in events if e.get("ph") == "C" and e["name"] == "attribution"
+    ]
+    assert len(counters) == 2
+    assert counters[0]["args"]["compute_frac"] == 0.62
+    assert counters[-1]["args"]["host_gap_frac"] == 0.24
+
+    events = trace_events(load_records(FIXTURES / "serving_tiny.jsonl"))
+    lanes = {
+        e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    assert "request/req-a" in lanes and "request/req-b" in lanes
+    # All three phases of req-a share its lane (a per-request timeline).
+    tid_by_lane = {
+        e["args"]["name"]: e["tid"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    req_a_spans = [
+        e
+        for e in events
+        if e.get("ph") == "X" and e.get("tid") == tid_by_lane["request/req-a"]
+    ]
+    assert {e["name"] for e in req_a_spans} == {
+        "queue_wait", "prefill", "decode"
+    }
+
+    # Lane cap: a long serving stream must not explode into one Perfetto
+    # row per request — beyond _MAX_REQUEST_LANES distinct ids the spans
+    # fall back to the shared phase lanes.
+    from bpe_transformer_tpu.telemetry.trace import _MAX_REQUEST_LANES
+
+    many = [
+        {"kind": "span", "name": "decode", "path": "serve/decode",
+         "t": i * 0.01, "dur_s": 0.005, "request_id": f"req-{i:04d}"}
+        for i in range(_MAX_REQUEST_LANES + 20)
+    ]
+    lanes = {
+        e["args"]["name"]
+        for e in trace_events(many)
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    req_lanes = {l for l in lanes if l.startswith("request/")}
+    assert len(req_lanes) == _MAX_REQUEST_LANES
+    assert "serve/decode" in lanes  # overflow kept the shared phase lane
+
+
+def test_report_serving_total_p99_and_dominant_phase(capsys):
+    """The serving section attributes tail latency to a phase: total
+    request p50/p95/p99 assembled from the request_id-tagged spans, with
+    the slow tail's dominant phase named."""
+    from bpe_transformer_tpu.telemetry.report import main as report_main
+
+    records = load_records(FIXTURES / "serving_tiny.jsonl")
+    serving = summarize(records)["serving"]
+    assert serving["requests_traced"] == 3
+    assert serving["total"]["p99_s"] is not None
+    assert serving["slow_dominant_phase"] == "decode"
+    assert serving["phases"]["decode"]["p99_s"] is not None
+
+    assert report_main([str(FIXTURES / "serving_tiny.jsonl")]) == 0
+    out = capsys.readouterr().out
+    assert "slow tail dominated by decode" in out
+
+
+def test_profile_cli_smoke(tmp_path, capsys):
+    """ACCEPTANCE (CPU degraded mode): bpe-tpu profile runs the cost model
+    + measured split end to end on CPU, writes a schema-valid attribution
+    stream, and the report renders its section."""
+    from bpe_transformer_tpu.telemetry import validate_record
+    from bpe_transformer_tpu.telemetry.report import main as report_main
+    from bpe_transformer_tpu.training.cli import main as cli_main
+
+    stream = tmp_path / "profile.jsonl"
+    rc = cli_main(
+        [
+            "profile", "--preset", "ts-test", "--batch", "2",
+            "--measure", "1", "--serve", "--slots", "2",
+            "--metrics-jsonl", str(stream), "--json",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "== cost model" in out and "train_step" in out
+    assert "prefill[16]" in out and "decode_tick[2]" in out
+    assert "== measured split" in out
+
+    records = load_records(stream)
+    attribution = next(
+        r for r in records if r.get("kind") == "attribution"
+    )
+    assert validate_record(attribution) == []
+    total = (
+        attribution["compute_frac"]
+        + (attribution["collective_frac"] or 0.0)
+        + attribution["host_gap_frac"]
+    )
+    assert total == pytest.approx(1.0, abs=0.02)
+    # The stream is a real telemetry stream: manifest + footer + report.
+    assert any(r.get("kind") == "manifest" for r in records)
+    assert any(r.get("kind") == "footer" for r in records)
+    assert report_main([str(stream)]) == 0
+    assert "== attribution" in capsys.readouterr().out

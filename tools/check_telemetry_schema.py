@@ -20,6 +20,12 @@ Three checks, all static/jax-free (wired into tier-1 via
    reads (its ``TRACE_ASSUMPTIONS``) must be a required field of the
    corresponding kind, so a schema change cannot silently break the
    Chrome trace export.
+5. **Fixture coverage** — every registered kind must appear in at least
+   one committed ``tests/fixtures/*.jsonl`` stream (the pinned wire
+   format): a kind nobody pins is a kind whose renderers regress
+   silently.  (``metric`` is exempt from the literal-kind grep — it is
+   the pseudo-kind of the kind-less step records, matched by a bare
+   ``"step"`` + ``"loss"`` record instead.)
 
 Exit 0 when clean; 1 with one line per violation otherwise.
 """
@@ -118,6 +124,32 @@ def check_fixtures() -> list[str]:
     return problems
 
 
+def check_fixture_coverage() -> list[str]:
+    """Every registered record kind is exercised by a committed fixture."""
+    from bpe_transformer_tpu.telemetry.schema import record_kind
+
+    seen: set[str] = set()
+    for path in sorted((REPO / "tests" / "fixtures").glob("*.jsonl")):
+        for line in path.read_text(encoding="utf-8").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(record, dict):
+                seen.add(record_kind(record))
+    problems = []
+    for kind in RECORD_SCHEMAS:
+        if kind not in seen:
+            problems.append(
+                f"record kind {kind!r} appears in no tests/fixtures/*.jsonl "
+                "stream — add a fixture record so its renderers are pinned"
+            )
+    return problems
+
+
 def check_trace_assumptions() -> list[str]:
     from bpe_transformer_tpu.telemetry.trace import TRACE_ASSUMPTIONS
 
@@ -147,6 +179,7 @@ def main() -> int:
         + check_docs()
         + check_fixtures()
         + check_trace_assumptions()
+        + check_fixture_coverage()
     )
     for problem in problems:
         print(f"telemetry-schema: {problem}", file=sys.stderr)
