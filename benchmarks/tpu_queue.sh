@@ -304,6 +304,15 @@ run_job attribution 900 "$OUT/attribution.jsonl" \
   --preset tinystories-4l --batch 32 --measure 10 \
   --metrics-jsonl "$MIR/attribution_stream.jsonl" --json
 
+# Sharded optimizer + step overlap (PR 7): plain dp vs dp+ZeRO-1(+prefetch)
+# through the real training loop on every local chip — the row carries
+# per-chip opt-state bytes (expect ~1/N), the attribution host-gap split,
+# and tok/s/chip for both variants, so the memory win and the throughput
+# guardrail land in one machine-checked line.  "--json"-style platform
+# field means the CPU-fallback guard applies.
+run_job sharded_opt 1500 "$CAP/sharded_opt.jsonl" \
+  python benchmarks/bench_sharded_opt.py --config tinystories-4l
+
 # Kill-resume smoke (resilience layer, PR 5): SIGTERM a short training
 # run midway on the chip and assert the preemption exit code + emergency
 # checkpoint + clean --resume completion — the recovery paths the CPU
@@ -385,6 +394,62 @@ print(
 PY
 )
   [ -n "$ATTR_LINE" ] && log "attribution self-report: $ATTR_LINE"
+fi
+# Sharded-optimizer self-report (jax-free, CPU-only): the newest
+# sharded_opt row's per-chip opt-state bytes, host-gap fractions, and
+# tok/s/chip vs the plain variant AND vs the plain headline capture —
+# the PR-7 "did the memory/overlap win land without costing speed" line.
+if [ -s "$CAP/sharded_opt.jsonl" ]; then
+  SHARD_LINE=$(env JAX_PLATFORMS=cpu python - "$CAP/sharded_opt.jsonl" "$HEADLINE_CAP" <<'PY'
+import json, sys
+
+row = None
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if not ln:
+        continue
+    try:
+        r = json.loads(ln)
+    except json.JSONDecodeError:
+        continue
+    if r.get("metric") == "sharded_opt":
+        row = r  # newest row wins
+if row is None:
+    sys.exit(0)
+
+
+def pct(v):
+    return f"{v:.0%}" if isinstance(v, (int, float)) else "n/a"
+
+
+def num(v):
+    return f"{v:,.0f}" if isinstance(v, (int, float)) else "n/a"
+
+
+headline = None
+try:
+    with open(sys.argv[2]) as f:
+        cap = json.load(f)
+    parsed = cap.get("parsed") if isinstance(cap.get("parsed"), dict) else cap
+    headline = parsed.get("value")
+except Exception:
+    pass
+
+parts = [
+    f"opt_bytes/chip {num(row.get('opt_state_bytes'))} "
+    f"(plain {num(row.get('opt_state_bytes_plain'))}, "
+    f"ratio {row.get('opt_bytes_ratio', 'n/a')})",
+    f"host_gap {pct(row.get('host_gap_frac'))} "
+    f"(plain {pct(row.get('host_gap_frac_plain'))})",
+    f"tok/s/chip {num(row.get('value'))} "
+    f"(plain {num(row.get('plain_tokens_per_sec_per_chip'))})",
+]
+if isinstance(headline, (int, float)):
+    parts.append(f"headline capture {num(headline)}")
+print("  ".join(parts))
+PY
+)
+  [ -n "$SHARD_LINE" ] && log "sharded_opt self-report: $SHARD_LINE"
 fi
 log "queue pass complete"
 # Same size guard as the restore: never shrink the mirrored history.

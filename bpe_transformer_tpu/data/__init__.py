@@ -2,6 +2,7 @@
 
 from bpe_transformer_tpu.data.dataset import (
     BatchLoader,
+    BatchPrefetcher,
     check_dataset_geometry,
     get_batch,
     load_token_file,
@@ -10,6 +11,7 @@ from bpe_transformer_tpu.data.dataset import (
 
 __all__ = [
     "BatchLoader",
+    "BatchPrefetcher",
     "check_dataset_geometry",
     "get_batch",
     "load_token_file",

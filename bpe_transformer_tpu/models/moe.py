@@ -126,16 +126,21 @@ def switch_ffn(
         # Flat slot index; dropped assignments land on a sentinel slot past
         # the real e*cap range.
         dest = jnp.where(kept, expert_of_row * cap + pos_of_row, e * cap)
-        # slot -> source token (sentinel n = the appended zero row).  Kept
-        # destinations are unique by construction (cumsum queueing), so the
-        # scatter is collision-free over real slots.
+        # slot -> source token (sentinel n = out of bounds, reads a zero
+        # row below).  Kept destinations are unique by construction (cumsum
+        # queueing), so the scatter is collision-free over real slots.
         slot_src = (
             jnp.full((e * cap + 1,), n, jnp.int32).at[dest].set(src_token)
         )
-        tokens_pad = jnp.concatenate([tokens, jnp.zeros((1, d), compute_dtype)])
-        expert_in = jnp.take(tokens_pad, slot_src[: e * cap], axis=0).reshape(
-            e, cap, d
-        )
+        # mode="fill": empty slots (index n, out of bounds) read zeros.
+        # Deliberately NOT a concat-of-a-zero-row + clamped take: gathering
+        # from a concatenation of a batch-sharded operand miscompiles under
+        # the GSPMD partitioner (wrong rows near the shard boundary —
+        # tests/test_moe.py::test_ep_step_matches_single_device[gather]),
+        # while an OOB-fill gather partitions correctly.
+        expert_in = jnp.take(
+            tokens, slot_src[: e * cap], axis=0, mode="fill", fill_value=0
+        ).reshape(e, cap, d)
     else:
         dispatch = (
             keep[:, :, None]
@@ -155,13 +160,12 @@ def switch_ffn(
     expert_out = jnp.einsum("ecf,edf->ecd", h, moe_params["w2"])
 
     if config.moe_dispatch == "gather":
+        # Dropped assignments carry the sentinel dest e*cap: out of bounds,
+        # filled with zeros (same no-concat rule as the dispatch gather).
         out_rows = jnp.take(
-            jnp.concatenate(
-                [expert_out.reshape(e * cap, d), jnp.zeros((1, d), expert_out.dtype)]
-            ),
-            dest,
-            axis=0,
-        )  # (k·n, d); dropped assignments read the zero row
+            expert_out.reshape(e * cap, d), dest, axis=0,
+            mode="fill", fill_value=0,
+        )  # (k·n, d)
         gates_flat = (gates.T.reshape(kn) * jnp.sum(keep, axis=1)).astype(
             compute_dtype
         )

@@ -2,11 +2,25 @@
 
 from bpe_transformer_tpu.optim.adamw import AdamWState, adamw_init, adamw_update
 from bpe_transformer_tpu.optim.schedule import cosine_schedule, cosine_schedule_jax
+from bpe_transformer_tpu.optim.sharded import (
+    ShardedAdamWState,
+    restore_opt_state,
+    shard_opt_state,
+    sharded_adamw_init,
+    sharded_adamw_update,
+    unshard_opt_state,
+)
 
 __all__ = [
     "AdamWState",
+    "ShardedAdamWState",
     "adamw_init",
     "adamw_update",
     "cosine_schedule",
     "cosine_schedule_jax",
+    "restore_opt_state",
+    "shard_opt_state",
+    "sharded_adamw_init",
+    "sharded_adamw_update",
+    "unshard_opt_state",
 ]

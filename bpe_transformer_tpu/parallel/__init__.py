@@ -18,6 +18,8 @@ from bpe_transformer_tpu.parallel.sharding import (
     param_shardings,
     param_specs,
     shard_params,
+    zero1_opt_shardings,
+    zero1_opt_specs,
 )
 from bpe_transformer_tpu.parallel.pp import (
     init_pp_opt_state,
@@ -65,4 +67,6 @@ __all__ = [
     "replicated",
     "shard_batch",
     "shard_params",
+    "zero1_opt_shardings",
+    "zero1_opt_specs",
 ]

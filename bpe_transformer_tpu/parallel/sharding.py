@@ -117,6 +117,49 @@ def param_specs(
     return jax.tree_util.tree_map_with_path(rule, params)
 
 
+def zero1_opt_specs(
+    params, mesh: Mesh, strategy: str = "dp", *, fsdp_axis: str = "data"
+):
+    """ZeRO-1 partition specs for optimizer-moment leaves under GSPMD.
+
+    Starts from the strategy's param specs and additionally shards each
+    leaf's largest still-unsharded divisible dim along the data axis — so
+    AdamW m/v live 1/N per chip even when the params themselves are
+    replicated (plain dp) or only model-sharded (tp).  Annotating the
+    opt-state in/out shardings with these specs is all GSPMD needs: XLA
+    derives the reduce-scatter (grads → owned shard) and all-gather
+    (fresh params) schedule from the annotations, the Xu et al.
+    arXiv:2004.13336 weight-update sharding expressed declaratively.
+    Under ``fsdp`` the extension is a no-op (moments already shard with
+    the params).  Tiny leaves (< FSDP_MIN_SIZE) stay replicated.
+    """
+    base = param_specs(params, mesh, strategy, fsdp_axis=fsdp_axis)
+    size = mesh.shape.get(fsdp_axis, 1)
+
+    def extend(leaf, spec):
+        s = list(spec) + [None] * (leaf.ndim - len(spec))
+        if size <= 1 or fsdp_axis in s or leaf.size < FSDP_MIN_SIZE:
+            return P(*s)
+        return P(*_fsdp_extend(s, leaf.shape, size, fsdp_axis))
+
+    return jax.tree_util.tree_map(
+        extend, params, base,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def zero1_opt_shardings(
+    params, mesh: Mesh, strategy: str = "dp", *, fsdp_axis: str = "data"
+):
+    """Pytree of ``NamedSharding`` for ZeRO-1 optimizer moments."""
+    specs = zero1_opt_specs(params, mesh, strategy, fsdp_axis=fsdp_axis)
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
 def param_shardings(params, mesh: Mesh, strategy: str = "dp", **kwargs):
     """Pytree of ``NamedSharding`` for ``params``."""
     specs = param_specs(params, mesh, strategy, **kwargs)

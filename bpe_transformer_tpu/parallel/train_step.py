@@ -46,6 +46,7 @@ def _multi_step_body(
     reduce_axis: str | None,
     health: bool = False,
     dynamics: bool = False,
+    zero1_shards: int | None = None,
 ) -> tuple[Callable, bool]:
     """(body, stacked): the per-shard update body for the requested
     accumulation/scan mode, and whether batches carry a leading stacked dim
@@ -55,14 +56,18 @@ def _multi_step_body(
     (see ``training.train_step.train_step_fn``): the device-side health/
     dynamics stats compile inside the same sharded program, so their
     reductions reuse the step's collectives and nothing new crosses the
-    host boundary."""
+    host boundary.
+
+    ``zero1_shards`` swaps the AdamW update for the ZeRO-1 sharded one
+    (`optim.sharded`): reduce-scatter grads, shard-local moment update,
+    all-gather params — composed with the same accum/inner stacking."""
     if accum_steps > 1 and inner_steps > 1:
         raise ValueError("accum_steps and inner_steps cannot both exceed 1")
     if accum_steps > 1:
         return (
             grad_accum_step_fn(
                 config, hparams, accum_steps, reduce_axis, health=health,
-                dynamics=dynamics,
+                dynamics=dynamics, zero1_shards=zero1_shards,
             ),
             True,
         )
@@ -70,12 +75,15 @@ def _multi_step_body(
         return (
             scanned_step_fn(
                 config, hparams, inner_steps, reduce_axis, health=health,
-                dynamics=dynamics,
+                dynamics=dynamics, zero1_shards=zero1_shards,
             ),
             True,
         )
     return (
-        train_step_fn(config, hparams, reduce_axis, health=health, dynamics=dynamics),
+        train_step_fn(
+            config, hparams, reduce_axis, health=health, dynamics=dynamics,
+            zero1_shards=zero1_shards,
+        ),
         False,
     )
 
@@ -89,6 +97,7 @@ def make_dp_train_step(
     inner_steps: int = 1,
     health: bool = False,
     dynamics: bool = False,
+    opt_sharding: str | None = None,
 ) -> Callable:
     """Data-parallel step with an explicit gradient all-reduce over ``axis``.
 
@@ -101,19 +110,39 @@ def make_dp_train_step(
     ``(accum_steps, micro_batch, seq)`` with the micro batch split on
     ``axis``.  ``inner_steps > 1``: several full updates per dispatch, each
     with its own all-reduce; batches are ``(inner_steps, batch, seq)``.
+
+    ``opt_sharding="zero1"`` replaces the pmean + replicated AdamW with the
+    ZeRO-1 sharded update (`optim.sharded`): reduce-scatter grads along
+    ``axis``, shard-local moment/master update, all-gather fresh params.
+    The opt state must then be a ``ShardedAdamWState`` (from
+    ``sharded_adamw_init``/``restore_opt_state``) whose flat ``(N, L)``
+    leaves ride ``P(axis)`` in/out specs — per-chip optimizer bytes ~1/N.
     """
+    if opt_sharding not in (None, "zero1"):
+        raise ValueError(f"unknown opt_sharding: {opt_sharding!r}")
+    n_shards = mesh.shape[axis] if opt_sharding == "zero1" else None
     body, stacked = _multi_step_body(
         config, hparams, accum_steps, inner_steps, reduce_axis=axis,
-        health=health, dynamics=dynamics,
+        health=health, dynamics=dynamics, zero1_shards=n_shards,
     )
     batch_spec = P(None, axis) if stacked else P(axis)
+    if n_shards is not None:
+        from bpe_transformer_tpu.optim.sharded import ShardedAdamWState
+
+        # The sharded state's (N, L) leaves split their leading dim over
+        # the dp axis — each replica's body sees its own (1, L) block.
+        opt_spec = ShardedAdamWState(
+            step=P(), m=P(axis), v=P(axis), master=P(axis)
+        )
+    else:
+        opt_spec = P()
     # out_specs are pytree PREFIXES: the final P() covers the whole metrics
     # dict, whatever keys (health sub-dicts included) the body emits.
     mapped = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(), P(), batch_spec, batch_spec),
-        out_specs=(P(), P(), P()),
+        in_specs=(P(), opt_spec, batch_spec, batch_spec),
+        out_specs=(P(), opt_spec, P()),
         check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0, 1))
@@ -129,6 +158,7 @@ def make_gspmd_train_step(
     inner_steps: int = 1,
     health: bool = False,
     dynamics: bool = False,
+    opt_sharding: str | None = None,
 ) -> Callable:
     """Sharding-annotated jit step; XLA derives the collective schedule.
 
@@ -140,16 +170,32 @@ def make_gspmd_train_step(
     dim, split on ``data`` along their second axis); XLA still derives all
     collectives from the annotations, so FSDP's gather/scatter schedule
     composes with accumulation without any manual communication.
+
+    ``opt_sharding="zero1"`` annotates the AdamW m/v leaves with
+    ``zero1_opt_specs`` (each leaf's largest divisible dim split along
+    ``data`` on top of the strategy's param spec): the update body is
+    UNCHANGED — the in/out sharding constraints alone make XLA keep the
+    moments 1/N per chip and derive the reduce-scatter/all-gather around
+    the weight update.  A no-op under ``fsdp`` (moments already shard with
+    the params).
     """
     if example_params is None:
         raise ValueError("example_params is required to derive shardings")
+    if opt_sharding not in (None, "zero1"):
+        raise ValueError(f"unknown opt_sharding: {opt_sharding!r}")
     body, stacked = _multi_step_body(
         config, hparams, accum_steps, inner_steps, reduce_axis=None,
         health=health, dynamics=dynamics,
     )
     p_sh = param_shardings(example_params, mesh, strategy)
     replicated = NamedSharding(mesh, P())
-    opt_sh = AdamWState(step=replicated, m=p_sh, v=p_sh)
+    if opt_sharding == "zero1":
+        from bpe_transformer_tpu.parallel.sharding import zero1_opt_shardings
+
+        moment_sh = zero1_opt_shardings(example_params, mesh, strategy)
+    else:
+        moment_sh = p_sh
+    opt_sh = AdamWState(step=replicated, m=moment_sh, v=moment_sh)
     data_spec = (P(None, "data") if stacked else P("data"))
     batch_sh = (
         NamedSharding(mesh, data_spec) if "data" in mesh.shape else replicated

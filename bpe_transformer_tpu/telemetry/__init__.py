@@ -32,10 +32,12 @@ Subsumes and extends the old ``utils.metrics`` / ``utils.profiling`` pair
 from bpe_transformer_tpu.telemetry.manifest import git_sha, run_manifest
 from bpe_transformer_tpu.telemetry.report import nonfinite_fields
 from bpe_transformer_tpu.telemetry.resources import (
+    compile_cache_hits,
     compile_events,
     install_compile_counter,
     record_compile_events,
     sample_resources,
+    tree_bytes_per_device,
 )
 from bpe_transformer_tpu.telemetry.schema import RECORD_SCHEMAS, validate_record
 from bpe_transformer_tpu.telemetry.sinks import MetricsLogger
@@ -77,6 +79,7 @@ __all__ = [
     "StepTimer",
     "Telemetry",
     "Watchdog",
+    "compile_cache_hits",
     "compile_events",
     "dynamics_metrics",
     "dynamics_record",
@@ -97,5 +100,6 @@ __all__ = [
     "serving_program_costs",
     "time_call",
     "time_fn",
+    "tree_bytes_per_device",
     "validate_record",
 ]

@@ -187,6 +187,7 @@ class StepProbe:
         inner_steps: int = 1,
         iters: int = 3,
         seed: int = 0,
+        opt_sharding: str | None = None,
     ):
         if parallel in ("sp", "pp"):
             raise ValueError(
@@ -200,6 +201,7 @@ class StepProbe:
         self.parallel = parallel
         self.accum_steps = accum_steps
         self.inner_steps = inner_steps
+        self.opt_sharding = opt_sharding
         self.iters = iters
         self._rng = np.random.default_rng(seed)
         self._compiled: dict[str, object] = {}
@@ -234,14 +236,22 @@ class StepProbe:
         collective (placement, shapes, and per-chip compute identical)."""
         from bpe_transformer_tpu.parallel.train_step import _multi_step_body
 
-        def body(reduce_axis):
+        def body(reduce_axis, zero1_shards=None):
             b, _ = _multi_step_body(
                 self.config, self.hparams, self.accum_steps,
                 self.inner_steps, reduce_axis=reduce_axis,
+                zero1_shards=zero1_shards,
             )
             return b
 
         if self.mesh is not None and self.parallel == "dp":
+            if self.opt_sharding == "zero1":
+                # The ZeRO-1 schedule interleaves reduce-scatter / compute /
+                # all-gather; a collective-free variant would change the
+                # per-chip work, so — like GSPMD — it reports
+                # collective_frac=None rather than a made-up number.
+                n = self.mesh.shape["data"]
+                return {"train_step": body("data", zero1_shards=n)}
             return {
                 "train_step": body("data"),
                 "train_step_local": body(None),
@@ -294,11 +304,19 @@ class StepProbe:
         stacked = self.accum_steps > 1 or self.inner_steps > 1
         if self.parallel == "dp":
             batch_spec = P(None, "data") if stacked else P("data")
+            if self.opt_sharding == "zero1":
+                from bpe_transformer_tpu.optim.sharded import ShardedAdamWState
+
+                opt_spec = ShardedAdamWState(
+                    step=P(), m=P("data"), v=P("data"), master=P("data")
+                )
+            else:
+                opt_spec = P()
             mapped = jax.shard_map(
                 body,
                 mesh=self.mesh,
-                in_specs=(P(), P(), batch_spec, batch_spec),
-                out_specs=(P(), P(), P()),
+                in_specs=(P(), opt_spec, batch_spec, batch_spec),
+                out_specs=(P(), opt_spec, P()),
                 check_vma=False,
             )
             return jax.jit(mapped)
@@ -306,7 +324,13 @@ class StepProbe:
 
         p_sh = param_shardings(params, self.mesh, self.parallel)
         replicated = NamedSharding(self.mesh, P())
-        opt_sh = type(opt_state)(step=replicated, m=p_sh, v=p_sh)
+        if self.opt_sharding == "zero1":
+            from bpe_transformer_tpu.parallel.sharding import zero1_opt_shardings
+
+            moment_sh = zero1_opt_shardings(params, self.mesh, self.parallel)
+        else:
+            moment_sh = p_sh
+        opt_sh = type(opt_state)(step=replicated, m=moment_sh, v=moment_sh)
         data_spec = P(None, "data") if stacked else P("data")
         batch_sh = (
             NamedSharding(self.mesh, data_spec)
@@ -405,7 +429,9 @@ class StepProbe:
         """Total host syncs one :meth:`measure` call performs — variants x
         :data:`FETCHES_PER_MEASURE` (the fetch-count test's budget)."""
         n_variants = 2 if (
-            self.mesh is not None and self.parallel == "dp"
+            self.mesh is not None
+            and self.parallel == "dp"
+            and self.opt_sharding != "zero1"
         ) else 1
         return n_variants * self.FETCHES_PER_MEASURE
 

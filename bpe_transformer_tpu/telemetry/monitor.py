@@ -73,7 +73,7 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
             for key in ("host_rss_bytes", "live_buffer_bytes",
                         "hbm_bytes_in_use", "hbm_peak_bytes_in_use",
                         "hbm_bytes_limit", "compile_events",
-                        "compile_time_s"):
+                        "compile_time_s", "params_bytes", "opt_state_bytes"):
                 if record.get(key) is not None:
                     state[key] = record[key]
         elif kind == "attribution":
@@ -303,6 +303,11 @@ def render_frame(state: dict, source: str) -> str:
         mem_parts.append(hbm)
     if state.get("live_buffer_bytes") is not None:
         mem_parts.append(f"live buffers {_mib(state['live_buffer_bytes'])}")
+    if state.get("opt_state_bytes") is not None:
+        # Per-chip state bytes: the live view of the optimizer-sharding win.
+        mem_parts.append(f"opt state/chip {_mib(state['opt_state_bytes'])}")
+    if state.get("params_bytes") is not None:
+        mem_parts.append(f"params/chip {_mib(state['params_bytes'])}")
     if state.get("host_rss_bytes") is not None:
         mem_parts.append(f"rss {_mib(state['host_rss_bytes'])}")
     if mem_parts:

@@ -312,6 +312,15 @@ def summarize(records: list[dict]) -> dict:
             "compile_events": _stats(
                 [r.get("compile_events") for r in resources]
             ),
+            # Per-chip state bytes (optional fields — older streams predate
+            # them): the ZeRO-1 optimizer-sharding memory win shows up as
+            # opt_state_bytes dropping to ~1/N of the unsharded run's.
+            "params_bytes": _stats(
+                [r.get("params_bytes") for r in resources]
+            ),
+            "opt_state_bytes": _stats(
+                [r.get("opt_state_bytes") for r in resources]
+            ),
         }
 
     # Resilience records (resilience/ + training/loop.py): NaN-rollback
@@ -667,11 +676,13 @@ def render_report(records: list[dict]) -> str:
             ("live_buffer_bytes", "live buffers", 2**20),
             ("hbm_bytes_in_use", "hbm in use", 2**20),
             ("hbm_peak_bytes_in_use", "hbm peak", 2**20),
+            ("params_bytes", "params/chip", 2**20),
+            ("opt_state_bytes", "opt state/chip", 2**20),
         ):
             st_r = rs[key]
             if st_r:
                 lines.append(
-                    f"  {label:<13s}{st_r['first'] / scale:,.1f} -> "
+                    f"  {label:<15s}{st_r['first'] / scale:,.1f} -> "
                     f"{st_r['last'] / scale:,.1f} MiB"
                     f"  (max {st_r['max'] / scale:,.1f})"
                 )
@@ -881,6 +892,15 @@ COMPARE_METRICS: dict = {
     "hbm_peak_bytes": (
         lambda s: (s["resources"] or {}).get("hbm_peak_bytes_in_use", {}).get("max")
         if s.get("resources") else None, "lower"),
+    # Per-chip state bytes (optimizer sharding's memory win): a run whose
+    # opt_state_bytes shrinks 1/N against the unsharded baseline shows up
+    # as an "improved" row; growing back is a gated regression.
+    "params_bytes_per_chip": (
+        lambda s: ((s.get("resources") or {}).get("params_bytes", {})
+                   or {}).get("last"), "lower"),
+    "opt_state_bytes_per_chip": (
+        lambda s: ((s.get("resources") or {}).get("opt_state_bytes", {})
+                   or {}).get("last"), "lower"),
 }
 
 
@@ -914,6 +934,18 @@ def baseline_capture_metrics(capture: dict) -> dict:
     val_loss = capture.get("final_val_loss")
     if isinstance(val_loss, (int, float)) and math.isfinite(val_loss):
         out["val_loss_best"] = (float(val_loss), "lower")
+    # Sharded-optimizer capture rows (benchmarks/bench_sharded_opt.py):
+    # per-chip state bytes and the attribution fractions, gateable against
+    # a later stream the same way as throughput.
+    for cap_key, metric in (
+        ("opt_state_bytes", "opt_state_bytes_per_chip"),
+        ("params_bytes", "params_bytes_per_chip"),
+        ("host_gap_frac", "host_gap_frac"),
+        ("collective_frac", "collective_frac"),
+    ):
+        value = capture.get(cap_key)
+        if isinstance(value, (int, float)) and math.isfinite(value):
+            out[metric] = (float(value), COMPARE_METRICS[metric][1])
     return out
 
 

@@ -42,13 +42,22 @@ import time
 #: visible as a climbing count; how much wall it stole needs the sum).
 _compile_events = 0
 _compile_time_s = 0.0
+_compile_cache_hits = 0
 _compile_lock = threading.Lock()
 _listener_installed = False
 
 #: The jax.monitoring duration event every backend compile records exactly
 #: once (traced-jaxpr and MLIR-lowering events fire alongside it; counting
-#: only this one keeps "1 event == 1 XLA compile").
+#: only this one keeps "1 event == 1 XLA compile").  NOTE: on persistent-
+#: compilation-cache HITS this event still fires (its duration then
+#: measures cache deserialization, not XLA work) — the cache-hit counter
+#: below is what distinguishes a warm start from a recompile.
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+#: Fired once per compile request served from the persistent compilation
+#: cache (``--compile-cache DIR`` / utils.compile_cache): a restarted
+#: process whose hit counter climbs while wall compile time stays flat is
+#: warm-starting as designed.
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 
 
 def record_compile_events(n: int = 1, duration_s: float = 0.0) -> int:
@@ -73,6 +82,13 @@ def compile_time_s() -> float:
     by the same ``jax.monitoring`` duration events as the counter)."""
     with _compile_lock:
         return _compile_time_s
+
+
+def compile_cache_hits() -> int:
+    """Compile requests served from the persistent compilation cache so
+    far (0 when the cache is disabled or jax predates the event)."""
+    with _compile_lock:
+        return _compile_cache_hits
 
 
 def install_compile_counter() -> bool:
@@ -101,6 +117,18 @@ def install_compile_counter() -> bool:
             monitoring.register_event_duration_secs_listener(_on_duration)
         except Exception:
             return False
+        try:
+            # Best-effort: older jax has no plain-event listener API; the
+            # hit counter then just stays 0.
+            def _on_event(event: str, **_kwargs) -> None:
+                if event == _CACHE_HIT_EVENT:
+                    global _compile_cache_hits
+                    with _compile_lock:
+                        _compile_cache_hits += 1
+
+            monitoring.register_event_listener(_on_event)
+        except Exception:
+            pass
         _listener_installed = True
         return True
 
@@ -169,6 +197,42 @@ def live_buffer_bytes() -> int | None:
         return None
 
 
+def tree_bytes_per_device(tree) -> int | None:
+    """PER-DEVICE bytes of a pytree of arrays — the number that answers
+    "how much HBM does this state cost each chip".
+
+    For a sharded ``jax.Array`` the per-device cost is its shard shape
+    (``sharding.shard_shape``) times the itemsize — metadata only, no
+    device sync — so a ZeRO-1 optimizer state reports ~1/N of its global
+    bytes while replicated params report their full size.  Host/numpy
+    leaves count their full ``nbytes`` (they cost that much wherever they
+    land).  ``None`` when the tree is empty or jax is absent.
+    """
+    try:
+        import jax
+        import numpy as np
+    except Exception:
+        return None
+    total = 0
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return None
+    for leaf in leaves:
+        try:
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None and hasattr(sharding, "shard_shape"):
+                shape = sharding.shard_shape(leaf.shape)
+            else:
+                shape = np.shape(leaf)
+            itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+            total += int(np.prod(shape)) * itemsize
+        except Exception:
+            # A leaf we can't size (deleted buffer, exotic type) must not
+            # take the whole resource record down.
+            continue
+    return total
+
+
 def sample_resources(**extra) -> dict:
     """One ``kind="resources"`` record: host RSS, live-buffer bytes, summed
     device-memory stats (None fields on CPU), and the process compile
@@ -184,6 +248,9 @@ def sample_resources(**extra) -> dict:
         # older streams predate the field) — the /metrics compile-time
         # gauge and the trace counter track read it.
         "compile_time_s": round(compile_time_s(), 3),
+        # Persistent-compilation-cache hits (not schema-required): climbs
+        # while compile_time_s stays flat on a warm --compile-cache start.
+        "compile_cache_hits": compile_cache_hits(),
     }
     mem = device_memory_stats()
     record["hbm_bytes_in_use"] = mem["bytes_in_use"] if mem else None

@@ -36,7 +36,12 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
         "tokens_total", "ticks", "requests_finished", "compiled_programs",
     },
     # Resource accounting sample (telemetry/resources.py): HBM fields are
-    # None on backends without memory_stats (CPU), never absent.
+    # None on backends without memory_stats (CPU), never absent.  Training
+    # records additionally carry optional ``params_bytes`` /
+    # ``opt_state_bytes`` (PER-CHIP state bytes from shard-shape metadata —
+    # the ZeRO-1 optimizer-sharding memory win reads directly off them) and
+    # ``compile_time_s``; all three are optional — older streams predate
+    # them.
     "resources": {
         "kind", "time_unix", "host_rss_bytes", "live_buffer_bytes",
         "compile_events", "hbm_bytes_in_use", "hbm_peak_bytes_in_use",

@@ -148,6 +148,13 @@ def cmd_train(args) -> int:
     from bpe_transformer_tpu.training.loop import LoopConfig, train
     from bpe_transformer_tpu.training.train_step import TrainHParams
 
+    if args.compile_cache:
+        # Before anything jit-compiles: repeat starts (supervisor respawns,
+        # preemption resumes) then load their XLA programs from disk.
+        from bpe_transformer_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache)
+
     model_config = _load_model_config(args)
     hparams = TrainHParams(
         max_learning_rate=args.lr,
@@ -190,6 +197,8 @@ def cmd_train(args) -> int:
         inner_steps=args.inner_steps,
         grad_accum_steps=args.grad_accum_steps,
         async_checkpoint=args.async_checkpoint,
+        opt_sharding=args.opt_sharding,
+        prefetch=args.prefetch,
     )
     train_data = load_token_file(args.data, args.dtype)
     val_data = load_token_file(args.val_data, args.dtype) if args.val_data else None
@@ -287,6 +296,13 @@ def cmd_serve(args) -> int:
     if args.prompts_file and not args.output:
         print("serve: --prompts-file needs --output", file=sys.stderr)
         return 2
+    if args.compile_cache:
+        # Before the engine compiles its bucket ladder: a rolling-restart
+        # replica warm-starts from the cache instead of re-paying every
+        # prefill bucket + decode tick compile.
+        from bpe_transformer_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache)
     payload, model_config, tokenizer = _load_inference_state(
         args, need_tokenizer=True
     )
@@ -752,6 +768,34 @@ def build_parser() -> argparse.ArgumentParser:
         help="optimizer updates per XLA dispatch (lax.scan; single device)",
     )
     p.add_argument(
+        "--opt-sharding",
+        choices=["zero1"],
+        default=None,
+        help="ZeRO-1 optimizer-state sharding across the data axis (with "
+        "--parallel dp or a GSPMD strategy): AdamW m/v and the fp32 master "
+        "weights live 1/N per chip; the dp path reduce-scatters grads "
+        "and all-gathers fresh params instead of the all-reduce",
+    )
+    p.add_argument(
+        "--prefetch",
+        type=int,
+        default=1,
+        metavar="N",
+        help="batch prefetch depth: sample + stack the next N batches on a "
+        "jax-free background thread while the device runs the current step "
+        "(0 = synchronous feed; the device transfer itself is an async "
+        "enqueue either way); batches stay a pure function of the "
+        "iteration, so determinism/resume are unaffected",
+    )
+    p.add_argument(
+        "--compile-cache",
+        default=None,
+        metavar="DIR",
+        help="enable JAX's persistent compilation cache rooted at DIR: "
+        "respawns/resumes (and any later run of the same config) load "
+        "their XLA programs from disk instead of recompiling",
+    )
+    p.add_argument(
         "--async-checkpoint",
         action="store_true",
         help="write checkpoints in a background thread (overlaps IO with "
@@ -852,6 +896,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="on Ctrl-C/SIGTERM: stop accepting, then wait up "
                    "to this long for queued + in-flight requests to finish "
                    "before cancelling stragglers (graceful drain)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="enable JAX's persistent compilation cache rooted "
+                   "at DIR: restarted replicas load the prefill-bucket/"
+                   "decode programs from disk instead of recompiling")
     p.add_argument("--special-token", action="append", default=None,
                    help='repeatable; default: ["<|endoftext|>"]')
     p.set_defaults(fn=cmd_serve)

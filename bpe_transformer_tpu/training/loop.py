@@ -44,6 +44,7 @@ from bpe_transformer_tpu.telemetry import (
     nonfinite_fields,
     run_manifest,
     sample_resources,
+    tree_bytes_per_device,
 )
 
 
@@ -132,6 +133,25 @@ class LoopConfig:
     #: dispatch over its microbatches.  log/eval/checkpoint cadences must
     #: be multiples.
     inner_steps: int = 1
+    #: Optimizer-state sharding across the data-parallel axis.  "zero1"
+    #: (with parallel="dp" or a GSPMD strategy) shards AdamW m/v and the
+    #: fp32 master weights 1/N per chip (optim/sharded.py,
+    #: Xu et al. arXiv:2004.13336): the dp path reduce-scatters gradients,
+    #: updates each replica's shard, and all-gathers fresh params; GSPMD
+    #: strategies express the same schedule through NamedSharding
+    #: annotations on the opt-state leaves.  Not supported with sp/pp, and
+    #: (dp path) not combinable with health_stats/dynamics_every — the
+    #: sharded update never materializes the global gradient tree those
+    #: taps read.
+    opt_sharding: str | None = None
+    #: Batch prefetch depth (data/dataset.BatchPrefetcher): N batches are
+    #: sampled + stacked on a jax-free background thread while the device
+    #: runs the current step, so the main thread only pays the
+    #: async-enqueued device transfer — the host-sampling share of
+    #: host_gap_frac collapses.  Batches stay a pure function of the
+    #: iteration, so determinism/resume are unaffected.  0 (the library
+    #: default) is the synchronous feed; the CLI defaults to 1.
+    prefetch: int = 0
     #: Microbatches per optimizer update (gradient accumulation): each
     #: batch of ``batch_size`` is split into this many sequential
     #: microbatches, capping activation memory at one microbatch while the
@@ -179,7 +199,10 @@ def train(
         shard_params,
         shard_sp_batch,
     )
-    from bpe_transformer_tpu.data.dataset import check_dataset_geometry
+    from bpe_transformer_tpu.data.dataset import (
+        BatchPrefetcher,
+        check_dataset_geometry,
+    )
     from bpe_transformer_tpu.resilience.faults import FaultInjector
     from bpe_transformer_tpu.resilience.rollback import (
         RollbackBudget,
@@ -239,6 +262,27 @@ def train(
                 "probes run at log boundaries so untouched steps pay zero "
                 "extra host syncs"
             )
+    if loop.opt_sharding is not None:
+        if loop.opt_sharding != "zero1":
+            raise ValueError(
+                f"unknown opt_sharding: {loop.opt_sharding!r} (only "
+                '"zero1" is implemented)'
+            )
+        if loop.parallel in (None, "sp", "pp"):
+            raise ValueError(
+                'opt_sharding="zero1" needs a data-parallel mesh to shard '
+                'across — use --parallel dp or a GSPMD strategy (fsdp '
+                "already shards its optimizer state with the params)"
+            )
+        if loop.parallel == "dp" and (loop.health_stats or loop.dynamics_every):
+            raise ValueError(
+                'opt_sharding="zero1" with parallel="dp" does not support '
+                "health_stats/dynamics_every — the reduce-scatter update "
+                "never materializes the global gradient tree those taps "
+                "read; drop them or use a GSPMD strategy"
+            )
+    if loop.prefetch < 0:
+        raise ValueError(f"prefetch must be >= 0, got {loop.prefetch}")
     if loop.watchdog and loop.watchdog_policy not in Watchdog.POLICIES:
         # Validate BEFORE any sink opens: a bad policy must not leak an open
         # JSONL handle or an unfinished wandb run.
@@ -307,6 +351,14 @@ def train(
                     f'parallel="{loop.parallel}" requires a mesh with a '
                     f'"{needed}" axis, e.g. --mesh data=2,{needed}=4'
                 )
+        if loop.opt_sharding == "zero1" and "data" not in mesh.shape:
+            # No data axis -> nothing to shard across: zero1 would silently
+            # degrade to a replicated optimizer.  Fail loudly instead.
+            raise ValueError(
+                'opt_sharding="zero1" requires a mesh with a "data" axis '
+                "to shard the optimizer state across, e.g. --mesh "
+                "data=4,model=2"
+            )
         if loop.parallel == "sp":
             seq_size = mesh.shape.get("seq")
             if seq_size is None:
@@ -355,14 +407,23 @@ def train(
                     lambda: init_params(jax.random.PRNGKey(0), model_config)
                 )
                 pshard = param_shardings(abstract, mesh, loop.parallel)
+                moment_sh = pshard
+                if loop.opt_sharding == "zero1":
+                    from bpe_transformer_tpu.parallel.sharding import (
+                        zero1_opt_shardings,
+                    )
+
+                    moment_sh = zero1_opt_shardings(
+                        abstract, mesh, loop.parallel
+                    )
                 return load_checkpoint_sharded(
                     path,
                     shardings={
                         "params": pshard,
                         "opt_state": AdamWState(
                             step=NamedSharding(mesh, PartitionSpec()),
-                            m=pshard,
-                            v=pshard,
+                            m=moment_sh,
+                            v=moment_sh,
                         ),
                     },
                 )
@@ -370,10 +431,18 @@ def train(
 
         payload, used = load_checkpoint_with_fallback(src, loader=loader)
         loaded_params = payload["params"]
-        loaded_opt = (
-            AdamWState(*payload["opt_state"])
-            if payload["opt_state"] is not None
-            else adamw_init(loaded_params)
+        # restore_opt_state adapts whatever the checkpoint holds — a dense
+        # AdamWState, a ZeRO-1 ShardedAdamWState (possibly from a different
+        # dp width), or nothing — to THIS run's optimizer-sharding mode, so
+        # pre-sharding checkpoints resume into sharded runs and vice versa.
+        from bpe_transformer_tpu.optim.sharded import restore_opt_state
+
+        zero1_dp = loop.parallel == "dp" and loop.opt_sharding == "zero1"
+        loaded_opt = restore_opt_state(
+            payload["opt_state"],
+            loaded_params,
+            zero1_shards=mesh.shape["data"] if zero1_dp else None,
+            mesh=mesh if zero1_dp else None,
         )
         return loaded_params, loaded_opt, payload["iteration"], used
 
@@ -417,8 +486,33 @@ def train(
         params = shard_pp_params(params, mesh)
         if opt_state is None:
             opt_state = init_pp_opt_state(params, mesh)
+    zero1_dp = loop.parallel == "dp" and loop.opt_sharding == "zero1"
+    zero1_gspmd = (
+        loop.opt_sharding == "zero1"
+        and mesh is not None
+        and loop.parallel not in ("dp", "sp", "pp")
+    )
     if opt_state is None:
-        opt_state = adamw_init(params)
+        if zero1_dp:
+            from bpe_transformer_tpu.optim.sharded import sharded_adamw_init
+
+            opt_state = sharded_adamw_init(
+                params, mesh.shape["data"], mesh=mesh
+            )
+        else:
+            opt_state = adamw_init(params)
+    if zero1_gspmd:
+        # Commit the moments to their ZeRO-1 shardings up front (1/N per
+        # chip from step 0); a resumed dense state gets placed the same
+        # way.  No-op for leaves already on the right sharding.
+        from bpe_transformer_tpu.parallel.sharding import zero1_opt_shardings
+
+        moment_sh = zero1_opt_shardings(params, mesh, loop.parallel)
+        opt_state = AdamWState(
+            step=jax.numpy.asarray(opt_state.step),
+            m=jax.device_put(opt_state.m, moment_sh),
+            v=jax.device_put(opt_state.v, moment_sh),
+        )
 
     stride = loop.inner_steps
     if stride > 1:
@@ -495,6 +589,7 @@ def train(
             return make_dp_train_step(
                 model_config, hparams, mesh, accum_steps=accum, inner_steps=n,
                 health=health, dynamics=dynamics,
+                opt_sharding=loop.opt_sharding,
             )
 
         step_fn = build_step()
@@ -540,6 +635,7 @@ def train(
                 inner_steps=n,
                 health=health,
                 dynamics=dynamics,
+                opt_sharding=loop.opt_sharding,
             )
 
         step_fn = build_step()
@@ -673,6 +769,53 @@ def train(
             return np.random.default_rng((loop.seed, it, batch_salt))
         return np.random.default_rng((loop.seed, it))
 
+    def make_host_batch(it: int):
+        """``(x, y, n, plain)`` for iteration ``it`` — numpy host sampling
+        only (memmap gather, stacking, microbatch reshape), a pure function
+        of the iteration (and rollback salt), so the jax-free prefetch
+        worker can build it while the device runs the current step.  ``n``
+        is the number of optimizer updates the batch carries (< stride only
+        on the tail scan of a run whose total isn't a stride multiple);
+        ``plain`` selects place_plain (the unstacked 1-step layout) at
+        placement time.  Device placement stays on the MAIN thread: the
+        transfer is an async enqueue once dispatch returns, and a worker
+        issuing device ops concurrently with the donating step dispatch can
+        abort the CPU runtime."""
+        injector.on_batch_read(it)
+        if stride > 1:
+            n = min(stride, loop.steps - it)
+            batches = [
+                get_batch(
+                    train_data,
+                    loop.batch_size,
+                    model_config.context_length,
+                    batch_rng(it + j),
+                )
+                for j in range(n)
+            ]
+            if n == 1:
+                # A 1-step tail is a plain step (build_step(1)): feed the
+                # unstacked (B, S) layout it expects.
+                return batches[0][0], batches[0][1], n, True
+            x = np.stack([b[0] for b in batches])
+            y = np.stack([b[1] for b in batches])
+            return x, y, n, False
+        x, y = get_batch(
+            train_data, loop.batch_size, model_config.context_length,
+            batch_rng(it),
+        )
+        if accum > 1:  # (B, S) -> (accum, B/accum, S) microbatches
+            micro = loop.batch_size // accum
+            x = x.reshape(accum, micro, -1)
+            y = y.reshape(accum, micro, -1)
+        return x, y, 1, False
+
+    #: Lookahead batch feed: while the device runs step i, the worker
+    #: thread samples + stacks the batch for step i+n, so the
+    #: inter-dispatch host gap shrinks to the async device enqueue
+    #: (attribution's host_gap_frac is the needle this moves).
+    prefetcher = BatchPrefetcher(make_host_batch, depth=loop.prefetch)
+
     def save_snapshot(sync: bool = False) -> Path:
         """Write one checkpoint at the current iteration (step file +
         latest pointer + retention GC) — shared by the periodic cadence and
@@ -772,50 +915,36 @@ def train(
             if stop.triggered:
                 preempted = stop.signame or "signal"
                 break
-            injector.on_batch_read(iteration)
             # Per-iteration seeding (not one stream advanced per step) so a
             # resumed run samples the SAME batch at the same iteration as an
             # uninterrupted one — preemption-safe determinism (batch_rng
-            # folds in the post-rollback salt).
-            if stride > 1:
-                n = min(stride, loop.steps - iteration)
-                batches = [
-                    get_batch(
-                        train_data,
-                        loop.batch_size,
-                        model_config.context_length,
-                        batch_rng(iteration + j),
-                    )
-                    for j in range(n)
-                ]
-                if n != stride:  # tail shorter than the compiled scan length
-                    step_fn = build_step(n)
-                    # The rebuilt step pays a fresh jit compile on dispatch:
-                    # route it through the same span/exclusion/pause path as
-                    # the first step so it can't pollute throughput or trip
-                    # the watchdog.
-                    first_dispatch = True
-                if n == 1:
-                    # A 1-step tail is a plain step (build_step(1)): feed the
-                    # unstacked (B, S) layout it expects.
-                    x = jax.numpy.asarray(batches[0][0])
-                    y = jax.numpy.asarray(batches[0][1])
-                    x, y = place_plain((x, y))
-                else:
-                    x = jax.numpy.asarray(np.stack([b[0] for b in batches]))
-                    y = jax.numpy.asarray(np.stack([b[1] for b in batches]))
-                    x, y = place((x, y))
-            else:
-                n = 1
-                step_rng = batch_rng(iteration)
-                x, y = get_batch(
-                    train_data, loop.batch_size, model_config.context_length, step_rng
-                )
-                if accum > 1:  # (B, S) -> (accum, B/accum, S) microbatches
-                    micro = loop.batch_size // accum
-                    x = x.reshape(accum, micro, -1)
-                    y = y.reshape(accum, micro, -1)
-                x, y = place((jax.numpy.asarray(x), jax.numpy.asarray(y)))
+            # folds in the post-rollback salt).  The prefetcher hands back
+            # the worker-built host batch when one is ready, else builds it
+            # synchronously (first step, post-rollback).
+            hx, hy, n, plain = prefetcher.get(iteration)
+            if stride > 1 and n != stride:
+                # Tail shorter than the compiled scan length.  The rebuilt
+                # step pays a fresh jit compile on dispatch: route it
+                # through the same span/exclusion/pause path as the first
+                # step so it can't pollute throughput or trip the watchdog.
+                step_fn = build_step(n)
+                first_dispatch = True
+            # Kick off the next batches now (up to the configured depth —
+            # schedule() dedups and caps the pipeline): they sample + stack
+            # on the worker thread while the device executes this step.
+            # Future iterations advance by this dispatch's n, which matches
+            # every upcoming boundary — including the shorter tail scan,
+            # whose boundary still lands on a stride multiple and whose
+            # batch make_host_batch builds correctly because it recomputes
+            # its own n = min(stride, steps - it) per iteration.
+            for ahead in range(1, loop.prefetch + 1):
+                future_it = iteration + ahead * n
+                if future_it < loop.steps:
+                    prefetcher.schedule(future_it)
+            # Device placement (async enqueue) on the main thread only.
+            x, y = (place_plain if plain else place)(
+                (jax.numpy.asarray(hx), jax.numpy.asarray(hy))
+            )
             if first_dispatch:
                 # The first dispatch of a (re)built step pays the jit
                 # compile; span it (with a sync fence so the span measures
@@ -891,7 +1020,15 @@ def train(
                 # boundary: sample_resources is sync-free (RSS, live-buffer
                 # metadata, device memory_stats, compile counter), so HBM
                 # headroom and recompile trends cost zero extra host syncs.
-                telemetry.emit(sample_resources(step=iteration))
+                # params/opt-state bytes are PER-CHIP (shard-shape metadata)
+                # — the number that shows the ZeRO-1 memory win directly.
+                telemetry.emit(
+                    sample_resources(
+                        step=iteration,
+                        params_bytes=tree_bytes_per_device(params),
+                        opt_state_bytes=tree_bytes_per_device(opt_state),
+                    )
+                )
                 log_fn(
                     f"step {record['step']:>6d}  loss {record['loss']:.4f}  "
                     f"lr {record['lr']:.2e}  gnorm {record['grad_norm']:.3f}  "
@@ -933,6 +1070,7 @@ def train(
                                 accum_steps=accum,
                                 inner_steps=stride,
                                 seed=loop.seed,
+                                opt_sharding=loop.opt_sharding,
                             )
                         attr_record = attribution_probe.attribution_record(
                             params,
@@ -1025,6 +1163,12 @@ def train(
                                 )
                         timer.exclude(handle.end())
                         batch_salt += 1
+                        # Prefetched batches were sampled with the OLD salt
+                        # (and for the replayed window): drop them.
+                        # reraise=True: a fault a prefetched batch already
+                        # consumed (fire-once chaos read faults) surfaces
+                        # here instead of vanishing with the pipeline.
+                        prefetcher.invalidate(reraise=True)
                         telemetry.emit(
                             {
                                 "kind": "recovery",
@@ -1130,6 +1274,7 @@ def train(
 
     finally:
         stop.uninstall()
+        prefetcher.close()
         try:
             if async_saver is not None:
                 # Join the in-flight write so a finished run always has its

@@ -120,12 +120,69 @@ def _reduce_act_stats(act_stats: dict, axis: str) -> dict:
     }
 
 
+def _check_zero1(zero1_shards, reduce_axis, health, dynamics, context):
+    """Validate a ZeRO-1 request: it needs a mapped dp axis to scatter
+    over, and it never materializes the global mean-gradient tree the
+    health/dynamics taps read (that tree not existing is the point)."""
+    if zero1_shards is None:
+        return
+    if reduce_axis is None:
+        raise ValueError(
+            f"{context}: zero1_shards requires a mapped reduce_axis (the "
+            "sharded update reduce-scatters gradients over the dp axis)"
+        )
+    if health or dynamics:
+        raise ValueError(
+            f"{context}: health/dynamics stats are not supported with the "
+            "ZeRO-1 sharded update — they read the global gradient tree, "
+            "which the reduce-scatter path deliberately never builds; "
+            "drop --health-stats/--dynamics-every or --opt-sharding"
+        )
+
+
+def _zero1_update(params, opt_state, loss, grads, hparams, axis, n_shards):
+    """The shared ZeRO-1 tail of a step body: schedule lr, reduce-scatter +
+    shard-local AdamW + all-gather (`optim.sharded`), metrics dict.  The
+    plain and grad-accum bodies differ only in how ``loss``/``grads`` were
+    produced (``loss`` is this shard's local value; the pmean happens
+    here)."""
+    from bpe_transformer_tpu.optim.sharded import sharded_adamw_update
+
+    loss = jax.lax.pmean(loss, axis)
+    lr = cosine_schedule_jax(
+        opt_state.step,
+        hparams.max_learning_rate,
+        hparams.min_learning_rate,
+        hparams.warmup_iters,
+        hparams.cosine_cycle_iters,
+    )
+    new_params, opt_state, grad_norm = sharded_adamw_update(
+        params,
+        grads,
+        opt_state,
+        lr,
+        axis=axis,
+        n_shards=n_shards,
+        betas=hparams.betas,
+        eps=hparams.eps,
+        weight_decay=hparams.weight_decay,
+        grad_clip_norm=hparams.grad_clip_norm,
+    )
+    metrics = {
+        "loss": loss.astype(jnp.float32),
+        "lr": lr.astype(jnp.float32),
+        "grad_norm": grad_norm,
+    }
+    return new_params, opt_state, metrics
+
+
 def train_step_fn(
     config: ModelConfig,
     hparams: TrainHParams,
     reduce_axis: str | None = None,
     health: bool = False,
     dynamics: bool = False,
+    zero1_shards: int | None = None,
 ) -> Callable:
     """The un-jitted update body ``(params, opt_state, x, y) ->
     (params, opt_state, metrics)`` shared by every execution mode.
@@ -145,10 +202,29 @@ def train_step_fn(
     ratios, per-tensor non-finite localization counts, and per-block
     activation stats tapped from the SAME differentiated forward
     (``forward_hidden_stats``).  Everything stays on device and rides the
-    same log-cadence fetch — zero extra host syncs."""
+    same log-cadence fetch — zero extra host syncs.
+
+    ``zero1_shards`` (with ``reduce_axis``) switches the update to the
+    ZeRO-1 sharded optimizer (`optim.sharded`): gradients are
+    reduce-scattered instead of pmean'd, each replica updates its 1/N
+    shard of AdamW state, and fresh params are all-gathered — ``opt_state``
+    is then a :class:`~bpe_transformer_tpu.optim.sharded.ShardedAdamWState`
+    whose leaves arrive as this replica's block under ``shard_map``."""
+    _check_zero1(zero1_shards, reduce_axis, health, dynamics, "train_step_fn")
     is_moe = config.ffn_type == "moe"
     with_aux = health and is_moe
     loss_fn = make_loss_fn(config, with_aux=with_aux, with_stats=dynamics)
+
+    if zero1_shards is not None:
+
+        def zero1_step(params, opt_state, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            return _zero1_update(
+                params, opt_state, loss, grads, hparams, reduce_axis,
+                zero1_shards,
+            )
+
+        return zero1_step
 
     def step(params, opt_state: AdamWState, x, y):
         act_stats = None
@@ -275,6 +351,7 @@ def grad_accum_step_fn(
     reduce_axis: str | None = None,
     health: bool = False,
     dynamics: bool = False,
+    zero1_shards: int | None = None,
 ) -> Callable:
     """Un-jitted accumulation body: one optimizer update from
     ``accum_steps`` microbatch gradients.
@@ -301,10 +378,30 @@ def grad_accum_step_fn(
 
     Signature: ``(params, opt_state, xs, ys) -> (params, opt_state,
     metrics)`` with ``xs/ys: (accum_steps, micro_batch, seq)``.
+
+    ``zero1_shards`` swaps in the ZeRO-1 sharded update (as in
+    :func:`train_step_fn`): the locally-ACCUMULATED gradients are
+    reduce-scattered — still one collective per optimizer update.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    _check_zero1(
+        zero1_shards, reduce_axis, health, dynamics, "grad_accum_step_fn"
+    )
     loss_fn = make_loss_fn(config)
+
+    if zero1_shards is not None:
+
+        def zero1_step(params, opt_state, xs, ys):
+            loss, grads = accumulate_grads(
+                jax.value_and_grad(loss_fn), params, xs, ys, accum_steps
+            )
+            return _zero1_update(
+                params, opt_state, loss, grads, hparams, reduce_axis,
+                zero1_shards,
+            )
+
+        return zero1_step
 
     def step(params, opt_state: AdamWState, xs, ys):
         loss, grads = accumulate_grads(
@@ -376,6 +473,7 @@ def scanned_step_fn(
     body: Callable | None = None,
     health: bool = False,
     dynamics: bool = False,
+    zero1_shards: int | None = None,
 ) -> Callable:
     """Un-jitted body: ``inner_steps`` optimizer updates via ``lax.scan``.
 
@@ -398,7 +496,8 @@ def scanned_step_fn(
         raise ValueError(f"inner_steps must be >= 1, got {inner_steps}")
     if body is None:
         body = train_step_fn(
-            config, hparams, reduce_axis, health=health, dynamics=dynamics
+            config, hparams, reduce_axis, health=health, dynamics=dynamics,
+            zero1_shards=zero1_shards,
         )
 
     def multi(params, opt_state: AdamWState, xs, ys):

@@ -1,11 +1,13 @@
 from bpe_transformer_tpu.telemetry.sinks import MetricsLogger
 from bpe_transformer_tpu.telemetry.timing import StepTimer, profile_trace, time_fn
+from bpe_transformer_tpu.utils.compile_cache import enable_compile_cache
 from bpe_transformer_tpu.utils.debug import check_finite, nan_checks
 
 __all__ = [
     "MetricsLogger",
     "StepTimer",
     "check_finite",
+    "enable_compile_cache",
     "nan_checks",
     "profile_trace",
     "time_fn",

@@ -1,0 +1,63 @@
+"""JAX persistent compilation cache wiring (`--compile-cache DIR`).
+
+Every train/serve start pays a full XLA compile per program (visible in
+the PR-3 compile counters) — 20-40 s each on the real chip — which taxes
+exactly the respawn loop the supervisor runs and every rolling-restart of
+a serve replica.  JAX ships a content-addressed persistent cache keyed on
+the lowered program + compile options + backend version; pointing it at a
+directory that outlives the process turns all of those into disk reads.
+
+One function so the CLI, bench queue (``tpu_queue.sh`` exports
+``JAX_COMPILATION_CACHE_DIR`` the env-var way), and tests share the exact
+config-knob set.
+
+Stability caveat (jax 0.4.x): with the VIRTUAL multi-device CPU platform
+(``--xla_force_host_platform_device_count=N``, the test mesh) the cache
+has been observed aborting the process under donated sharded executions —
+which is why the test suite does not enable it globally and the
+warm-restart test runs single-device subprocesses.  Real single-device
+CPU and TPU backends (where the bench queue has exported the env var for
+rounds) are unaffected.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+
+def enable_compile_cache(cache_dir: str | Path) -> Path:
+    """Enable JAX's persistent compilation cache rooted at ``cache_dir``.
+
+    Creates the directory, points ``jax_compilation_cache_dir`` at it, and
+    zeroes the min-compile-time / min-entry-size thresholds so even the
+    fast-compiling programs of the test/serve ladder are cached (the
+    defaults skip sub-second compiles, which is every program on the CPU
+    test platform).  Threshold knobs that this jax version doesn't have
+    are skipped — the cache still works with its defaults.
+
+    Safe to call after compiles have already happened: jax latches the
+    cache-disabled state at the first compile of the process, so the
+    latched cache object is reset (best-effort, private API) to pick the
+    new directory up.  Programs compiled before the call are simply not
+    cached.  Returns the cache directory.
+    """
+    import jax
+
+    cache_dir = Path(cache_dir)
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    for option, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(option, value)
+        except Exception:
+            pass
+    try:
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:
+        pass
+    return cache_dir
