@@ -1,19 +1,29 @@
-"""Serving-engine throughput/latency vs request concurrency.
+"""Serving-engine throughput/latency: closed-loop concurrency sweeps and
+an open-loop (target-QPS) load generator.
 
-The continuous-batching question in numbers: how much chip does a slot
-pool recover as in-flight requests stack up?  For each concurrency level
-the engine serves a fixed request load (ragged prompt lengths, shared
-token budget) and reports aggregate generated tokens/sec plus p50/p95
-request latency — the tradeoff curve capacity planning reads.
+Two modes, one JSON row per cell:
 
-Run on a TPU host:  python benchmarks/bench_serving.py
-Prints one JSON line per (config, concurrency) cell.
+* **closed loop** (default): for each ``--concurrency`` level the engine
+  serves a fixed request load (ragged prompt lengths, shared token
+  budget) and reports aggregate generated tokens/sec plus p50/p95/p99
+  request latency — the tradeoff curve capacity planning reads.
+* **open loop** (``--qps F``): requests arrive on a Poisson schedule at
+  the target rate regardless of completions — the arrival process real
+  traffic has — with an optional shared system prefix
+  (``--shared-prefix-len N`` tokens on ``--shared-prefix-frac`` of
+  requests).  Rows carry p50/p95/p99 end-to-end latency, achieved QPS,
+  and — for the paged engine — the prefix-cache hit rate and prefill
+  compute seconds, so the paged-vs-dense comparison ("prefix sharing
+  buys X% of prefill back") is one jax-free diff of two rows.
 
-`--config tinystories-4l|gpt2-small-32k`, `--concurrency N` (repeatable),
-`--requests M`, `--new-tokens K` restrict the grid so long runs can be
-split across invocations (tunnel-outage hygiene).  Warmup (compilation of
-the prefill buckets + tick) happens before timing, so cells measure
-steady-state serving, not XLA.
+``--paged`` switches the engine to the block-pool KV cache
+(`serving/kvpool/`): radix prefix sharing + chunked prefill
+(``--prefill-chunk``/``--prefill-budget``).  Warmup (compilation of the
+bucket ladder + tick) happens before timing in both modes, so cells
+measure steady-state serving, not XLA.
+
+Run on a TPU host:  python benchmarks/bench_serving.py [--qps 8 --paged]
+Prints one JSON line per cell.
 """
 
 from __future__ import annotations
@@ -43,30 +53,104 @@ def _pctl(values, q):
     return ordered[min(len(ordered) - 1, max(0, round(q * len(ordered)) - 1))]
 
 
-def run_cell(params, config, *, concurrency, n_requests, new_tokens, seed=0):
+def _make_engine(params, config, *, concurrency, n_requests, args):
     from bpe_transformer_tpu.serving import ServingEngine
 
-    rng = np.random.default_rng(seed)
+    return ServingEngine(
+        params, config, slots=concurrency, max_queue=n_requests + 1,
+        paged=args.paged, block_size=args.block_size,
+        prefill_chunk=args.prefill_chunk,
+        prefill_token_budget=args.prefill_budget,
+    )
+
+
+def _warmup(serving, config):
+    """One request per distinct bucket + the tick program, so timed cells
+    measure steady-state serving rather than XLA.  Prompts are DISTINCT
+    per bucket: identical ones would share a radix-cache prefix on the
+    paged engine, shrinking later rungs' chunks into already-compiled
+    programs and leaving their cold compile inside the timed cell.
+    Returns a post-warmup stats snapshot so row fields can be reported as
+    deltas (warmup traffic must not pollute hit-rate/compute evidence)."""
     ctx = config.context_length
-    # Ragged prompts across the bucket range, biased short (serving-shaped).
-    lengths = rng.integers(8, min(ctx - new_tokens, 4 * 64), size=n_requests)
-    prompts = [
-        [int(t) for t in rng.integers(0, config.vocab_size, size=n)]
-        for n in lengths
-    ]
+    vocab = config.vocab_size
+    for b in serving.engine.buckets:
+        plen = min(b, ctx - 2)
+        serving.generate([(17 * b + i) % vocab for i in range(plen)],
+                         max_new_tokens=2, temperature=0.0, timeout=600)
+    return serving.stats()
 
-    with ServingEngine(
-        params, config, slots=concurrency, max_queue=n_requests + 1
+
+def _prompts(rng, config, *, n_requests, new_tokens,
+             shared_prefix_len=0, shared_prefix_frac=0.0):
+    """Ragged prompts biased short (serving-shaped); a ``shared_prefix_len``
+    system prefix rides the first ``shared_prefix_frac`` fraction of them
+    (same tokens every time — the prefix-cache target)."""
+    ctx = config.context_length
+    vocab = config.vocab_size
+    max_suffix = max(min(ctx - new_tokens - shared_prefix_len, 4 * 64), 9)
+    prefix = [int(t) for t in rng.integers(0, vocab, size=shared_prefix_len)]
+    prompts = []
+    for i in range(n_requests):
+        n = int(rng.integers(8, max_suffix))
+        suffix = [int(t) for t in rng.integers(0, vocab, size=n)]
+        if shared_prefix_len and i < shared_prefix_frac * n_requests:
+            prompts.append(prefix + suffix)
+        else:
+            prompts.append(suffix)
+    return prompts
+
+
+def _prefill_compute_s(stats):
+    return sum(
+        work["seconds"]
+        for work in stats.get("prefill_bucket_work", {}).values()
+    )
+
+
+def _paged_row_fields(serving, baseline):
+    """Prefix-cache and prefill-compute evidence as DELTAS against the
+    post-warmup ``baseline`` snapshot (warmup traffic excluded) —
+    None-filled for the dense engine so rows stay diffable."""
+    stats = serving.stats()
+    hits = misses = rate = None
+    if stats.get("prefix_cache_hits") is not None:
+        hits = stats["prefix_cache_hits"] - baseline.get(
+            "prefix_cache_hits", 0
+        )
+        misses = stats["prefix_cache_misses"] - baseline.get(
+            "prefix_cache_misses", 0
+        )
+        rate = round(hits / (hits + misses), 6) if hits + misses else None
+    return {
+        "engine": stats.get("engine_kind", "dense"),
+        "prefill_compute_s": round(
+            _prefill_compute_s(stats) - _prefill_compute_s(baseline), 4
+        ),
+        "prefix_hits": hits,
+        "prefix_hit_rate": rate,
+        "kv_blocks_free_end": stats.get("kv_blocks_free"),
+        "decode_p95_s": stats["phase_p95_s"]["decode"],
+    }
+
+
+def run_cell(params, config, *, concurrency, n_requests, new_tokens, args,
+             seed=0):
+    """Closed loop: submit everything up front, the scheduler feeds slots."""
+    from bpe_transformer_tpu.serving import Request
+
+    rng = np.random.default_rng(seed)
+    prompts = _prompts(
+        rng, config, n_requests=n_requests, new_tokens=new_tokens,
+        shared_prefix_len=args.shared_prefix_len,
+        shared_prefix_frac=args.shared_prefix_frac,
+    )
+
+    with _make_engine(
+        params, config, concurrency=concurrency, n_requests=n_requests,
+        args=args,
     ) as serving:
-        # Warmup: one request per distinct bucket + the tick program, so
-        # timed cells measure steady-state serving rather than XLA.
-        for b in serving.engine.buckets:
-            serving.generate([1] * min(b, ctx - 2), max_new_tokens=2,
-                             temperature=0.0, timeout=600)
-
-        # Submit everything up front; the scheduler feeds free slots.
-        from bpe_transformer_tpu.serving import Request
-
+        baseline = _warmup(serving, config)
         t0 = time.perf_counter()
         handles = [
             serving.submit(
@@ -84,15 +168,81 @@ def run_cell(params, config, *, concurrency, n_requests, new_tokens, seed=0):
         ]
         tokens = sum(len(r.token_ids) for r in results)
         compiled = serving.engine.compiled_programs()
+        extra = _paged_row_fields(serving, baseline)
 
     return {
         "wall_s": round(wall, 3),
         "gen_tok_per_s": round(tokens / wall, 1),
         "latency_p50_s": round(_pctl(latencies, 0.50), 4),
         "latency_p95_s": round(_pctl(latencies, 0.95), 4),
+        "latency_p99_s": round(_pctl(latencies, 0.99), 4),
         "compiled_programs": compiled,
         "requests": n_requests,
         "new_tokens": new_tokens,
+        **extra,
+    }
+
+
+def run_open_loop(params, config, *, concurrency, n_requests, new_tokens,
+                  qps, args, seed=0):
+    """Open loop: Poisson arrivals at the target QPS — submissions never
+    wait for completions, so queueing delay is measured, not hidden."""
+    from bpe_transformer_tpu.serving import Request
+
+    rng = np.random.default_rng(seed)
+    prompts = _prompts(
+        rng, config, n_requests=n_requests, new_tokens=new_tokens,
+        shared_prefix_len=args.shared_prefix_len,
+        shared_prefix_frac=args.shared_prefix_frac,
+    )
+    # The shared-prefix requests are interleaved with the rest (real mixes
+    # are), not front-loaded: shuffle the submission order.
+    order = rng.permutation(n_requests)
+    arrivals = np.cumsum(rng.exponential(1.0 / qps, size=n_requests))
+
+    with _make_engine(
+        params, config, concurrency=concurrency, n_requests=n_requests,
+        args=args,
+    ) as serving:
+        baseline = _warmup(serving, config)
+        t0 = time.perf_counter()
+        handles = []
+        for arrival, idx in zip(arrivals, order):
+            now = time.perf_counter() - t0
+            if now < arrival:
+                time.sleep(arrival - now)
+            handles.append(
+                serving.submit(
+                    Request(
+                        prompt_ids=tuple(prompts[int(idx)]),
+                        max_new_tokens=new_tokens,
+                        temperature=1.0, top_k=50, seed=int(idx),
+                    )
+                )
+            )
+        results = [h.result(timeout=1800) for h in handles]
+        wall = time.perf_counter() - t0
+        latencies = [
+            r.queue_wait_s + r.prefill_s + r.decode_s for r in results
+        ]
+        tokens = sum(len(r.token_ids) for r in results)
+        compiled = serving.engine.compiled_programs()
+        extra = _paged_row_fields(serving, baseline)
+
+    return {
+        "wall_s": round(wall, 3),
+        "qps_target": qps,
+        "qps_achieved": round(n_requests / wall, 3),
+        "gen_tok_per_s": round(tokens / wall, 1),
+        "latency_p50_s": round(_pctl(latencies, 0.50), 4),
+        "latency_p95_s": round(_pctl(latencies, 0.95), 4),
+        "latency_p99_s": round(_pctl(latencies, 0.99), 4),
+        "compiled_programs": compiled,
+        "requests": n_requests,
+        "new_tokens": new_tokens,
+        "shared_prefix_len": args.shared_prefix_len,
+        "shared_prefix_frac": args.shared_prefix_frac,
+        **extra,
     }
 
 
@@ -105,6 +255,21 @@ def main() -> int:
     parser.add_argument("--requests", type=int, default=None,
                         help="requests per cell (default 4x concurrency)")
     parser.add_argument("--new-tokens", type=int, default=64)
+    parser.add_argument("--qps", type=float, default=None,
+                        help="open-loop mode: Poisson arrivals at this "
+                        "target rate (default: closed loop)")
+    parser.add_argument("--paged", action="store_true",
+                        help="paged block-pool KV engine (radix prefix "
+                        "sharing + chunked prefill)")
+    parser.add_argument("--block-size", type=int, default=16)
+    parser.add_argument("--prefill-chunk", type=int, default=None)
+    parser.add_argument("--prefill-budget", type=int, default=None)
+    parser.add_argument("--shared-prefix-len", type=int, default=0,
+                        help="shared system-prefix length in tokens "
+                        "(the prefix-cache target workload)")
+    parser.add_argument("--shared-prefix-frac", type=float, default=0.5,
+                        help="fraction of requests carrying the shared "
+                        "prefix (with --shared-prefix-len)")
     args = parser.parse_args()
 
     import dataclasses
@@ -126,23 +291,38 @@ def main() -> int:
     for concurrency in levels:
         n_requests = args.requests or 4 * concurrency
         try:
-            cell = run_cell(
-                params, config,
-                concurrency=concurrency,
-                n_requests=n_requests,
-                new_tokens=new_tokens,
-            )
+            if args.qps is not None:
+                cell = run_open_loop(
+                    params, config,
+                    concurrency=concurrency,
+                    n_requests=n_requests,
+                    new_tokens=new_tokens,
+                    qps=args.qps,
+                    args=args,
+                )
+                mode = f"qps={args.qps}"
+            else:
+                cell = run_cell(
+                    params, config,
+                    concurrency=concurrency,
+                    n_requests=n_requests,
+                    new_tokens=new_tokens,
+                    args=args,
+                )
+                mode = "closed"
         except Exception as exc:  # noqa: BLE001 - report the cell as absent
             print(f"concurrency={concurrency} failed: {exc!r}"[:300],
                   file=sys.stderr)
             continue
         measured_any = True
+        engine = "paged" if args.paged else "dense"
         print(
             json.dumps(
                 {
                     "metric": f"serving_tokens_per_sec ({args.config}, "
                     f"slots={concurrency}, req={n_requests}, "
-                    f"new={new_tokens}, {config.activation_dtype})",
+                    f"new={new_tokens}, {engine}, {mode}, "
+                    f"{config.activation_dtype})",
                     **cell,
                     "device": str(jax.devices()[0]),
                     "platform": jax.devices()[0].platform,

@@ -281,6 +281,20 @@ run_job serve_gpt2s_4 1800 "$CAP/serving.jsonl" \
   python benchmarks/bench_serving.py --config gpt2-small-32k \
   --concurrency 4 --requests 8
 
+# Paged-KV serving (PR 8): open-loop Poisson arrivals with a shared
+# system prefix on half the requests — dense row first (the headline the
+# paged row is judged against), then the paged engine with radix prefix
+# sharing + chunked prefill.  The self-report at the end diffs the two:
+# prefix_hit_rate > 0 and lower prefill_compute_s is the paged win; the
+# p99 columns pin decode latency under the same arrival process.
+run_job serve_open_dense 900 "$CAP/serving_paged.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --concurrency 8 --requests 64 --qps 8 --shared-prefix-len 64
+run_job serve_open_paged 900 "$CAP/serving_paged.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --concurrency 8 --requests 64 --qps 8 --shared-prefix-len 64 \
+  --paged --block-size 16 --prefill-chunk 64 --prefill-budget 128
+
 # Dynamics-introspection overhead (PR 4): the headline config with the
 # in-graph telemetry.dynamics stats compiled into the step (per-layer
 # norms, update ratios, activation taps), captured to its own file
@@ -450,6 +464,50 @@ print("  ".join(parts))
 PY
 )
   [ -n "$SHARD_LINE" ] && log "sharded_opt self-report: $SHARD_LINE"
+fi
+# Paged-serving self-report (jax-free, CPU-only): newest paged vs dense
+# open-loop rows — prefix-cache hit rate, prefill compute delta, and the
+# p99 guardrail under the same Poisson arrivals.
+if [ -s "$CAP/serving_paged.jsonl" ]; then
+  PAGED_LINE=$(env JAX_PLATFORMS=cpu python - "$CAP/serving_paged.jsonl" <<'PY'
+import json, sys
+
+rows = {}
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if not ln:
+        continue
+    try:
+        r = json.loads(ln)
+    except json.JSONDecodeError:
+        continue
+    if "qps_target" in r:
+        rows[r.get("engine", "dense")] = r  # newest row per engine wins
+paged, dense = rows.get("paged"), rows.get("dense")
+if paged is None:
+    sys.exit(0)
+
+
+def num(v, d=4):
+    return f"{v:,.{d}g}" if isinstance(v, (int, float)) else "n/a"
+
+
+parts = [
+    f"prefix_hit_rate {num(paged.get('prefix_hit_rate'))}",
+    f"prefill_compute {num(paged.get('prefill_compute_s'))}s"
+    + (f" (dense {num(dense.get('prefill_compute_s'))}s)" if dense else ""),
+    f"p99 {num(paged.get('latency_p99_s'))}s"
+    + (f" (dense {num(dense.get('latency_p99_s'))}s)" if dense else ""),
+    f"tok/s {num(paged.get('gen_tok_per_s'))}"
+    + (f" (dense {num(dense.get('gen_tok_per_s'))})" if dense else ""),
+]
+hits = paged.get("prefix_hits")
+if isinstance(hits, (int, float)) and hits <= 0:
+    parts.append("WARNING: no prefix-cache hits on a shared-prefix mix")
+print("  ".join(parts))
+PY
+)
+  [ -n "$PAGED_LINE" ] && log "paged serving self-report: $PAGED_LINE"
 fi
 log "queue pass complete"
 # Same size guard as the restore: never shrink the mirrored history.

@@ -289,6 +289,209 @@ def decode_step(
     return logits, new_cache
 
 
+# --------------------------------------------------------- paged KV memory
+#
+# The serving kvpool layer (serving/kvpool/) replaces the dense per-slot
+# cache rows with a flat pool of fixed-size blocks; these are the device
+# programs that read/write KV *through a block table* instead of a
+# contiguous row.  Both live here (not in serving/) because they are the
+# paged twins of prefill/decode_step above and share every building block.
+
+
+def init_kv_pool(
+    config: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.float32
+) -> KVCache:
+    """A paged KV pool: per layer ``(num_blocks, kv_heads, block_size,
+    d_head)`` K and V block arrays.  Block 0 is the serving layer's trash
+    block (masked writes are steered to it); a request's cache is a chain
+    of block ids, not a row index."""
+    kv_heads = config.num_kv_heads or config.num_heads
+    shape = (num_blocks, kv_heads, block_size, config.d_head)
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(config.num_layers)
+    ]
+
+
+def gather_paged_kv(buf: Array, tables: Array) -> Array:
+    """Materialize contiguous per-slot KV from the pool through the block
+    table: ``buf`` (num_blocks, kv_heads, block_size, d_head) gathered by
+    ``tables`` (slots, blocks_per_slot) -> (slots, kv_heads,
+    blocks_per_slot * block_size, d_head).
+
+    This one gather is the whole paged-attention read path: its output is
+    layout-identical to the dense cache, so BOTH decode attention
+    implementations (`xla_decode_attention` and the Pallas flash-decoding
+    kernel) serve the paged pool unchanged.  The buffer is transient
+    (activation-sized, one layer at a time) — only the block pool is
+    resident, which is where paging's memory win lives.
+    """
+    gathered = buf[tables]  # (S, nb, kv, bs, dh)
+    s, nb, kv, bs, dh = gathered.shape
+    return jnp.transpose(gathered, (0, 2, 1, 3, 4)).reshape(s, kv, nb * bs, dh)
+
+
+def paged_decode_step(
+    params: Params,
+    token: Array,
+    pos: Array,
+    pool: KVCache,
+    tables: Array,
+    config: ModelConfig,
+    lm_head: Array | None = None,
+    active: Array | None = None,
+    *,
+    block_size: int,
+) -> tuple[Array, KVCache]:
+    """One cached decode step against the paged pool — the block-table twin
+    of :func:`decode_step`.
+
+    ``token``/``pos``/``active``: per-slot ``(slots,)`` vectors as in the
+    serving slot pool.  ``tables`` (slots, blocks_per_slot) int32 maps each
+    slot's logical block index to a pool block id (0 = trash).  The new
+    K/V is scattered into the pool at ``(tables[slot, pos // block_size],
+    pos % block_size)`` — inactive slots scatter to the trash block, so one
+    compiled program serves every occupancy pattern — then attention reads
+    the slot's contiguous view through :func:`gather_paged_kv`, honoring
+    ``config.decode_attention_impl`` exactly like the dense step.
+    """
+    x = embedding(params["token_embeddings"], token[:, None])  # (S, 1, d)
+    positions = pos[:, None]
+    block_col = (pos // block_size).astype(jnp.int32)
+    offsets = (pos % block_size).astype(jnp.int32)
+    write_ids = jnp.take_along_axis(tables, block_col[:, None], axis=1)[:, 0]
+    if active is not None:
+        write_ids = jnp.where(active, write_ids, 0)
+
+    new_pool = []
+    for block_params, layer_pool in zip(params["layers"], pool):
+
+        def attend(h, block_params=block_params, layer_pool=layer_pool):
+            q, k, v = _project_qkv(h, block_params["attn"], config)
+            q, k = _rope_qk(q, k, positions, config)
+            # Scatter the one new token's K/V into each slot's frontier
+            # block (advanced-index scatter: (S,) block ids x (S,) offsets
+            # address (S, kv_heads, d_head) values).
+            k_pool = layer_pool["k"].at[write_ids, :, offsets, :].set(
+                k[:, :, 0, :]
+            )
+            v_pool = layer_pool["v"].at[write_ids, :, offsets, :].set(
+                v[:, :, 0, :]
+            )
+            new_pool.append({"k": k_pool, "v": v_pool})
+            k_cache = gather_paged_kv(k_pool, tables)
+            v_cache = gather_paged_kv(v_pool, tables)
+            if config.decode_attention_impl == "pallas":
+                from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+                    decode_attention,
+                )
+
+                att = decode_attention(q[:, :, 0], k_cache, v_cache, pos)
+            else:
+                from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+                    xla_decode_attention,
+                )
+
+                att = xla_decode_attention(q[:, :, 0], k_cache, v_cache, pos)
+            att = merge_heads(att[:, :, None, :])
+            return linear(att, block_params["attn"]["output_proj"])
+
+        x = _block_apply(x, block_params, config, attend)
+
+    x = _norm(x, params["ln_final"], config)
+    head = lm_head_weight(params, config) if lm_head is None else lm_head
+    logits = head_logits(x[:, 0], head)
+    return logits, new_pool
+
+
+def paged_chunk_prefill(
+    params: Params,
+    chunk_tokens: Array,
+    start: Array,
+    chunk_len: Array,
+    table_row: Array,
+    pool: KVCache,
+    config: ModelConfig,
+    lm_head: Array | None = None,
+    *,
+    block_size: int,
+) -> tuple[Array, KVCache]:
+    """Prefill ONE chunk of one slot's prompt into the paged pool.
+
+    ``chunk_tokens`` (1, chunk_bucket) is the chunk padded to its program
+    bucket; ``start`` (traced scalar) its first absolute position;
+    ``chunk_len`` (traced) the real token count; ``table_row``
+    (blocks_per_slot,) the slot's block chain.  The chunk's K/V is
+    scattered straight into the pool per position (padded tail positions
+    steer to the trash block), then the chunk's queries attend to the
+    slot's FULL gathered cache under the causal mask ``key_pos <= start +
+    row`` — which is what lets a chunk resume after a radix-cache-shared
+    prefix (positions < start were written by an earlier request's
+    prefill) and is also how long prompts prefill incrementally, chunk by
+    chunk, between decode ticks.
+
+    Returns logits at the chunk's last real position (the serving layer
+    samples the first token from the FINAL chunk's logits and discards the
+    others) and the updated pool.  Non-final chunks must have ``chunk_len
+    % block_size == 0`` so the next chunk starts block-aligned.
+
+    Attention here is the materialized-scores formulation (transient
+    O(chunk x context) score buffer) regardless of ``attention_impl`` —
+    the chunk-vs-whole-cache shape has no flash kernel yet.
+    """
+    _, cb = chunk_tokens.shape
+    ctx = config.context_length
+    nb = table_row.shape[0]
+    positions = start + jnp.arange(cb)
+    # Padded tail rows may index past the RoPE/context tables: clamp them
+    # (their outputs are discarded; their pool writes go to trash below).
+    safe_positions = jnp.clip(positions, 0, ctx - 1)
+    in_chunk = jnp.arange(cb) < chunk_len
+    idx_in_table = jnp.clip(safe_positions // block_size, 0, nb - 1)
+    write_ids = jnp.where(in_chunk, table_row[idx_in_table], 0)
+    offsets = safe_positions % block_size
+
+    x = embedding(params["token_embeddings"], chunk_tokens)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(config.d_head, jnp.float32))
+    # (cb, ctx) causal frontier: key j visible to chunk row i iff j <= start+i.
+    mask = (
+        jnp.arange(nb * block_size)[None, :] <= (start + jnp.arange(cb))[:, None]
+    )
+
+    new_pool = []
+    for block_params, layer_pool in zip(params["layers"], pool):
+
+        def attend(h, block_params=block_params, layer_pool=layer_pool):
+            q, k, v = _project_qkv(h, block_params["attn"], config)
+            q, k = _rope_qk(q, k, safe_positions, config)
+            k_pool = layer_pool["k"].at[write_ids, :, offsets, :].set(
+                jnp.transpose(k[0], (1, 0, 2))
+            )
+            v_pool = layer_pool["v"].at[write_ids, :, offsets, :].set(
+                jnp.transpose(v[0], (1, 0, 2))
+            )
+            new_pool.append({"k": k_pool, "v": v_pool})
+            k_cache = gather_paged_kv(k_pool, table_row[None])
+            v_cache = gather_paged_kv(v_pool, table_row[None])
+            k_full = _expand_kv(k_cache, config)
+            v_full = _expand_kv(v_cache, config)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_full) * scale
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32), axis=-1
+            ).astype(h.dtype)
+            att = merge_heads(jnp.einsum("bhqk,bhkd->bhqd", probs, v_full))
+            return linear(att, block_params["attn"]["output_proj"])
+
+        x = _block_apply(x, block_params, config, attend)
+
+    x = _norm(x, params["ln_final"], config)
+    head = lm_head_weight(params, config) if lm_head is None else lm_head
+    idx = jnp.reshape(jnp.clip(chunk_len - 1, 0, cb - 1), (1, 1, 1))
+    last = jnp.take_along_axis(x, idx, axis=1)[:, 0]
+    return head_logits(last, head), new_pool
+
+
 def _sample_from_logits(
     logits, key, temperature: float, top_k: int | None, top_p: float | None = None
 ):

@@ -1,46 +1,67 @@
 """Continuous-batching inference serving.
 
 The ROADMAP's "serve heavy traffic" leg: a fixed-capacity slot pool of
-batched KV caches (`engine`), a FIFO admission queue with backpressure,
-deadlines, and max-wait batching (`scheduler`), and the request/transport
-layer — blocking + streaming generation, offline batch files, a stdlib
-HTTP endpoint — behind ``bpe-tpu serve`` (`server`).
+batched KV caches (`engine`), a paged block-pool alternative with radix
+prefix sharing and chunked prefill (`kvpool`), a FIFO admission queue with
+backpressure, deadlines, max-wait batching, and a chunked-prefill token
+budget (`scheduler`), the request/transport layer — blocking + streaming
+generation, offline batch files, a stdlib HTTP endpoint — behind
+``bpe-tpu serve`` (`server`), and a jax-free fleet `router` that balances
+requests across N replicas off their /statusz health surface
+(``bpe-tpu route``).
 
 Everything runs under ``JAX_PLATFORMS=cpu`` with tiny configs, so the full
 engine is tier-1-testable; on TPU the same programs serve at chip speed.
+
+PEP-562 lazy exports: the jax-free members (`FifoScheduler`,
+`PrefillBudget`, `Router`, the kvpool host-side bookkeeping) must be
+importable on hosts with no accelerator runtime — the engine/server
+modules (which import jax) only load when their symbols are touched.
 """
 
-from bpe_transformer_tpu.serving.engine import (
-    SlotPoolEngine,
-    TickEvent,
-    default_prefill_buckets,
-)
-from bpe_transformer_tpu.serving.metrics import (
-    LatencyHistogram,
-    ServingMetrics,
-    render_prometheus,
-)
-from bpe_transformer_tpu.serving.scheduler import FifoScheduler, QueueFullError
-from bpe_transformer_tpu.serving.server import (
-    Request,
-    RequestHandle,
-    Result,
-    ServingEngine,
-    make_http_server,
+from bpe_transformer_tpu._lazy import lazy_attrs
+
+__getattr__ = lazy_attrs(
+    __name__,
+    {
+        "SlotPoolEngine": "engine",
+        "TickEvent": "engine",
+        "default_prefill_buckets": "engine",
+        "LatencyHistogram": "metrics",
+        "ServingMetrics": "metrics",
+        "render_prometheus": "metrics",
+        "FifoScheduler": "scheduler",
+        "PrefillBudget": "scheduler",
+        "QueueFullError": "scheduler",
+        "Request": "server",
+        "RequestHandle": "server",
+        "Result": "server",
+        "ServingEngine": "server",
+        "make_http_server": "server",
+        "PagedEngine": "kvpool.paged_engine",
+        "NoFreeBlocksError": "kvpool.blocks",
+        "Router": "router",
+        "make_router_http_server": "router",
+    },
 )
 
 __all__ = [
     "FifoScheduler",
     "LatencyHistogram",
+    "NoFreeBlocksError",
+    "PagedEngine",
+    "PrefillBudget",
     "QueueFullError",
     "Request",
     "RequestHandle",
     "Result",
+    "Router",
     "ServingEngine",
     "ServingMetrics",
     "SlotPoolEngine",
     "TickEvent",
     "default_prefill_buckets",
     "make_http_server",
+    "make_router_http_server",
     "render_prometheus",
 ]

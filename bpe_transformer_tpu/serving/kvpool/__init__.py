@@ -1,0 +1,44 @@
+"""Paged KV-cache memory for the serving engine.
+
+The PR-2 slot pool stores one dense ``context_length`` KV row per slot:
+every admission pays full prefill compute and full-row HBM even when
+thousands of requests share the same system prompt.  This package replaces
+that with **paged memory** (the vLLM formulation, TPU-shaped):
+
+- `blocks`   — a jax-free refcounted allocator over fixed-size KV blocks
+  (``block_size`` tokens each); KV for one request is a *chain of block
+  ids*, not a contiguous row, so memory is provisioned by tokens actually
+  written rather than worst-case context;
+- `radix`    — a jax-free token-trie mapping prompt prefixes (at block
+  granularity) to frozen block chains, so a shared system prompt is
+  prefilled ONCE and subsequently reference-counted copy-on-write —
+  shared blocks are never written again, new requests only allocate and
+  compute their unshared suffix;
+- `paged_engine` — the `PagedEngine`: the slot-pool engine's contract
+  (admit / tick / release, one jitted tick, bounded compile count) on top
+  of the block pool, with **chunked prefill** — long prompts prefill in
+  fixed-size chunks the serving worker interleaves with decode ticks so
+  heavy prefill traffic cannot starve decode latency.
+
+`blocks` and `radix` import no jax (the router and tests reason about
+them on chip-free hosts); `paged_engine` owns the device programs.
+"""
+
+from bpe_transformer_tpu._lazy import lazy_attrs
+
+__getattr__ = lazy_attrs(
+    __name__,
+    {
+        "BlockAllocator": "blocks",
+        "NoFreeBlocksError": "blocks",
+        "RadixPrefixCache": "radix",
+        "PagedEngine": "paged_engine",
+    },
+)
+
+__all__ = [
+    "BlockAllocator",
+    "NoFreeBlocksError",
+    "PagedEngine",
+    "RadixPrefixCache",
+]

@@ -1,0 +1,546 @@
+"""Paged continuous-batching engine: the slot-pool contract on block-pool
+KV memory, with radix prefix sharing and chunked prefill.
+
+Drop-in peer of `serving.engine.SlotPoolEngine` (same admit/tick/release
+lifecycle, same ``TickEvent`` vocabulary, same one-jitted-tick and
+bounded-compile-count guarantees), with three new behaviors:
+
+* **paged KV** — the cache is a flat pool of ``block_size``-token blocks
+  (`models/decode.init_kv_pool`); each slot owns a *chain of block ids*
+  in a block table that the decode tick and chunk prefill read through
+  (gather) and write through (scatter).  Pool capacity is a knob
+  (``num_blocks``) decoupled from ``slots * context_length``;
+* **radix prefix sharing** — prompts consult the `RadixPrefixCache`
+  before computing: matched full blocks are reference-counted into the
+  slot's table and prefill starts at the first unmatched position, so a
+  shared system prompt is computed once per fleet replica, not once per
+  request.  Token-identical to the dense engine by construction: K/V at
+  a position is a pure function of the token prefix, and shared blocks
+  are frozen (copy-on-write, never rewritten);
+* **chunked prefill** — prefill is a resumable state machine
+  (:meth:`begin` / :meth:`prefill_step`): each step runs ONE
+  ``prefill_chunk``-token chunk, so the serving worker can interleave
+  decode ticks between a long prompt's chunks and decode p99 stays
+  bounded under heavy prefill traffic (the worker owns the per-tick
+  token budget — `serving.scheduler.PrefillBudget`).
+
+Compile count: one program per chunk bucket + one tick, asserted by
+:meth:`compiled_programs` exactly like the dense engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bpe_transformer_tpu.models.config import ModelConfig
+from bpe_transformer_tpu.models.decode import (
+    init_kv_pool,
+    paged_chunk_prefill,
+    paged_decode_step,
+)
+from bpe_transformer_tpu.models.transformer import lm_head_weight
+from bpe_transformer_tpu.serving.engine import (
+    TOP_K_DISABLED,
+    TOP_P_DISABLED,
+    SlotPoolEngine,
+    TickEvent,
+    default_prefill_buckets,
+    sample_tokens,
+)
+from bpe_transformer_tpu.serving.kvpool.blocks import (
+    BlockAllocator,
+    NoFreeBlocksError,
+)
+from bpe_transformer_tpu.serving.kvpool.radix import RadixPrefixCache
+
+__all__ = ["PagedEngine", "PagedSlotInfo", "NoFreeBlocksError"]
+
+
+def _chunk_program(
+    params, lm_head, pool, table_row, chunk, start, chunk_len, key, temp,
+    top_k, top_p, *, config: ModelConfig, block_size: int,
+):
+    """One chunk-bucket-shaped prefill step + first-token sampling.  The
+    sampled token/key are meaningful only for a prompt's FINAL chunk (the
+    host passes the request key there and ignores the outputs earlier),
+    so key handling stays byte-identical to the dense prefill program."""
+    logits, pool = paged_chunk_prefill(
+        params, chunk, start, chunk_len, table_row, pool, config,
+        lm_head=lm_head, block_size=block_size,
+    )
+    key, sub = jax.random.split(key)
+    tok = sample_tokens(
+        logits, sub[None], temp[None], top_k[None], top_p[None]
+    )[0]
+    return tok, key, pool
+
+
+def _paged_tick_program(
+    params, lm_head, pool, tables, tokens, positions, active, keys, temps,
+    top_ks, top_ps, *, config: ModelConfig, block_size: int,
+):
+    """One engine tick over the paged pool — sampling identical to the
+    dense `_tick_program`, decode reads/writes through the block table."""
+    logits, pool = paged_decode_step(
+        params, tokens, positions, pool, tables, config, lm_head=lm_head,
+        active=active, block_size=block_size,
+    )
+    split = jax.vmap(jax.random.split)(keys)
+    keys_next, subs = split[:, 0], split[:, 1]
+    nxt = sample_tokens(logits, subs, temps, top_ks, top_ps)
+    nxt = jnp.where(active, nxt, tokens)
+    keys_next = jnp.where(active[:, None], keys_next, keys)
+    positions = jnp.where(active, positions + 1, positions)
+    return nxt, positions, keys_next, pool
+
+
+@dataclasses.dataclass
+class PagedSlotInfo:
+    """Host-side bookkeeping for one occupied slot (prefill + decode)."""
+
+    prompt: np.ndarray  # int32 prompt ids (owned copy)
+    prompt_len: int
+    bucket: int  # the first computed chunk's program bucket (metrics)
+    max_new_tokens: int  # effective: clamped to the context window
+    stop_id: int | None
+    seed: int
+    temp_enc: np.float32
+    top_k_enc: np.int32
+    top_p_enc: np.float32
+    block_ids: list  # every block this slot holds a reference on
+    shared_len: int  # tokens reused from the prefix cache (block-aligned)
+    next_pos: int  # prefill cursor: first position not yet computed
+    generated: int = 0
+
+
+class PagedEngine:
+    """Paged-KV continuous-batching engine (see module docstring).
+
+    Single-threaded like the dense engine: one caller drives
+    :meth:`begin`/:meth:`prefill_step`/:meth:`tick`/:meth:`release` (or
+    the :meth:`admit` convenience that runs a whole prefill at once).
+    """
+
+    def __init__(
+        self,
+        params,
+        config: ModelConfig,
+        *,
+        slots: int = 8,
+        block_size: int = 16,
+        num_blocks: int | None = None,
+        prefill_buckets: tuple[int, ...] | None = None,
+        min_bucket: int = 16,
+        prefill_chunk: int | None = None,
+        prefix_cache: bool = True,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        ctx = config.context_length
+        if block_size < 1 or ctx % block_size:
+            raise ValueError(
+                f"block_size={block_size} must divide "
+                f"context_length={ctx}"
+            )
+        self.config = config
+        self.n_slots = slots
+        self.block_size = block_size
+        self.blocks_per_slot = ctx // block_size
+        if prefill_chunk is None:
+            prefill_chunk = ctx
+        if prefill_chunk < 1 or (
+            prefill_chunk < ctx and prefill_chunk % block_size
+        ):
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} must be a positive "
+                f"multiple of block_size={block_size} (chunks after the "
+                "first must start block-aligned)"
+            )
+        self.prefill_chunk = min(prefill_chunk, ctx)
+
+        if prefill_buckets is None:
+            prefill_buckets = default_prefill_buckets(ctx, min_bucket)
+        ladder = tuple(sorted(set(prefill_buckets)))
+        if not ladder or ladder[-1] > ctx:
+            raise ValueError(
+                f"prefill buckets {ladder} must be non-empty and <= "
+                f"context_length={ctx}"
+            )
+        if ladder[-1] < ctx:
+            ladder = ladder + (ctx,)
+        # Chunk program shapes: the bucket ladder capped at the chunk size
+        # (a chunk is never longer than prefill_chunk, so larger buckets
+        # would never compile anyway — the compile bound only shrinks).
+        chunk_ladder = tuple(b for b in ladder if b < self.prefill_chunk)
+        self.buckets = chunk_ladder + (self.prefill_chunk,)
+
+        # Pool capacity: default exactly the dense slot pool's (every slot
+        # can hold a full context) + the reserved trash block; prefix
+        # sharing makes the same capacity serve MORE concurrent work.
+        if num_blocks is None:
+            num_blocks = slots * self.blocks_per_slot + 1
+        self.allocator = BlockAllocator(num_blocks, block_size)
+        self.prefix_cache = (
+            RadixPrefixCache(self.allocator) if prefix_cache else None
+        )
+
+        act_dtype = jnp.dtype(config.activation_dtype)
+        self._lm_head = lm_head_weight(params, config).astype(act_dtype)
+        if act_dtype != jnp.float32:
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(act_dtype), params
+            )
+        self._params = params
+        self._pool = init_kv_pool(config, num_blocks, block_size, act_dtype)
+
+        self._tables = np.zeros((slots, self.blocks_per_slot), np.int32)
+        self._tokens = np.zeros(slots, np.int32)
+        self._positions = np.zeros(slots, np.int32)
+        self._active = np.zeros(slots, bool)
+        self._keys = np.zeros((slots, 2), np.uint32)
+        self._temps = np.zeros(slots, np.float32)
+        self._top_ks = np.full(slots, TOP_K_DISABLED, np.int32)
+        self._top_ps = np.full(slots, TOP_P_DISABLED, np.float32)
+        self._slots: list[PagedSlotInfo | None] = [None] * slots
+        self._prefilling: list[int] = []  # slots mid-prefill, begin order
+
+        # Per-engine jit closures: compiled_programs() is an exact
+        # per-engine compile counter, as in the dense engine.
+        self._chunk_jit = jax.jit(
+            functools.partial(
+                _chunk_program, config=config, block_size=block_size
+            )
+        )
+        self._tick_jit = jax.jit(
+            functools.partial(
+                _paged_tick_program, config=config, block_size=block_size
+            )
+        )
+
+        self.ticks = 0
+        self.tokens_emitted = 0
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def active_count(self) -> int:
+        return int(self._active.sum())
+
+    @property
+    def free_slots(self) -> int:
+        return sum(1 for info in self._slots if info is None)
+
+    def compiled_programs(self) -> int:
+        """XLA programs compiled by this engine so far — bounded by
+        ``len(self.buckets) + 1`` (one chunk program per bucket + the
+        tick)."""
+        return self._chunk_jit._cache_size() + self._tick_jit._cache_size()
+
+    def bucket_for(self, length: int) -> int:
+        """The smallest chunk bucket holding ``length`` tokens (lengths
+        beyond the chunk size run as multiple chunks of the largest)."""
+        for b in self.buckets:
+            if length <= b:
+                return b
+        return self.buckets[-1]
+
+    def slot_bucket(self, slot: int) -> int | None:
+        """The slot's first computed chunk bucket (metrics labeling)."""
+        info = self._slots[slot]
+        return None if info is None else info.bucket
+
+    def slot_shared_len(self, slot: int) -> int:
+        """Prompt tokens the slot reused from the prefix cache."""
+        info = self._slots[slot]
+        return 0 if info is None else info.shared_len
+
+    def pending_prefills(self) -> tuple[int, ...]:
+        """Slots with prefill chunks still to run, in begin order."""
+        return tuple(self._prefilling)
+
+    def prefill_remaining(self, slot: int) -> int:
+        info = self._slots[slot]
+        if info is None:
+            return 0
+        return info.prompt_len - info.next_pos
+
+    def next_chunk_tokens(self, slot: int) -> int:
+        """The token cost of the next :meth:`prefill_step` on ``slot``
+        (what the serving worker charges against its per-tick budget)."""
+        return min(self.prefill_chunk, self.prefill_remaining(slot))
+
+    def pending_prefill_tokens(self) -> int:
+        return sum(self.prefill_remaining(s) for s in self._prefilling)
+
+    def gauges(self) -> dict:
+        """The kvpool operational gauges (/metrics + kind="kvpool")."""
+        out = self.allocator.gauges()
+        if self.prefix_cache is not None:
+            out.update(self.prefix_cache.gauges())
+        else:
+            out.update(
+                {
+                    "prefix_cache_hits": 0,
+                    "prefix_cache_misses": 0,
+                    "prefix_hit_rate": None,
+                    "prefix_cache_nodes": 0,
+                }
+            )
+        out["prefill_pending_tokens"] = self.pending_prefill_tokens()
+        out["prefill_pending_slots"] = len(self._prefilling)
+        return out
+
+    def slot_states(self) -> list[dict]:
+        """Per-slot occupancy snapshot (the ``/statusz`` view), extended
+        with paged-memory facts: blocks held, shared-prefix tokens, and
+        prefill progress for slots still chunking."""
+        states: list[dict] = []
+        for slot in range(self.n_slots):
+            info = self._slots[slot]
+            if info is None:
+                states.append({"slot": slot, "active": False})
+                continue
+            states.append(
+                {
+                    "slot": slot,
+                    "active": bool(self._active[slot]),
+                    "position": int(self._positions[slot]),
+                    "prompt_len": info.prompt_len,
+                    "bucket": info.bucket,
+                    "generated": info.generated,
+                    "max_new_tokens": info.max_new_tokens,
+                    "blocks": len(info.block_ids),
+                    "shared_prefix_tokens": info.shared_len,
+                    "prefill_pos": info.next_pos,
+                }
+            )
+        return states
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _validate(self, prompt: np.ndarray, max_new_tokens: int) -> None:
+        plen = prompt.shape[0]
+        ctx = self.config.context_length
+        if plen < 1:
+            raise ValueError("prompt must contain at least one token")
+        if plen > ctx - 1:
+            raise ValueError(
+                f"prompt of {plen} tokens leaves no room to generate in a "
+                f"context of {ctx}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Worst-case block reservation for one request (before any
+        prefix-cache credit): every position the request may ever write."""
+        ctx = self.config.context_length
+        eff = min(max_new_tokens, ctx - prompt_len)
+        span = min(prompt_len + eff, ctx)
+        return -(-span // self.block_size)  # ceil
+
+    def begin(
+        self,
+        prompt_ids,
+        *,
+        max_new_tokens: int,
+        temperature: float = 1.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        seed: int = 0,
+        stop_id: int | None = None,
+    ) -> int:
+        """Reserve a slot + its worst-case block chain (prefix-cache blocks
+        reused by reference) and queue the prompt for chunked prefill.
+        Raises ``RuntimeError`` when no slot is free,
+        :class:`NoFreeBlocksError` when the pool (after cache eviction)
+        cannot cover the reservation — the caller parks the admission and
+        retries as decode retirements free blocks."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        self._validate(prompt, max_new_tokens)
+        plen = int(prompt.shape[0])
+        free = [s for s in range(self.n_slots) if self._slots[s] is None]
+        if not free:
+            raise RuntimeError("no free slot")
+        slot = free[0]
+
+        need = self.blocks_needed(plen, max_new_tokens)
+        if need > self.allocator.usable_blocks:
+            raise ValueError(
+                f"request needs {need} KV blocks; the pool holds "
+                f"{self.allocator.usable_blocks}"
+            )
+        matched: list[int] = []
+        if self.prefix_cache is not None:
+            matched = self.prefix_cache.match([int(t) for t in prompt])
+        new_needed = need - len(matched)
+        shortfall = new_needed - self.allocator.free_count
+        if shortfall > 0 and self.prefix_cache is not None:
+            self.prefix_cache.evict(shortfall)
+        try:
+            fresh = self.allocator.alloc(new_needed)
+        except NoFreeBlocksError:
+            if matched:
+                self.allocator.deref(matched)
+            raise
+        block_ids = matched + fresh
+        self._tables[slot, : len(block_ids)] = block_ids
+        self._tables[slot, len(block_ids):] = 0
+
+        shared_len = len(matched) * self.block_size
+        if self.prefix_cache is not None:
+            # Charged only now that the admission proceeds: a parked
+            # (block-starved) request re-matches on every retry and must
+            # not inflate the hit/miss counters.
+            self.prefix_cache.charge(plen, shared_len)
+        ctx = self.config.context_length
+        info = PagedSlotInfo(
+            prompt=prompt,
+            prompt_len=plen,
+            bucket=self.bucket_for(min(plen - shared_len, self.prefill_chunk)),
+            max_new_tokens=min(max_new_tokens, ctx - plen),
+            stop_id=stop_id,
+            seed=seed,
+            temp_enc=np.float32(temperature),
+            top_k_enc=np.int32(TOP_K_DISABLED if top_k is None else top_k),
+            top_p_enc=np.float32(TOP_P_DISABLED if top_p is None else top_p),
+            block_ids=block_ids,
+            shared_len=shared_len,
+            next_pos=shared_len,
+        )
+        self._slots[slot] = info
+        self._prefilling.append(slot)
+        return slot
+
+    def prefill_step(self, slot: int) -> TickEvent | None:
+        """Run ONE prefill chunk for ``slot``.  Returns ``None`` while
+        chunks remain; on the final chunk, samples the request's first
+        token, activates the slot for decode ticks, indexes the prompt's
+        full blocks into the prefix cache, and returns the admission
+        :class:`TickEvent` (exactly the dense engine's ``admit`` result)."""
+        info = self._slots[slot]
+        if info is None or slot not in self._prefilling:
+            raise ValueError(f"slot {slot} has no pending prefill")
+        plen = info.prompt_len
+        chunk_len = min(self.prefill_chunk, plen - info.next_pos)
+        bucket = self.bucket_for(chunk_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :chunk_len] = info.prompt[
+            info.next_pos: info.next_pos + chunk_len
+        ]
+        final = info.next_pos + chunk_len == plen
+        # Key discipline = dense prefill: the request key is split ONCE, on
+        # the final chunk; earlier chunks get a throwaway key and their
+        # sampled token/key outputs are discarded.
+        key_in = jax.random.PRNGKey(info.seed)
+        tok, key, self._pool = self._chunk_jit(
+            self._params, self._lm_head, self._pool,
+            self._tables[slot], padded, np.int32(info.next_pos),
+            np.int32(chunk_len), key_in, info.temp_enc, info.top_k_enc,
+            info.top_p_enc,
+        )
+        info.next_pos += chunk_len
+        if not final:
+            return None
+
+        self._prefilling.remove(slot)
+        token = int(tok)
+        self._tokens[slot] = token
+        self._positions[slot] = plen
+        self._keys[slot] = np.asarray(key)
+        self._temps[slot] = info.temp_enc
+        self._top_ks[slot] = info.top_k_enc
+        self._top_ps[slot] = info.top_p_enc
+        self._active[slot] = True
+        info.generated = 1
+        self.tokens_emitted += 1
+        if self.prefix_cache is not None:
+            full = plen // self.block_size
+            if full:
+                self.prefix_cache.insert(
+                    [int(t) for t in info.prompt[: full * self.block_size]],
+                    info.block_ids[:full],
+                )
+        finished = SlotPoolEngine._finish_reason(info, token)
+        if finished:
+            self.release(slot)
+        return TickEvent(slot=slot, token=token, finished=finished)
+
+    def admit(
+        self,
+        prompt_ids,
+        *,
+        max_new_tokens: int,
+        temperature: float = 1.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        seed: int = 0,
+        stop_id: int | None = None,
+    ) -> TickEvent:
+        """Dense-engine-compatible admission: begin + run every prefill
+        chunk back to back (no decode interleaving).  The serving worker
+        drives chunks itself for budget-interleaved scheduling; tests and
+        offline batch use this."""
+        slot = self.begin(
+            prompt_ids,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            seed=seed,
+            stop_id=stop_id,
+        )
+        while True:
+            event = self.prefill_step(slot)
+            if event is not None:
+                return event
+
+    def tick(self) -> list[TickEvent]:
+        """One batched decode step across every occupied slot — semantics
+        identical to the dense engine's tick."""
+        if not self._active.any():
+            return []
+        tokens, positions, keys, self._pool = self._tick_jit(
+            self._params, self._lm_head, self._pool, self._tables,
+            self._tokens, self._positions, self._active, self._keys,
+            self._temps, self._top_ks, self._top_ps,
+        )
+        tokens = np.asarray(tokens)
+        self._tokens = tokens.copy()
+        self._positions = np.asarray(positions).copy()
+        self._keys = np.asarray(keys).copy()
+        self.ticks += 1
+
+        events: list[TickEvent] = []
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            info = self._slots[slot]
+            token = int(tokens[slot])
+            info.generated += 1
+            self.tokens_emitted += 1
+            finished = SlotPoolEngine._finish_reason(info, token)
+            if finished:
+                self.release(slot)
+            events.append(
+                TickEvent(slot=slot, token=token, finished=finished)
+            )
+        return events
+
+    def release(self, slot: int) -> None:
+        """Free a slot: drop its block references (blocks still indexed by
+        the prefix cache survive for future hits), clear its table row."""
+        info = self._slots[slot]
+        self._active[slot] = False
+        self._slots[slot] = None
+        if slot in self._prefilling:
+            self._prefilling.remove(slot)
+        if info is not None and info.block_ids:
+            self.allocator.deref(info.block_ids)
+        self._tables[slot, :] = 0

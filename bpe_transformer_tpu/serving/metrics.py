@@ -22,7 +22,12 @@ import math
 import threading
 import time
 
-__all__ = ["LatencyHistogram", "ServingMetrics", "render_prometheus"]
+__all__ = [
+    "LatencyHistogram",
+    "ServingMetrics",
+    "emit_prometheus",
+    "render_prometheus",
+]
 
 #: Default latency buckets (seconds): sub-ms queue pops up to minute-long
 #: decodes, roughly x2.5 per step — 14 buckets keeps the exposition small.
@@ -234,6 +239,28 @@ def _fmt_le(bound: float) -> str:
     return formatted
 
 
+def emit_prometheus(
+    lines: list, prefix: str, name: str, kind: str, help_text: str, samples
+) -> None:
+    """Append one metric family (HELP/TYPE + samples) in Prometheus text
+    exposition.  ``samples`` is ``[(labels_dict, value), ...]``; None
+    values are skipped.  Shared by the serving exposition below and the
+    fleet router's (`serving/router.py`) — one formatter, no drift."""
+    lines.append(f"# HELP {prefix}_{name} {help_text}")
+    lines.append(f"# TYPE {prefix}_{name} {kind}")
+    for labels, value in samples:
+        if value is None:
+            continue
+        label_str = (
+            "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+            if labels
+            else ""
+        )
+        if isinstance(value, float):
+            value = f"{value:.9g}"
+        lines.append(f"{prefix}_{name}{label_str} {value}")
+
+
 def render_prometheus(
     metrics: ServingMetrics,
     engine_stats: dict | None = None,
@@ -250,19 +277,7 @@ def render_prometheus(
     lines: list[str] = []
 
     def emit(name, kind, help_text, samples):
-        lines.append(f"# HELP {prefix}_{name} {help_text}")
-        lines.append(f"# TYPE {prefix}_{name} {kind}")
-        for labels, value in samples:
-            if value is None:
-                continue
-            label_str = (
-                "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
-                if labels
-                else ""
-            )
-            if isinstance(value, float):
-                value = f"{value:.9g}"
-            lines.append(f"{prefix}_{name}{label_str} {value}")
+        emit_prometheus(lines, prefix, name, kind, help_text, samples)
 
     with metrics._lock:
         submitted = metrics.requests_submitted
@@ -357,6 +372,31 @@ def render_prometheus(
         emit("engine_compiled_programs", "gauge",
              "XLA programs compiled by this engine (bounded: buckets + 1).",
              [({}, engine_stats.get("compiled_programs"))])
+        # Paged-KV pool gauges (present only when the engine is paged):
+        # block occupancy drives the fleet router's health weighting,
+        # prefix counters quantify the radix cache, pending tokens the
+        # chunked-prefill backlog.
+        emit("kv_blocks_total", "gauge",
+             "KV block pool capacity (trash block excluded).",
+             [({}, engine_stats.get("kv_blocks_total"))])
+        emit("kv_blocks_free", "gauge", "KV blocks currently free.",
+             [({}, engine_stats.get("kv_blocks_free"))])
+        emit("kv_blocks_shared", "gauge",
+             "KV blocks referenced by more than one holder "
+             "(prefix sharing at work).",
+             [({}, engine_stats.get("kv_blocks_shared"))])
+        emit("prefix_cache_hits_total", "counter",
+             "Prompt tokens reused from the radix prefix cache "
+             "(prefill compute avoided).",
+             [({}, engine_stats.get("prefix_cache_hits"))])
+        emit("prefix_cache_misses_total", "counter",
+             "Prompt tokens prefilled because no cached prefix covered "
+             "them.",
+             [({}, engine_stats.get("prefix_cache_misses"))])
+        emit("prefill_pending_tokens", "gauge",
+             "Prompt tokens queued in chunked prefill (the prefill/decode "
+             "interleave backlog).",
+             [({}, engine_stats.get("prefill_pending_tokens"))])
 
     if resources:
         emit("compile_events_total", "counter",

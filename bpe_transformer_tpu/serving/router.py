@@ -1,0 +1,505 @@
+"""Health-aware fleet router: one jax-free HTTP front over N engine
+replicas (``bpe-tpu route``).
+
+One ``bpe-tpu serve`` process owns one accelerator; serving real traffic
+means a FLEET of replicas, and the fleet needs exactly two things a single
+replica cannot provide: capacity-weighted spreading and survival of any
+one replica draining (PR-5 rolling restarts) or dying (exit-75 respawn
+window).  This router provides both from the replicas' existing
+operational surface — no new protocol:
+
+* a poller thread GETs each replica's ``/statusz`` every
+  ``poll_interval_s``: ``queue_depth``, ``active_slots``/``slots``, the
+  paged pool's ``kv_blocks_free``, ``draining``, ``worker_alive``, and
+  the ``last_errors`` ring feed a per-replica health record; a failed
+  poll marks the replica down immediately (fast failover), a healthy
+  poll brings it back (rejoin after restart needs no operator action);
+* ``POST /generate`` picks the healthy, non-draining replica with the
+  most free capacity — weighted by free slots, free KV blocks, and queue
+  depth — and proxies the request.  A refused/broken connection or a
+  draining/backpressure 503 marks the replica and **re-queues the request
+  on the next-best replica** (generation is deterministic per seed, so a
+  replayed request returns the same tokens), so a rolling restart loses
+  zero requests;
+* ``GET /statusz`` (the fleet table: per-replica health + routing
+  counters) and ``GET /metrics`` (Prometheus: routed/retried/failed
+  counters per replica, per-replica health gauges) make the router
+  itself monitorable by the same tools (`bpe-tpu monitor --url`).
+
+Deliberately stdlib-only and importable without jax — it runs on a
+front-end box with no accelerator runtime, like ``bpe-tpu monitor``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.request
+from urllib.parse import urlsplit
+
+__all__ = ["ReplicaState", "Router", "make_router_http_server", "main"]
+
+
+class ReplicaState:
+    """The router's live view of one replica (mutated by the poller)."""
+
+    __slots__ = (
+        "url", "healthy", "draining", "queue_depth", "active_slots",
+        "slots", "kv_blocks_free", "kv_blocks_total", "last_error",
+        "last_poll_t", "consecutive_failures", "routed", "retried_away",
+    )
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.healthy = False  # unknown until the first poll
+        self.draining = False
+        self.queue_depth = 0
+        self.active_slots = 0
+        self.slots = 0
+        self.kv_blocks_free = None
+        self.kv_blocks_total = None
+        self.last_error: str | None = None
+        self.last_poll_t: float | None = None
+        self.consecutive_failures = 0
+        self.routed = 0
+        self.retried_away = 0
+
+    @property
+    def available(self) -> bool:
+        return self.healthy and not self.draining
+
+    def weight(self) -> float:
+        """Free-capacity score (higher = more headroom): free slots are
+        the primary axis, free KV blocks (paged replicas) scale it — a
+        replica with slots but a starved block pool would only park
+        admissions — and queued requests count against."""
+        free_slots = max(self.slots - self.active_slots, 0)
+        score = float(free_slots) - float(self.queue_depth)
+        if self.kv_blocks_total:
+            score += free_slots * (
+                (self.kv_blocks_free or 0) / self.kv_blocks_total
+            )
+        return score
+
+    def snapshot(self) -> dict:
+        return {
+            "url": self.url,
+            "healthy": self.healthy,
+            "draining": self.draining,
+            "available": self.available,
+            "weight": round(self.weight(), 3),
+            "queue_depth": self.queue_depth,
+            "active_slots": self.active_slots,
+            "slots": self.slots,
+            "kv_blocks_free": self.kv_blocks_free,
+            "kv_blocks_total": self.kv_blocks_total,
+            "routed": self.routed,
+            "retried_away": self.retried_away,
+            "consecutive_failures": self.consecutive_failures,
+            "last_error": self.last_error,
+        }
+
+
+class Router:
+    """Weighted balancer + failover over a fixed replica list (see module
+    docstring).  Thread-safe: HTTP handler threads call :meth:`handle`
+    while the poller refreshes health."""
+
+    def __init__(
+        self,
+        replica_urls: list[str],
+        *,
+        poll_interval_s: float = 1.0,
+        poll_timeout_s: float = 5.0,
+        request_timeout_s: float = 600.0,
+        connect_timeout_s: float = 5.0,
+        clock=time.monotonic,
+    ):
+        if not replica_urls:
+            raise ValueError("router needs at least one replica URL")
+        self.replicas = [
+            ReplicaState(self._canonical(url)) for url in replica_urls
+        ]
+        self.poll_interval_s = poll_interval_s
+        self.poll_timeout_s = poll_timeout_s
+        #: ``request_timeout_s`` bounds only the RESPONSE (a generation may
+        #: legitimately run minutes); ``connect_timeout_s`` bounds the TCP
+        #: connect, so a network-blackholed replica costs seconds before
+        #: failover, not the whole request budget.
+        self.request_timeout_s = request_timeout_s
+        self.connect_timeout_s = connect_timeout_s
+        self._clock = clock
+        self._t0 = clock()
+        self._lock = threading.Lock()
+        self._rr = 0  # round-robin tiebreak cursor
+        self.requests_routed = 0
+        self.requests_retried = 0
+        self.requests_failed = 0
+        self._thread: threading.Thread | None = None
+        self._running = False
+
+    @staticmethod
+    def _canonical(url: str) -> str:
+        return url if "://" in url else f"http://{url}"
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "Router":
+        if self._thread is not None:
+            return self
+        self.poll_once()  # routing before the first poll would be blind
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="router-poller", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def __enter__(self) -> "Router":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _poll_loop(self) -> None:
+        while self._running:
+            time.sleep(self.poll_interval_s)
+            if self._running:
+                self.poll_once()
+
+    # -------------------------------------------------------------- health
+
+    def poll_once(self) -> None:
+        """Refresh every replica's health from its ``/statusz``.  Replicas
+        are polled CONCURRENTLY: one blackholed host must cost one poll
+        timeout, not delay the whole fleet's health refresh by N of them."""
+        threads = [
+            threading.Thread(
+                target=self._poll_replica, args=(replica,), daemon=True
+            )
+            for replica in self.replicas
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=self.poll_timeout_s + 1.0)
+
+    def _poll_replica(self, replica: ReplicaState) -> None:
+        try:
+            with urllib.request.urlopen(
+                f"{replica.url}/statusz", timeout=self.poll_timeout_s
+            ) as resp:
+                page = json.loads(resp.read())
+        except (OSError, ValueError) as exc:
+            self._mark_down(replica, f"poll failed: {exc}")
+            return
+        kvpool = page.get("kvpool") or {}
+        with self._lock:
+            replica.healthy = bool(page.get("worker_alive", True))
+            replica.draining = bool(page.get("draining", False))
+            replica.queue_depth = int(page.get("queue_depth") or 0)
+            replica.slots = int(page.get("slots") or 0)
+            replica.active_slots = int(page.get("active_slots") or 0)
+            replica.kv_blocks_free = kvpool.get("kv_blocks_free")
+            replica.kv_blocks_total = kvpool.get("kv_blocks_total")
+            replica.consecutive_failures = 0
+            replica.last_poll_t = self._clock()
+            errors = page.get("last_errors") or []
+            replica.last_error = (
+                errors[-1].get("error")
+                if errors and isinstance(errors[-1], dict)
+                else None
+            )
+
+    def _mark_down(self, replica: ReplicaState, error: str) -> None:
+        with self._lock:
+            replica.healthy = False
+            replica.consecutive_failures += 1
+            replica.last_error = error
+            replica.last_poll_t = self._clock()
+
+    # -------------------------------------------------------------- routing
+
+    def pick_order(self) -> list[ReplicaState]:
+        """Available replicas, best weight first; round-robin rotation
+        breaks exact ties so equal replicas share load evenly."""
+        with self._lock:
+            avail = [r for r in self.replicas if r.available]
+            self._rr += 1
+            rotation = self._rr
+        rotated = avail[rotation % len(avail):] + avail[: rotation % len(avail)] if avail else []
+        return sorted(rotated, key=lambda r: -r.weight())
+
+    def _post_generate(self, replica: ReplicaState, body: bytes):
+        """POST /generate with a short CONNECT timeout and the full
+        request timeout only on the response.  Returns ``(phase, value)``:
+        ``("response", (status, payload))`` on an HTTP answer,
+        ``("connect", exc)`` when the replica was unreachable (safe to
+        fail over), ``("slow", exc)`` when an ESTABLISHED request timed
+        out (the generation is still running — replaying would duplicate
+        it), ``("read", exc)`` when the connection died mid-request
+        (replica killed — replay is safe, the work died with it)."""
+        parts = urlsplit(replica.url)
+        conn = http.client.HTTPConnection(
+            parts.hostname, parts.port, timeout=self.connect_timeout_s
+        )
+        try:
+            try:
+                conn.connect()
+            except OSError as exc:
+                return "connect", exc
+            conn.sock.settimeout(self.request_timeout_s)
+            try:
+                conn.request(
+                    "POST", "/generate", body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                data = resp.read()
+            except TimeoutError as exc:  # socket.timeout on the read side
+                return "slow", exc
+            except (OSError, http.client.HTTPException) as exc:
+                return "read", exc
+            try:
+                payload = json.loads(data)
+                if not isinstance(payload, dict):
+                    raise ValueError
+            except ValueError:
+                payload = {"error": data.decode("utf-8", "replace")[:200]}
+            return "response", (resp.status, payload)
+        finally:
+            conn.close()
+
+    def handle_generate(self, body: bytes) -> tuple[int, dict]:
+        """Proxy one generate request with failover: try replicas in
+        weight order; connection failures, mid-request deaths, and 503s
+        (draining replica, full queue) re-queue the request on the
+        next-best replica."""
+        order = self.pick_order()
+        if not order:
+            with self._lock:
+                self.requests_failed += 1
+            return 503, {"error": "no available replica"}
+        last_error = "no available replica"
+        for i, replica in enumerate(order):
+            if i > 0:
+                with self._lock:
+                    self.requests_retried += 1
+                    order[i - 1].retried_away += 1
+            phase, value = self._post_generate(replica, body)
+            if phase == "response":
+                status, payload = value
+                if status == 200:
+                    with self._lock:
+                        replica.routed += 1
+                        self.requests_routed += 1
+                    payload["replica"] = replica.url
+                    return 200, payload
+                detail = str(payload.get("error", ""))
+                if status == 503:
+                    # Draining or backpressured: route around it.  A
+                    # drain 503 means the replica is going away — flag it
+                    # so new picks skip it before the next poll lands.
+                    if "drain" in detail:
+                        with self._lock:
+                            replica.draining = True
+                    last_error = f"{replica.url}: 503 {detail}"
+                    continue
+                # 4xx is the CALLER's error: no other replica will judge
+                # it differently, so fail it through without retrying.
+                with self._lock:
+                    self.requests_failed += 1
+                return status, {"error": detail or f"HTTP {status}"}
+            if phase == "slow":
+                # The replica ACCEPTED the request and is still working:
+                # it is not dead, and replaying elsewhere would run the
+                # same generation twice fleet-wide.  Fail THIS request
+                # through as a gateway timeout; routing state untouched.
+                with self._lock:
+                    self.requests_failed += 1
+                return 504, {
+                    "error": f"{replica.url} did not answer within "
+                    f"{self.request_timeout_s}s (generation still "
+                    "running; not replayed)"
+                }
+            # "connect" (unreachable) or "read" (died mid-request): the
+            # replica is gone and so is any in-flight work — mark it down
+            # and replay the request elsewhere.
+            self._mark_down(replica, f"{phase} failed: {value}")
+            last_error = f"{replica.url}: {value}"
+        with self._lock:
+            self.requests_failed += 1
+        return 503, {"error": f"all replicas unavailable (last: {last_error})"}
+
+    # ------------------------------------------------------------- surface
+
+    def statusz(self) -> dict:
+        with self._lock:
+            replicas = [r.snapshot() for r in self.replicas]
+            routed, retried, failed = (
+                self.requests_routed,
+                self.requests_retried,
+                self.requests_failed,
+            )
+        return {
+            "uptime_s": round(self._clock() - self._t0, 3),
+            "replicas": replicas,
+            "available": sum(1 for r in replicas if r["available"]),
+            "requests_routed": routed,
+            "requests_retried": retried,
+            "requests_failed": failed,
+        }
+
+    def prometheus_metrics(self, prefix: str = "bpe_tpu_router") -> str:
+        with self._lock:
+            replicas = [r.snapshot() for r in self.replicas]
+            routed, retried, failed = (
+                self.requests_routed,
+                self.requests_retried,
+                self.requests_failed,
+            )
+        # serving/metrics.py is jax-free at import: the router can share
+        # the exposition formatter without touching an accelerator runtime.
+        from bpe_transformer_tpu.serving.metrics import emit_prometheus
+
+        lines: list = []
+
+        def emit(name, kind, help_text, samples):
+            emit_prometheus(lines, prefix, name, kind, help_text, samples)
+
+        emit("requests_routed_total", "counter",
+             "Requests successfully proxied to a replica.", [({}, routed)])
+        emit("requests_retried_total", "counter",
+             "Requests replayed on another replica after a failure/503.",
+             [({}, retried)])
+        emit("requests_failed_total", "counter",
+             "Requests no replica could serve.", [({}, failed)])
+        emit("replica_healthy", "gauge", "Replica reachable and worker alive.",
+             [({"replica": r["url"]}, int(r["healthy"])) for r in replicas])
+        emit("replica_draining", "gauge", "Replica draining (rolling restart).",
+             [({"replica": r["url"]}, int(r["draining"])) for r in replicas])
+        emit("replica_weight", "gauge", "Free-capacity routing weight.",
+             [({"replica": r["url"]}, r["weight"]) for r in replicas])
+        emit("replica_routed_total", "counter", "Requests routed per replica.",
+             [({"replica": r["url"]}, r["routed"]) for r in replicas])
+        return "\n".join(lines) + "\n"
+
+
+def make_router_http_server(
+    router: Router, host: str = "127.0.0.1", port: int = 8100
+):
+    """A `ThreadingHTTPServer` front for the router: ``POST /generate``
+    (proxied with failover), ``GET /statusz`` (fleet table), ``GET
+    /metrics`` (Prometheus), ``GET /healthz``.  ``port=0`` binds an
+    ephemeral port; the caller owns ``serve_forever()``/``shutdown()``."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # noqa: D102
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
+                page = router.statusz()
+                return self._reply(
+                    200, {"ok": page["available"] > 0, **page}
+                )
+            if path == "/statusz":
+                return self._reply(200, router.statusz())
+            if path == "/metrics":
+                body = router.prometheus_metrics().encode("utf-8")
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return None
+            return self._reply(404, {"error": "unknown path"})
+
+        def do_POST(self):  # noqa: N802 (stdlib API)
+            if self.path != "/generate":
+                return self._reply(404, {"error": "unknown path"})
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) or b"{}"
+            code, payload = router.handle_generate(body)
+            return self._reply(code, payload)
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``bpe-tpu route`` entry point (jax-free)."""
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="bpe-tpu route",
+        description="Health-aware HTTP router over bpe-tpu serve replicas "
+        "(jax-free).",
+    )
+    parser.add_argument("--replica", action="append", required=True,
+                        metavar="HOST:PORT",
+                        help="replica base URL (repeatable)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8100,
+                        help="router HTTP port (0: ephemeral)")
+    parser.add_argument("--poll-interval", type=float, default=1.0,
+                        help="seconds between replica health polls")
+    parser.add_argument("--request-timeout", type=float, default=600.0,
+                        help="seconds to wait for a replica's RESPONSE "
+                        "(generations may run long; a timeout is NOT "
+                        "replayed — the work is still running)")
+    parser.add_argument("--connect-timeout", type=float, default=5.0,
+                        help="seconds to wait for a replica's TCP connect "
+                        "(failover to the next replica after)")
+    args = parser.parse_args(argv if argv is not None else sys.argv[1:])
+
+    router = Router(
+        args.replica,
+        poll_interval_s=args.poll_interval,
+        request_timeout_s=args.request_timeout,
+        connect_timeout_s=args.connect_timeout,
+    )
+    server = make_router_http_server(router, host=args.host, port=args.port)
+    host, port = server.server_address[:2]
+    with router:
+        available = sum(1 for r in router.replicas if r.available)
+        print(
+            f"routing on http://{host}:{port} over {len(router.replicas)} "
+            f"replicas ({available} available; POST /generate, GET /healthz "
+            "/metrics /statusz; Ctrl-C stops)",
+            flush=True,
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.shutdown()
+            server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -36,6 +36,43 @@ class QueueFullError(RuntimeError):
     """The admission queue is at capacity — reject, don't hang."""
 
 
+class PrefillBudget:
+    """Per-tick prefill-token budget: the chunked-prefill fairness policy.
+
+    With paged chunked prefill, the worker loop runs prefill CHUNKS and
+    decode ticks from the same thread; without a budget a burst of long
+    prompts would run chunk after chunk while every decoding request
+    stalls — exactly the prefill/decode interference that blows decode
+    p99.  The budget caps prefill tokens between consecutive decode
+    ticks: the worker calls :meth:`start_tick` each loop iteration, asks
+    :meth:`admits` before every chunk, and :meth:`spend`s what it ran.
+
+    The FIRST chunk of an iteration is always admitted (a chunk larger
+    than the whole budget must still make progress); ``tokens_per_tick
+    = None`` disables the policy (prefills run to completion before the
+    next tick, the dense engine's behavior).
+    """
+
+    def __init__(self, tokens_per_tick: int | None):
+        if tokens_per_tick is not None and tokens_per_tick < 1:
+            raise ValueError(
+                f"tokens_per_tick must be >= 1 or None, got {tokens_per_tick}"
+            )
+        self.tokens_per_tick = tokens_per_tick
+        self._spent = 0
+
+    def start_tick(self) -> None:
+        self._spent = 0
+
+    def admits(self, chunk_tokens: int) -> bool:
+        if self.tokens_per_tick is None or self._spent == 0:
+            return True
+        return self._spent + chunk_tokens <= self.tokens_per_tick
+
+    def spend(self, chunk_tokens: int) -> None:
+        self._spent += chunk_tokens
+
+
 @dataclass
 class QueuedItem:
     """One queued request entry plus its arrival metadata."""

@@ -35,7 +35,11 @@ from typing import Iterator
 
 from bpe_transformer_tpu.serving.engine import SlotPoolEngine, TickEvent
 from bpe_transformer_tpu.serving.metrics import ServingMetrics, render_prometheus
-from bpe_transformer_tpu.serving.scheduler import FifoScheduler, QueueFullError
+from bpe_transformer_tpu.serving.scheduler import (
+    FifoScheduler,
+    PrefillBudget,
+    QueueFullError,
+)
 from bpe_transformer_tpu.telemetry.resources import (
     install_compile_counter,
     sample_resources,
@@ -97,7 +101,8 @@ class _Entry:
     __slots__ = (
         "request", "tokens", "stream", "done", "result", "slot",
         "t_submit", "t_decode_start", "queue_wait_s", "prefill_s",
-        "cancel_requested", "bucket",
+        "cancel_requested", "bucket", "t_prefill_start", "programs_before",
+        "shared_tokens",
     )
 
     def __init__(self, request: Request, t_submit: float):
@@ -113,6 +118,9 @@ class _Entry:
         self.prefill_s = 0.0
         self.cancel_requested = False
         self.bucket: int | None = None  # prefill bucket, set at admission
+        self.t_prefill_start = t_submit  # first chunk start (paged engine)
+        self.programs_before = 0  # compile counter at admission (paged)
+        self.shared_tokens = 0  # prefix-cache-reused prompt tokens (paged)
 
 
 class RequestHandle:
@@ -173,15 +181,46 @@ class ServingEngine:
         idle_poll_s: float = 0.02,
         clock=time.monotonic,
         manifest: dict | None = None,
+        paged: bool = False,
+        block_size: int = 16,
+        num_kv_blocks: int | None = None,
+        prefill_chunk: int | None = None,
+        prefill_token_budget: int | None = None,
+        prefix_cache: bool = True,
     ):
         # Count XLA compiles (the engine's bucketed prefills included) into
         # the process-wide telemetry.resources counter before the first
         # program builds.
         install_compile_counter()
-        self.engine = SlotPoolEngine(
-            params, config, slots=slots,
-            prefill_buckets=prefill_buckets, min_bucket=min_bucket,
+        if paged:
+            from bpe_transformer_tpu.serving.kvpool.paged_engine import (
+                PagedEngine,
+            )
+
+            self.engine = PagedEngine(
+                params, config, slots=slots, block_size=block_size,
+                num_blocks=num_kv_blocks,
+                prefill_buckets=prefill_buckets, min_bucket=min_bucket,
+                prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+            )
+        else:
+            self.engine = SlotPoolEngine(
+                params, config, slots=slots,
+                prefill_buckets=prefill_buckets, min_bucket=min_bucket,
+            )
+        self.paged = paged
+        #: Chunked-prefill fairness (paged only): prefill tokens allowed
+        #: between consecutive decode ticks (None = run chunks to
+        #: completion, the dense engine's schedule).
+        self._prefill_budget = PrefillBudget(
+            prefill_token_budget if paged else None
         )
+        #: Admissions parked on KV-block exhaustion (paged): retried in
+        #: FIFO order before any newer queue pop, as decode retirements
+        #: free blocks.
+        self._admit_backlog: list[_Entry] = []
+        #: Slots mid-chunked-prefill -> their entries (paged).
+        self._prefill_entries: dict[int, _Entry] = {}
         self.scheduler = FifoScheduler(
             max_queue=max_queue, max_wait_s=max_wait_s, clock=clock
         )
@@ -278,6 +317,13 @@ class ServingEngine:
             entry = self._slot_entries.pop(slot)
             self.engine.release(slot)
             self._finish(entry, "cancelled")
+        for slot in list(self._prefill_entries):
+            entry = self._prefill_entries.pop(slot)
+            self.engine.release(slot)
+            self._finish(entry, "cancelled")
+        for entry in self._admit_backlog:
+            self._finish(entry, "cancelled")
+        self._admit_backlog = []
         if self._telemetry is not None:
             self._telemetry.footer(
                 clean=self._worker_error is None,
@@ -321,6 +367,16 @@ class ServingEngine:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {request.max_new_tokens}"
             )
+        if self.paged:
+            # A request whose worst-case block chain exceeds the whole pool
+            # can NEVER be admitted: fail fast at the transport instead of
+            # deadlocking the admission backlog.
+            need = self.engine.blocks_needed(plen, request.max_new_tokens)
+            if need > self.engine.allocator.usable_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks; the pool holds "
+                    f"{self.engine.allocator.usable_blocks}"
+                )
         entry = _Entry(request, self._clock())
         with self._entries_lock:
             self._entries[request.request_id] = entry
@@ -391,8 +447,11 @@ class ServingEngine:
 
     def stats(self) -> dict:
         """Engine/queue gauges + the live request counters — the same
-        aggregate ``GET /metrics`` renders, reachable offline."""
-        return {
+        aggregate ``GET /metrics`` renders, reachable offline.  A paged
+        engine adds the kvpool gauges (block occupancy, prefix-cache
+        hit/miss counters, chunked-prefill queue depth)."""
+        stats = {
+            "engine_kind": "paged" if self.paged else "dense",
             "slots": self.engine.n_slots,
             "active_slots": self.engine.active_count,
             "queue_depth": self.scheduler.depth,
@@ -403,6 +462,11 @@ class ServingEngine:
             "prefill_buckets": list(self.engine.buckets),
             **self.metrics.snapshot(),
         }
+        if self.paged:
+            stats.update(self.engine.gauges())
+            stats["block_size"] = self.engine.block_size
+            stats["admit_backlog"] = len(self._admit_backlog)
+        return stats
 
     def statusz(self) -> dict:
         """The ``GET /statusz`` payload: run manifest, uptime, compile
@@ -410,13 +474,23 @@ class ServingEngine:
         events), per-slot state, queue depth, the recent-request trace
         ring (per-request phase timelines), and the last-error ring."""
         resources = sample_resources()
-        return {
+        page = {
             "manifest": self.manifest,
             "uptime_s": round(self.metrics.uptime_s(), 3),
+            "engine_kind": "paged" if self.paged else "dense",
+            # The fleet router reads these to route around a replica that
+            # is shutting down (PR-5 drain) or whose worker died, and to
+            # weight by free capacity.  Load is reported as OCCUPANCY, not
+            # decode activity: a paged slot mid-chunked-prefill is busy,
+            # and a block-starved parked admission is queued work — a
+            # replica saturated with prefills must not look idle.
+            "draining": self._draining,
             "compiled_programs": self.engine.compiled_programs(),
             "compile_events": resources["compile_events"],
             "prefill_buckets": list(self.engine.buckets),
-            "queue_depth": self.scheduler.depth,
+            "queue_depth": self.scheduler.depth + len(self._admit_backlog),
+            "slots": self.engine.n_slots,
+            "active_slots": self.engine.n_slots - self.engine.free_slots,
             "requests_finished": self._requests_finished,
             "worker_alive": self._thread is not None
             and self._worker_error is None,
@@ -428,6 +502,13 @@ class ServingEngine:
             "resources": resources,
             "last_errors": self.metrics.last_errors(),
         }
+        if self.paged:
+            page["kvpool"] = {
+                **self.engine.gauges(),
+                "block_size": self.engine.block_size,
+                "admit_backlog": len(self._admit_backlog),
+            }
+        return page
 
     def prometheus_metrics(self) -> str:
         """The ``GET /metrics`` body (Prometheus text exposition)."""
@@ -514,6 +595,12 @@ class ServingEngine:
                 entry = self._slot_entries.pop(slot)
                 self.engine.release(slot)
                 self._finish(entry, "error")
+            for slot in list(self._prefill_entries):
+                entry = self._prefill_entries.pop(slot)
+                self._finish(entry, "error")
+            for entry in self._admit_backlog:
+                self._finish(entry, "error")
+            self._admit_backlog = []
             # Every other registered request must unblock too — queued ones
             # AND ones popped for admission when the step raised: their
             # callers are parked on done.wait() and nothing else will run
@@ -528,21 +615,60 @@ class ServingEngine:
                 self._finish(entry, "error")
 
     def _step(self) -> bool:
-        """One engine-loop iteration: cancellations, admissions (prefill),
-        then a decode tick.  Returns whether any work happened."""
+        """One engine-loop iteration: cancellations, admissions, chunked
+        prefill under the per-tick token budget (paged), then a decode
+        tick.  Returns whether any work happened."""
         worked = False
 
-        # In-flight cancellations retire their slots before the next tick.
+        # In-flight cancellations retire their slots before the next tick
+        # — decoding slots, slots mid-chunked-prefill, and block-starved
+        # parked admissions alike.
         for slot, entry in list(self._slot_entries.items()):
             if entry.cancel_requested:
                 del self._slot_entries[slot]
                 self.engine.release(slot)
                 self._finish(entry, "cancelled")
                 worked = True
+        for slot, entry in list(self._prefill_entries.items()):
+            if entry.cancel_requested:
+                del self._prefill_entries[slot]
+                self.engine.release(slot)
+                self._finish(entry, "cancelled")
+                worked = True
+        if self._admit_backlog:
+            now = self._clock()
+            kept = []
+            for entry in self._admit_backlog:
+                deadline = entry.request.deadline_s
+                if entry.cancel_requested:
+                    self._finish(entry, "cancelled")
+                    worked = True
+                elif (
+                    deadline is not None
+                    and now >= entry.t_submit + deadline
+                ):
+                    # The deadline contract follows the request out of the
+                    # scheduler: a block-starved parked admission expires
+                    # exactly like a queued one would.
+                    self._finish(entry, "deadline")
+                    worked = True
+                else:
+                    kept.append(entry)
+            self._admit_backlog = kept
 
-        pop = self.scheduler.pop_ready(
-            self.engine.free_slots, engine_idle=self.engine.active_count == 0
+        # Admissions: block-starved parked entries retry FIRST, strictly
+        # FIFO — while any is parked, newer submissions stay queued so a
+        # big request cannot be starved by a stream of small ones.
+        while self._admit_backlog and self.engine.free_slots:
+            if not self._try_admit(self._admit_backlog[0]):
+                break
+            self._admit_backlog.pop(0)
+            worked = True
+        n_free = 0 if self._admit_backlog else self.engine.free_slots
+        engine_idle = (
+            self.engine.active_count == 0 and not self._prefill_entries
         )
+        pop = self.scheduler.pop_ready(n_free, engine_idle=engine_idle)
         for qe in pop.cancelled:
             self._finish(qe.item, "cancelled")
             worked = True
@@ -550,8 +676,14 @@ class ServingEngine:
             self._finish(qe.item, "deadline")
             worked = True
         for qe in pop.admit:
-            self._admit(qe.item)
+            # Strict FIFO past a block-starved admission: once one entry
+            # parks, everything popped behind it parks too — admitting it
+            # would consume the very blocks the parked request waits for.
+            if self._admit_backlog or not self._try_admit(qe.item):
+                self._admit_backlog.append(qe.item)
             worked = True
+
+        worked |= self._advance_prefills()
 
         if self.engine.active_count:
             t0 = self._clock()
@@ -561,9 +693,45 @@ class ServingEngine:
         self._maybe_emit_engine_record()
         return worked
 
-    def _admit(self, entry: _Entry) -> None:
+    def _try_admit(self, entry: _Entry) -> bool:
+        """Admit one popped entry into the engine.  Dense engine: one-shot
+        bucketed prefill, always succeeds (the scheduler never over-pops
+        slots).  Paged engine: reserve the slot + worst-case block chain
+        and queue the prompt's chunks; returns False when the pool is
+        block-starved so the caller parks the entry and retries as decode
+        retirements free blocks."""
         request = entry.request
         t0 = self._clock()
+        if self.paged:
+            from bpe_transformer_tpu.serving.kvpool.blocks import (
+                NoFreeBlocksError,
+            )
+
+            entry.programs_before = self.engine.compiled_programs()
+            try:
+                slot = self.engine.begin(
+                    request.prompt_ids,
+                    max_new_tokens=request.max_new_tokens,
+                    temperature=request.temperature,
+                    top_k=request.top_k,
+                    top_p=request.top_p,
+                    seed=request.seed,
+                    stop_id=request.stop_id,
+                )
+            except NoFreeBlocksError:
+                return False
+            entry.queue_wait_s = t0 - entry.t_submit
+            self._span(
+                "queue_wait", entry.t_submit, entry.queue_wait_s, request
+            )
+            entry.slot = slot
+            entry.bucket = self.engine.slot_bucket(slot)
+            entry.shared_tokens = self.engine.slot_shared_len(slot)
+            entry.t_prefill_start = t0
+            entry.prefill_s = 0.0
+            self._prefill_entries[slot] = entry
+            return True
+
         entry.queue_wait_s = t0 - entry.t_submit
         entry.bucket = self.engine.bucket_for(len(request.prompt_ids))
         programs_before = self.engine.compiled_programs()
@@ -590,6 +758,57 @@ class ServingEngine:
         )
         self._span("queue_wait", entry.t_submit, entry.queue_wait_s, request)
         self._span("prefill", t0, entry.prefill_s, request)
+        entry.tokens.append(event.token)
+        entry.stream.put(event.token)
+        if event.finished:
+            self._finish(entry, event.finished)
+        else:
+            self._slot_entries[event.slot] = entry
+        return True
+
+    def _advance_prefills(self) -> bool:
+        """Run pending prefill chunks (paged engine) under the per-tick
+        token budget, oldest admission first.  A completed prefill
+        delivers its first token and moves the slot to the decode set —
+        the paged twin of the dense admission's tail."""
+        if not self.paged or not self._prefill_entries:
+            return False
+        worked = False
+        budget = self._prefill_budget
+        budget.start_tick()
+        for slot in list(self.engine.pending_prefills()):
+            entry = self._prefill_entries.get(slot)
+            if entry is None:
+                continue
+            while True:
+                chunk_tokens = self.engine.next_chunk_tokens(slot)
+                if not budget.admits(chunk_tokens):
+                    return worked  # budget spent: decode tick runs next
+                t0 = self._clock()
+                event = self.engine.prefill_step(slot)
+                entry.prefill_s += self._clock() - t0
+                budget.spend(chunk_tokens)
+                worked = True
+                if event is not None:
+                    del self._prefill_entries[slot]
+                    self._complete_prefill(entry, event)
+                    break
+        return worked
+
+    def _complete_prefill(self, entry: _Entry, event: TickEvent) -> None:
+        request = entry.request
+        self.metrics.on_prefill(
+            entry.bucket,
+            # COMPUTED prompt tokens: the prefix-cache-shared prefix paid
+            # no compute, so it stays out of the throughput accounting.
+            len(request.prompt_ids) - entry.shared_tokens,
+            entry.prefill_s,
+            compiled=self.engine.compiled_programs() > entry.programs_before,
+        )
+        self._span(
+            "prefill", entry.t_prefill_start, entry.prefill_s, request
+        )
+        entry.t_decode_start = self._clock()
         entry.tokens.append(event.token)
         entry.stream.put(event.token)
         if event.finished:
@@ -713,6 +932,27 @@ class ServingEngine:
         self._telemetry.emit(
             sample_resources(t=round(now - self._t0, 6))
         )
+        if self.paged:
+            # Paged-pool accounting on the same cadence: block occupancy,
+            # prefix-cache effectiveness, chunked-prefill backlog — the
+            # numbers `report`'s kvpool section and the router's health
+            # weighting read.
+            gauges = self.engine.gauges()
+            self._telemetry.emit(
+                {
+                    "kind": "kvpool",
+                    "t": round(now - self._t0, 6),
+                    "blocks_total": gauges["kv_blocks_total"],
+                    "blocks_free": gauges["kv_blocks_free"],
+                    "blocks_shared": gauges["kv_blocks_shared"],
+                    "prefix_hits": gauges["prefix_cache_hits"],
+                    "prefix_misses": gauges["prefix_cache_misses"],
+                    "prefix_hit_rate": gauges["prefix_hit_rate"],
+                    "prefill_pending_tokens": gauges[
+                        "prefill_pending_tokens"
+                    ],
+                }
+            )
         self._last_record_t = now
         self._last_record_tokens = tokens
 

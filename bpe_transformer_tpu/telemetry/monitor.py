@@ -69,6 +69,14 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
                 if key in record:
                     state[key] = record[key]
             state["serve_tokens_per_sec"] = record.get("tokens_per_sec")
+        elif kind == "kvpool":
+            # Paged-KV pool snapshot (serving/kvpool/): block occupancy +
+            # prefix-cache effectiveness, the serve panel's memory view.
+            for key in ("blocks_total", "blocks_free", "blocks_shared",
+                        "prefix_hits", "prefix_misses", "prefix_hit_rate",
+                        "prefill_pending_tokens"):
+                if key in record:
+                    state[f"kv_{key}"] = record[key]
         elif kind == "resources":
             for key in ("host_rss_bytes", "live_buffer_bytes",
                         "hbm_bytes_in_use", "hbm_peak_bytes_in_use",
@@ -197,6 +205,13 @@ def fold_prometheus(samples: dict, prefix: str = "bpe_tpu") -> dict:
         "compile_time_s": get("compile_time_seconds_total"),
         "decode_tokens_per_sec": get("decode_tokens_per_sec"),
         "prefill_tps_by_bucket": prefill_tps or None,
+        # Paged-KV pool gauges (absent on dense replicas).
+        "kv_blocks_total": get("kv_blocks_total"),
+        "kv_blocks_free": get("kv_blocks_free"),
+        "kv_blocks_shared": get("kv_blocks_shared"),
+        "kv_prefix_hits": get("prefix_cache_hits_total"),
+        "kv_prefix_misses": get("prefix_cache_misses_total"),
+        "kv_prefill_pending_tokens": get("prefill_pending_tokens"),
         "host_rss_bytes": get("host_rss_bytes"),
         "live_buffer_bytes": get("live_buffer_bytes"),
         "hbm_bytes_in_use": get("hbm_bytes_in_use"),
@@ -291,6 +306,27 @@ def render_frame(state: dict, source: str) -> str:
                     )
                 )
             )
+
+    if state.get("kv_blocks_total") is not None:
+        free = state.get("kv_blocks_free")
+        total = state["kv_blocks_total"]
+        parts = [f"blocks {_num(free)}/{_num(total)} free"]
+        if state.get("kv_blocks_shared"):
+            parts.append(f"shared {_num(state['kv_blocks_shared'])}")
+        hits, misses = (
+            state.get("kv_prefix_hits"), state.get("kv_prefix_misses")
+        )
+        rate = state.get("kv_prefix_hit_rate")
+        if rate is None and hits is not None and misses is not None \
+                and hits + misses > 0:
+            rate = hits / (hits + misses)
+        if rate is not None:
+            parts.append(f"prefix hit {rate:.0%}")
+        if state.get("kv_prefill_pending_tokens"):
+            parts.append(
+                f"prefill backlog {_num(state['kv_prefill_pending_tokens'])}"
+            )
+        lines.append("  kv     " + "  ".join(parts))
 
     mem_parts = []
     if state.get("hbm_bytes_in_use") is not None:

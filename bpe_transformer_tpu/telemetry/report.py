@@ -277,6 +277,31 @@ def summarize(records: list[dict]) -> dict:
             "slow_dominant_phase": slow_dominant,
         }
 
+    # Paged-KV pool trajectory (kind="kvpool", serving/kvpool/): block
+    # occupancy, radix prefix-cache effectiveness, chunked-prefill
+    # backlog.  The hit rate is cumulative, so its LAST sample is the
+    # run's verdict.
+    kvpool_records = [r for r in records if r.get("kind") == "kvpool"]
+    kvpool_summary = None
+    if kvpool_records:
+        last = kvpool_records[-1]
+        kvpool_summary = {
+            "n": len(kvpool_records),
+            "blocks_total": last.get("blocks_total"),
+            "blocks_free": _stats(
+                [r.get("blocks_free") for r in kvpool_records]
+            ),
+            "blocks_shared": _stats(
+                [r.get("blocks_shared") for r in kvpool_records]
+            ),
+            "prefix_hits": last.get("prefix_hits"),
+            "prefix_misses": last.get("prefix_misses"),
+            "prefix_hit_rate": last.get("prefix_hit_rate"),
+            "prefill_pending_tokens": _stats(
+                [r.get("prefill_pending_tokens") for r in kvpool_records]
+            ),
+        }
+
     health_last = {}
     for record in steps:
         for key, value in record.items():
@@ -532,6 +557,7 @@ def summarize(records: list[dict]) -> dict:
             "mfu": _stats([r["mfu"] for r in steps if "mfu" in r]),
         },
         "serving": serving,
+        "kvpool": kvpool_summary,
         "resources": resource_summary,
         "attribution": attribution_summary,
         "dynamics": dynamics_summary,
@@ -666,6 +692,29 @@ def render_report(records: list[dict]) -> str:
                     if sv.get("slow_dominant_phase")
                     else ""
                 )
+            )
+
+    kv = s.get("kvpool")
+    if kv:
+        lines.append(f"== kv pool ({kv['n']} samples) ==")
+        bf = kv["blocks_free"] or {}
+        bsh = kv["blocks_shared"] or {}
+        lines.append(
+            f"  blocks {_fmt(kv['blocks_total'])}"
+            f"  free last {_fmt(bf.get('last'))} (min {_fmt(bf.get('min'))})"
+            f"  shared max {_fmt(bsh.get('max'))}"
+        )
+        rate = kv.get("prefix_hit_rate")
+        lines.append(
+            f"  prefix cache hits {_fmt(kv['prefix_hits'])}"
+            f"  misses {_fmt(kv['prefix_misses'])}"
+            + (f"  hit rate {rate:.1%}" if isinstance(rate, float) else "")
+        )
+        pending = kv.get("prefill_pending_tokens") or {}
+        if pending.get("max"):
+            lines.append(
+                f"  chunked-prefill backlog max {_fmt(pending.get('max'))} "
+                f"tokens (mean {_fmt(pending.get('mean'))})"
             )
 
     rs = s["resources"]
@@ -892,6 +941,14 @@ COMPARE_METRICS: dict = {
     "hbm_peak_bytes": (
         lambda s: (s["resources"] or {}).get("hbm_peak_bytes_in_use", {}).get("max")
         if s.get("resources") else None, "lower"),
+    # Paged-KV pool effectiveness (kind="kvpool"): a shared-prefix workload
+    # whose hit rate falls — or whose free-block floor sinks — regressed
+    # the radix cache or leaked blocks.
+    "prefix_hit_rate": (
+        lambda s: (s.get("kvpool") or {}).get("prefix_hit_rate"), "higher"),
+    "kv_blocks_free": (
+        lambda s: ((s.get("kvpool") or {}).get("blocks_free", {})
+                   or {}).get("min"), "higher"),
     # Per-chip state bytes (optimizer sharding's memory win): a run whose
     # opt_state_bytes shrinks 1/N against the unsharded baseline shows up
     # as an "improved" row; growing back is a gated regression.

@@ -80,6 +80,16 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
         "kind", "t", "step", "wall_step_s", "device_step_s",
         "compute_frac", "collective_frac", "host_gap_frac",
     },
+    # Paged-KV pool snapshot (serving/server.py, paged engines only),
+    # emitted on the engine-record cadence: block occupancy
+    # (``blocks_{total,free,shared}``), radix prefix-cache effectiveness
+    # (cumulative token ``prefix_{hits,misses}`` and the derived
+    # ``prefix_hit_rate``, null before any lookup), and the
+    # chunked-prefill backlog (optional ``prefill_pending_tokens``).
+    "kvpool": {
+        "kind", "t", "blocks_total", "blocks_free", "blocks_shared",
+        "prefix_hits", "prefix_misses",
+    },
     # Run trailer: record counts + clean verdict (spans.py Telemetry.footer).
     "footer": {"kind", "t", "record_counts"},
     # Step/val metrics (NO kind key): at least a step number plus one

@@ -38,10 +38,12 @@ TRACE_ASSUMPTIONS: dict[str, set[str]] = {
     "engine": {"kind", "t"},
     "resources": {"kind", "time_unix"},
     "attribution": {"kind", "t"},
+    "kvpool": {"kind", "t"},
 }
 
 #: Counter series pulled from each periodic record kind.
 _ENGINE_COUNTERS = ("active_slots", "queue_depth", "tokens_per_sec")
+_KVPOOL_COUNTERS = ("blocks_free", "blocks_shared", "prefill_pending_tokens")
 _ATTRIBUTION_COUNTERS = ("compute_frac", "collective_frac", "host_gap_frac")
 _RESOURCE_COUNTERS = (
     "host_rss_bytes",
@@ -176,6 +178,25 @@ def trace_events(records: list[dict]) -> list[dict]:
                         "ph": "C",
                         "pid": _PID,
                         "name": "engine",
+                        "ts": round(t * 1e6, 1),
+                        "args": series,
+                    }
+                )
+        elif kind == "kvpool":
+            t = record.get("t")
+            if not isinstance(t, (int, float)):
+                continue
+            series = {
+                k: record[k]
+                for k in _KVPOOL_COUNTERS
+                if isinstance(record.get(k), (int, float))
+            }
+            if series:
+                events.append(
+                    {
+                        "ph": "C",
+                        "pid": _PID,
+                        "name": "kvpool",
                         "ts": round(t * 1e6, 1),
                         "args": series,
                     }

@@ -329,6 +329,12 @@ def cmd_serve(args) -> int:
         default_max_new_tokens=args.max_new_tokens,
         telemetry=telemetry,
         manifest=manifest,
+        paged=args.paged,
+        block_size=args.block_size,
+        num_kv_blocks=args.num_kv_blocks,
+        prefill_chunk=args.prefill_chunk,
+        prefill_token_budget=args.prefill_budget,
+        prefix_cache=not args.no_prefix_cache,
     )
     try:
         with serving:
@@ -395,6 +401,93 @@ def cmd_serve(args) -> int:
             return 0
     finally:
         logger.close()
+
+
+def cmd_route(args) -> int:
+    # Jax-free fleet front (serving/router.py): health-aware balancing
+    # over N serve replicas off their /statusz surface — runs on a box
+    # with no accelerator runtime.
+    from bpe_transformer_tpu.serving.router import main as route_main
+
+    forwarded = []
+    for replica in args.replica:
+        forwarded += ["--replica", replica]
+    forwarded += [
+        "--host", args.host,
+        "--port", str(args.port),
+        "--poll-interval", str(args.poll_interval),
+        "--request-timeout", str(args.request_timeout),
+        "--connect-timeout", str(args.connect_timeout),
+    ]
+    return route_main(forwarded)
+
+
+def cmd_warmup(args) -> int:
+    """AOT-compile the serving program ladder into the persistent compile
+    cache, so a router-triggered replica restart (or first boot on a fresh
+    host sharing the cache dir) reaches traffic without paying the
+    20-40 s/program cold compiles — ROADMAP item 5's rolling-deploy
+    story, stub-sized: warm the exact programs ``bpe-tpu serve`` with the
+    same config/engine knobs will request."""
+    import jax
+
+    from bpe_transformer_tpu.telemetry.resources import (
+        compile_cache_hits,
+        install_compile_counter,
+    )
+    from bpe_transformer_tpu.utils.compile_cache import enable_compile_cache
+
+    install_compile_counter()
+    enable_compile_cache(args.compile_cache)
+
+    if args.checkpoint:
+        payload, model_config, _ = _load_inference_state(
+            args, need_tokenizer=False
+        )
+        params = payload["params"]
+    else:
+        # The cache key is the lowered program (shapes/config), not the
+        # weights: random init warms the same entries a checkpoint would.
+        from bpe_transformer_tpu.models import init_params
+
+        model_config = _load_model_config(args)
+        params = init_params(jax.random.PRNGKey(0), model_config)
+
+    if args.paged:
+        from bpe_transformer_tpu.serving import PagedEngine
+
+        # prefix_cache OFF: warmup's point is compiling every ladder rung,
+        # and its repeated dummy prompts would otherwise share a prefix and
+        # shrink later rungs' chunks into already-compiled programs.
+        engine = PagedEngine(
+            params, model_config, slots=args.slots,
+            block_size=args.block_size, num_blocks=args.num_kv_blocks,
+            prefill_chunk=args.prefill_chunk, prefix_cache=False,
+        )
+    else:
+        from bpe_transformer_tpu.serving import SlotPoolEngine
+
+        engine = SlotPoolEngine(params, model_config, slots=args.slots)
+
+    ctx = model_config.context_length
+    for bucket in engine.buckets:
+        plen = min(bucket, ctx - 2)
+        event = engine.admit(
+            [1] * plen, max_new_tokens=2, temperature=0.0
+        )
+        while not event.finished:
+            events = engine.tick()
+            event = next(e for e in events if e.slot == event.slot)
+
+    summary = {
+        "programs_compiled": engine.compiled_programs(),
+        "buckets": list(engine.buckets),
+        "engine": "paged" if args.paged else "dense",
+        "cache_dir": str(args.compile_cache),
+        "cache_hits": compile_cache_hits(),
+    }
+    print(json.dumps(summary))
+    return 0
 
 
 def cmd_profile(args) -> int:
@@ -899,10 +992,80 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="enable JAX's persistent compilation cache rooted "
                    "at DIR: restarted replicas load the prefill-bucket/"
-                   "decode programs from disk instead of recompiling")
+                   "decode programs from disk instead of recompiling "
+                   "(pre-warm with bpe-tpu warmup)")
+    p.add_argument("--paged", action="store_true",
+                   help="paged KV memory: block-pool cache with radix "
+                   "prefix sharing (shared system prompts prefill once) "
+                   "and chunked prefill (serving/kvpool/)")
+    p.add_argument("--block-size", type=int, default=16,
+                   help="KV block size in tokens (with --paged; must "
+                   "divide the context length)")
+    p.add_argument("--num-kv-blocks", type=int, default=None,
+                   help="KV pool capacity in blocks (with --paged; "
+                   "default: dense-equivalent slots x context / block)")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   metavar="TOKENS",
+                   help="chunked prefill: split long prompts into chunks "
+                   "of this many tokens, interleaved with decode ticks "
+                   "(with --paged; default: whole-prompt prefill)")
+    p.add_argument("--prefill-budget", type=int, default=None,
+                   metavar="TOKENS",
+                   help="max prefill tokens between consecutive decode "
+                   "ticks (with --paged + --prefill-chunk): bounds decode "
+                   "p99 under heavy prefill traffic")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable the radix prefix cache (with --paged)")
     p.add_argument("--special-token", action="append", default=None,
                    help='repeatable; default: ["<|endoftext|>"]')
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "route",
+        help="health-aware HTTP router over N serve replicas: weighted "
+        "balancing off each replica's /statusz (queue depth, free slots, "
+        "free KV blocks), drain/death failover with request replay; "
+        "jax-free — runs on a front-end box with no accelerator",
+    )
+    p.add_argument("--replica", action="append", required=True,
+                   metavar="HOST:PORT",
+                   help="replica base URL (repeatable)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8100,
+                   help="router HTTP port (0: ephemeral)")
+    p.add_argument("--poll-interval", type=float, default=1.0,
+                   help="seconds between replica health polls")
+    p.add_argument("--request-timeout", type=float, default=600.0,
+                   help="seconds to wait for a replica's response (a "
+                   "timeout is NOT replayed — the generation is still "
+                   "running on that replica)")
+    p.add_argument("--connect-timeout", type=float, default=5.0,
+                   help="seconds to wait for a replica's TCP connect "
+                   "before failing over")
+    p.set_defaults(fn=cmd_route)
+
+    p = sub.add_parser(
+        "warmup",
+        help="AOT-compile the serving program ladder (prefill buckets + "
+        "decode tick) into a persistent compile cache, so replica "
+        "restarts reach traffic without cold XLA compiles",
+    )
+    p.add_argument("--compile-cache", required=True, metavar="DIR",
+                   help="persistent compilation cache directory (shared "
+                   "with bpe-tpu serve --compile-cache)")
+    p.add_argument("--checkpoint", default=None,
+                   help="warm with a real checkpoint's config (default: "
+                   "--preset with random init — same programs)")
+    p.add_argument("--preset", default=None, choices=sorted(PRESETS))
+    p.add_argument("--model-config", default=None, help="JSON config path")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--paged", action="store_true",
+                   help="warm the paged engine's chunk/tick programs "
+                   "instead of the dense ladder")
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-kv-blocks", type=int, default=None)
+    p.add_argument("--prefill-chunk", type=int, default=None)
+    p.set_defaults(fn=cmd_warmup, default_preset="tinystories-4l")
 
     p = sub.add_parser(
         "profile",
@@ -1002,8 +1165,9 @@ def main(argv: list[str] | None = None) -> int:
         # Host-side tools that must never initialize a backend — and the
         # supervisor parent, which must not grab the accelerator its child
         # needs; the child re-enters main() without --supervise and applies
-        # the config itself.
-        command in ("report", "monitor", "verify-checkpoint")
+        # the config itself.  The fleet router is jax-free too: it fronts
+        # replicas from a box with no accelerator runtime.
+        command in ("report", "monitor", "verify-checkpoint", "route")
         or "--supervise" in raw_argv
     )
     if platforms and not jax_free:

@@ -548,6 +548,11 @@ def test_stats_and_statusz_offline_surface(setup):
 
     assert page["manifest"] is manifest
     assert page["uptime_s"] > 0
+    # Router-facing health fields (serving/router.py reads these).
+    assert page["engine_kind"] == "dense" and stats["engine_kind"] == "dense"
+    assert page["draining"] is False
+    assert page["slots"] == 1 and page["active_slots"] == 0
+    assert "kvpool" not in page  # dense engines carry no kv gauges
     assert page["compiled_programs"] >= 1
     assert isinstance(page["compile_events"], int)
     assert page["compile_events"] >= page["compiled_programs"]
