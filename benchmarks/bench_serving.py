@@ -18,9 +18,18 @@ Two modes, one JSON row per cell:
 
 ``--paged`` switches the engine to the block-pool KV cache
 (`serving/kvpool/`): radix prefix sharing + chunked prefill
-(``--prefill-chunk``/``--prefill-budget``).  Warmup (compilation of the
-bucket ladder + tick) happens before timing in both modes, so cells
-measure steady-state serving, not XLA.
+(``--prefill-chunk``/``--prefill-budget``); ``--decode-attention paged``
+runs the block-pool-NATIVE flash-decode kernel (no per-tick gather
+transient) and ``--kv-dtype int8`` the quantized pool — rows carry
+``kv_pool_bytes``/``kv_bytes_per_token`` so the memory-traffic claims
+are machine-checkable.  Warmup (compilation of the configured ladder +
+tick) happens before timing in both modes, so cells measure steady-state
+serving, not XLA.
+
+A third mode, ``--restart``, times restart-to-traffic (ROADMAP item 5):
+a serve replica from process spawn to first token THROUGH the router's
+rejoin path, cold versus ``bpe-tpu warmup``-warmed compile cache — one
+JSON row with ``cold_s``/``warm_s``/``warmup_s``.
 
 Run on a TPU host:  python benchmarks/bench_serving.py [--qps 8 --paged]
 Prints one JSON line per cell.
@@ -61,6 +70,7 @@ def _make_engine(params, config, *, concurrency, n_requests, args):
         paged=args.paged, block_size=args.block_size,
         prefill_chunk=args.prefill_chunk,
         prefill_token_budget=args.prefill_budget,
+        kv_dtype=None if args.kv_dtype == "act" else args.kv_dtype,
     )
 
 
@@ -130,6 +140,11 @@ def _paged_row_fields(serving, baseline):
         "prefix_hits": hits,
         "prefix_hit_rate": rate,
         "kv_blocks_free_end": stats.get("kv_blocks_free"),
+        # KV-memory economics (ISSUE 9): the int8 win and the paged-native
+        # kernel's traffic cut are judged against these row fields.
+        "kv_dtype": stats.get("kv_dtype"),
+        "kv_pool_bytes": stats.get("kv_pool_bytes"),
+        "kv_bytes_per_token": stats.get("kv_bytes_per_token"),
         "decode_p95_s": stats["phase_p95_s"]["decode"],
     }
 
@@ -246,6 +261,181 @@ def run_open_loop(params, config, *, concurrency, n_requests, new_tokens,
     }
 
 
+def _serve_flags(args) -> list:
+    """The engine knobs forwarded to a `bpe-tpu serve` / `bpe-tpu warmup`
+    subprocess (restart bench), mirroring what _make_engine builds
+    in-process."""
+    flags = []
+    if args.paged:
+        flags += ["--paged", "--block-size", str(args.block_size)]
+        if args.prefill_chunk:
+            flags += ["--prefill-chunk", str(args.prefill_chunk)]
+        if args.kv_dtype != "act":
+            flags += ["--kv-dtype", args.kv_dtype]
+    if args.decode_attention:
+        flags += ["--decode-attention", args.decode_attention]
+    return flags
+
+
+def run_restart(args) -> dict:
+    """Restart-to-traffic (ROADMAP item 5): time a replica from SPAWN to
+    first token served THROUGH the router's rejoin path, cold (empty
+    compile cache) vs `bpe-tpu warmup`-warmed — the rolling-deploy number
+    a fleet operator actually waits on.  The parent stays on CPU (jax
+    init would hold the accelerator the child serve needs); the router is
+    the in-process jax-free `serving.router.Router` driven by hand."""
+    import dataclasses
+    import os
+    import pickle
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    child_jax_platforms = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"  # parent: params init only
+
+    import jax as _jax
+
+    import bpe_transformer_tpu.models as models
+    from bpe_transformer_tpu.checkpointing import save_checkpoint
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.serving.router import Router
+
+    config = getattr(models, CONFIGS[args.config])
+    workdir = Path(tempfile.mkdtemp(prefix="bpe_restart_"))
+    procs: list = []
+    try:
+        ckpt = workdir / "model.ckpt"
+        save_checkpoint(
+            ckpt,
+            params=init_params(_jax.random.PRNGKey(0), config),
+            extra={"model_config": dataclasses.asdict(config)},
+        )
+        tok_dir = workdir / "tok"
+        tok_dir.mkdir()
+        with open(tok_dir / "vocab.pkl", "wb") as f:
+            pickle.dump({i: bytes([i]) for i in range(256)}, f)
+        with open(tok_dir / "merges.pkl", "wb") as f:
+            pickle.dump([], f)
+        cache_dir = workdir / "xla_cache"
+
+        import socket
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+
+        child_env = dict(os.environ)
+        if child_jax_platforms is None:
+            child_env.pop("JAX_PLATFORMS", None)
+        else:
+            child_env["JAX_PLATFORMS"] = child_jax_platforms
+        child_env["PYTHONPATH"] = str(Path(__file__).resolve().parent.parent)
+
+        base_cmd = [
+            sys.executable, "-m", "bpe_transformer_tpu.training.cli",
+            "serve",
+            "--checkpoint", str(ckpt),
+            "--tokenizer-dir", str(tok_dir),
+            "--port", str(port),
+            "--slots", "2",
+            "--max-new-tokens", "4",
+        ] + _serve_flags(args)
+
+        def spawn(extra):
+            proc = subprocess.Popen(
+                base_cmd + extra, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL, env=child_env,
+            )
+            procs.append(proc)
+            return proc
+
+        def time_to_first_token(extra, timeout_s=900.0):
+            """Spawn the replica and drive the router by hand until a
+            generate lands: the router marks the (absent) replica down,
+            sees it rejoin via /statusz polls, and the first 200 is
+            first-token time — exactly a rolling restart's window."""
+            router = Router(
+                [f"http://127.0.0.1:{port}"],
+                poll_timeout_s=2.0, connect_timeout_s=2.0,
+                request_timeout_s=600.0,
+            )
+            body = json.dumps(
+                {"prompt_ids": [5, 6, 7, 8, 9, 10, 11],
+                 "max_new_tokens": 4, "temperature": 0.0}
+            ).encode()
+            t0 = time.perf_counter()
+            proc = spawn(extra)
+            deadline = t0 + timeout_s
+            while time.perf_counter() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica exited rc={proc.returncode} before "
+                        "serving"
+                    )
+                router.poll_once()
+                if any(r.available for r in router.replicas):
+                    code, _payload = router.handle_generate(body)
+                    if code == 200:
+                        return time.perf_counter() - t0, proc
+                time.sleep(0.2)
+            raise RuntimeError(f"no first token within {timeout_s}s")
+
+        def stop(proc):
+            proc.send_signal(signal.SIGTERM)  # serve drains gracefully
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+
+        cold_s, proc = time_to_first_token([])
+        stop(proc)
+
+        t0 = time.perf_counter()
+        warm_proc = subprocess.run(
+            [
+                sys.executable, "-m", "bpe_transformer_tpu.training.cli",
+                "warmup",
+                "--compile-cache", str(cache_dir),
+                "--checkpoint", str(ckpt),
+                "--slots", "2",
+            ] + _serve_flags(args)
+            + (["--kv-dtype", args.kv_dtype] if args.paged
+               and args.kv_dtype == "act" else []),
+            capture_output=True, text=True, env=child_env, timeout=1200,
+        )
+        warmup_s = time.perf_counter() - t0
+        if warm_proc.returncode != 0:
+            raise RuntimeError(f"warmup failed: {warm_proc.stderr[-500:]}")
+        warm_summary = json.loads(
+            warm_proc.stdout.strip().splitlines()[-1]
+        )
+
+        warm_s, proc = time_to_first_token(
+            ["--compile-cache", str(cache_dir)]
+        )
+        stop(proc)
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    return {
+        "cold_s": round(cold_s, 3),
+        "warm_s": round(warm_s, 3),
+        "warmup_s": round(warmup_s, 3),
+        "speedup": round(cold_s / warm_s, 3) if warm_s else None,
+        "programs_warmed": warm_summary.get("programs_compiled"),
+        "engine": "paged" if args.paged else "dense",
+        "decode_attention": args.decode_attention or "xla",
+        "kv_dtype": args.kv_dtype if args.paged else None,
+    }
+
+
 def main() -> int:
     require_accelerator(Path(__file__).stem)
     parser = argparse.ArgumentParser()
@@ -270,7 +460,40 @@ def main() -> int:
     parser.add_argument("--shared-prefix-frac", type=float, default=0.5,
                         help="fraction of requests carrying the shared "
                         "prefix (with --shared-prefix-len)")
+    parser.add_argument("--kv-dtype", choices=("act", "int8"),
+                        default="act",
+                        help="paged KV pool storage width (int8: "
+                        "quantized blocks + per-block-per-head scales)")
+    parser.add_argument("--decode-attention",
+                        choices=("xla", "pallas", "paged"), default=None,
+                        help="decode-step attention impl ('paged': the "
+                        "block-pool-native flash kernel, no gather "
+                        "transient; needs --paged)")
+    parser.add_argument("--restart", action="store_true",
+                        help="restart-to-traffic mode: time a replica "
+                        "from spawn to first token through the router "
+                        "rejoin path, cold vs bpe-tpu-warmup-warmed "
+                        "(one row; ignores --concurrency/--qps)")
     args = parser.parse_args()
+
+    if args.decode_attention == "paged" and not args.paged:
+        print("--decode-attention paged needs --paged", file=sys.stderr)
+        return 2
+    if args.kv_dtype == "int8" and not args.paged:
+        print("--kv-dtype int8 needs --paged", file=sys.stderr)
+        return 2
+
+    if args.restart:
+        cell = run_restart(args)
+        print(json.dumps(
+            {
+                "metric": f"restart_to_traffic ({args.config}, "
+                f"{cell['engine']}, attn={cell['decode_attention']})",
+                **cell,
+                "platform": "subprocess",
+            }
+        ), flush=True)
+        return 0
 
     import dataclasses
 
@@ -281,7 +504,7 @@ def main() -> int:
     config = dataclasses.replace(
         getattr(models, CONFIGS[args.config]),
         attention_impl="xla",
-        decode_attention_impl="xla",
+        decode_attention_impl=args.decode_attention or "xla",
     )
     params = init_params(jax.random.PRNGKey(0), config)
     levels = args.concurrency or ([1, 4, 8] if on_accel else [1, 2])
@@ -316,6 +539,10 @@ def main() -> int:
             continue
         measured_any = True
         engine = "paged" if args.paged else "dense"
+        if args.paged and args.kv_dtype != "act":
+            engine += f"-{args.kv_dtype}"
+        if args.decode_attention:
+            engine += f"-{args.decode_attention}"
         print(
             json.dumps(
                 {
@@ -324,6 +551,7 @@ def main() -> int:
                     f"new={new_tokens}, {engine}, {mode}, "
                     f"{config.activation_dtype})",
                     **cell,
+                    "decode_attention": args.decode_attention or "xla",
                     "device": str(jax.devices()[0]),
                     "platform": jax.devices()[0].platform,
                 }

@@ -295,6 +295,32 @@ run_job serve_open_paged 900 "$CAP/serving_paged.jsonl" \
   --concurrency 8 --requests 64 --qps 8 --shared-prefix-len 64 \
   --paged --block-size 16 --prefill-chunk 64 --prefill-budget 128
 
+# Paged-NATIVE flash decode + int8 KV (ISSUE 9): the same arrival process
+# served (a) through the block-table-native kernel — the per-tick
+# contiguous KV gather is gone from the tick — and (b) additionally with
+# int8 KV blocks (block 32: int8 sublane alignment).  Rows carry
+# kv_pool_bytes / kv_bytes_per_token, so the memory-traffic claims land
+# machine-checked next to the gather-path paged row above.
+run_job serve_open_pnative 900 "$CAP/serving_paged.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --concurrency 8 --requests 64 --qps 8 --shared-prefix-len 64 \
+  --paged --block-size 16 --prefill-chunk 64 --prefill-budget 128 \
+  --decode-attention paged
+run_job serve_open_pnative_i8 900 "$CAP/serving_paged.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --concurrency 8 --requests 64 --qps 8 --shared-prefix-len 64 \
+  --paged --block-size 32 --prefill-chunk 64 --prefill-budget 128 \
+  --decode-attention paged --kv-dtype int8
+
+# Restart-to-traffic (ROADMAP item 5): one row timing a serve replica
+# from SPAWN to first token through the router's rejoin path, cold vs
+# `bpe-tpu warmup`-warmed compile cache — the rolling-deploy window.
+# The bench parent pins itself to CPU; the spawned replicas own the chip
+# sequentially.
+run_job restart_traffic 1800 "$CAP/restart.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l --restart \
+  --paged --block-size 16 --decode-attention paged
+
 # Dynamics-introspection overhead (PR 4): the headline config with the
 # in-graph telemetry.dynamics stats compiled into the step (per-layer
 # norms, update ratios, activation taps), captured to its own file
@@ -508,6 +534,95 @@ print("  ".join(parts))
 PY
 )
   [ -n "$PAGED_LINE" ] && log "paged serving self-report: $PAGED_LINE"
+fi
+# Paged-native / int8 self-report (jax-free, CPU-only): newest row per
+# (decode_attention, kv_dtype) variant — tok/s, p99, and the KV-memory
+# fields next to the gather-path paged row, i.e. "did deleting the
+# gather and halving the KV width pay, and what did it cost in bytes".
+if [ -s "$CAP/serving_paged.jsonl" ]; then
+  NATIVE_LINE=$(env JAX_PLATFORMS=cpu python - "$CAP/serving_paged.jsonl" <<'PY'
+import json, sys
+
+rows = {}
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if not ln:
+        continue
+    try:
+        r = json.loads(ln)
+    except json.JSONDecodeError:
+        continue
+    if "qps_target" in r and r.get("engine") == "paged":
+        key = (r.get("decode_attention", "xla"), r.get("kv_dtype"))
+        rows[key] = r  # newest row per variant wins
+native = rows.get(("paged", "float32")) or rows.get(("paged", "bfloat16"))
+int8 = next((r for (attn, kvd), r in rows.items()
+             if attn == "paged" and kvd == "int8"), None)
+gather = next((r for (attn, kvd), r in rows.items()
+               if attn != "paged"), None)
+if native is None and int8 is None:
+    sys.exit(0)
+
+
+def num(v, d=4):
+    return f"{v:,.{d}g}" if isinstance(v, (int, float)) else "n/a"
+
+
+parts = []
+if native is not None:
+    parts.append(
+        f"native tok/s {num(native.get('gen_tok_per_s'))} "
+        f"p99 {num(native.get('latency_p99_s'))}s"
+        + (f" (gather tok/s {num(gather.get('gen_tok_per_s'))} "
+           f"p99 {num(gather.get('latency_p99_s'))}s)" if gather else "")
+    )
+if int8 is not None:
+    parts.append(
+        f"int8 tok/s {num(int8.get('gen_tok_per_s'))} "
+        f"kv/token {num(int8.get('kv_bytes_per_token'))}B "
+        f"pool {num(int8.get('kv_pool_bytes'))}B"
+        + (f" (fp kv/token {num(native.get('kv_bytes_per_token'))}B)"
+           if native else "")
+    )
+print("  ".join(parts))
+PY
+)
+  [ -n "$NATIVE_LINE" ] && log "paged-native/int8 self-report: $NATIVE_LINE"
+fi
+# Restart-to-traffic self-report (jax-free, CPU-only): the newest restart
+# row's cold vs warmed spawn->first-token seconds — ROADMAP item 5's
+# rolling-deploy number.
+if [ -s "$CAP/restart.jsonl" ]; then
+  RESTART_LINE=$(env JAX_PLATFORMS=cpu python - "$CAP/restart.jsonl" <<'PY'
+import json, sys
+
+row = None
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if not ln:
+        continue
+    try:
+        r = json.loads(ln)
+    except json.JSONDecodeError:
+        continue
+    if str(r.get("metric", "")).startswith("restart_to_traffic"):
+        row = r  # newest row wins
+if row is None:
+    sys.exit(0)
+
+
+def num(v):
+    return f"{v:,.3g}" if isinstance(v, (int, float)) else "n/a"
+
+
+print(
+    f"cold {num(row.get('cold_s'))}s -> warmed {num(row.get('warm_s'))}s "
+    f"(speedup {num(row.get('speedup'))}x, warmup cost "
+    f"{num(row.get('warmup_s'))}s, {row.get('programs_warmed')} programs)"
+)
+PY
+)
+  [ -n "$RESTART_LINE" ] && log "restart-to-traffic self-report: $RESTART_LINE"
 fi
 log "queue pass complete"
 # Same size guard as the restore: never shrink the mirrored history.

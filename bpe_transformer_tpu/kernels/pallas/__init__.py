@@ -1,6 +1,9 @@
 """Pallas (Mosaic) TPU kernels for the hot ops."""
 
-from bpe_transformer_tpu.kernels.pallas.decode_attention import decode_attention
+from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+    decode_attention,
+    paged_decode_attention,
+)
 from bpe_transformer_tpu.kernels.pallas.flash_attention import (
     flash_attention,
     flash_attention_with_rope,
@@ -9,6 +12,7 @@ from bpe_transformer_tpu.kernels.pallas.gelu import gelu, gelu_reference
 
 __all__ = [
     "decode_attention",
+    "paged_decode_attention",
     "flash_attention",
     "flash_attention_with_rope",
     "gelu",

@@ -219,6 +219,207 @@ def decode_attention(
     return out[:, :group, :].reshape(batch, num_heads, d)
 
 
+def _paged_decode_kernel(
+    tables_ref, pos_ref, q_ref, k_ref, v_ref, *refs,
+    scale: float, block_size: int, num_blocks_per_slot: int, kv_heads: int,
+    quantized: bool,
+):
+    """Block-table flash decode: grid axis 1 walks a slot's KV BLOCKS (the
+    block table was already consumed by the BlockSpec index maps, so
+    ``k_ref``/``v_ref`` hold one pool block each) with the same online
+    softmax as :func:`_decode_kernel`."""
+    if quantized:
+        kscale_ref, vscale_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, acc_ref, m_ref, l_ref = refs
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    pos = pos_ref[pl.program_id(0) // kv_heads]
+    # This program's kv head (hoisted: program_id is a top-level-only
+    # primitive under the interpreter) — used to select the dequant scale.
+    head = pl.program_id(0) % kv_heads
+
+    @pl.when(j * block_size <= pos)
+    def _block():
+        q = q_ref[0].astype(jnp.float32) * scale  # (G_pad, d)
+        k = k_ref[0, 0].astype(jnp.float32)       # (block_size, d)
+        v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            # Per-block-per-head dequant IN REGISTERS: the scale row is
+            # (1, kv_heads) f32; this program's head is selected by lane
+            # mask (dynamic lane indexing is not a TPU vector primitive).
+            lane = jax.lax.broadcasted_iota(jnp.int32, (1, kv_heads), 1)
+            k = k * jnp.sum(jnp.where(lane == head, kscale_ref[...], 0.0))
+            v = v * jnp.sum(jnp.where(lane == head, vscale_ref[...], 0.0))
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (G_pad, block_size)
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_size
+        s = jnp.where(cols <= pos, s, NEG_INF)
+
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == num_blocks_per_slot - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    tables: jax.Array,
+    pos: jax.Array,
+    *,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Paged-NATIVE flash decode: one decode step of attention read straight
+    out of the KV block pool — no contiguous per-slot gather ever exists.
+
+    The serving block pool (`models/decode.init_kv_pool`) stores KV as
+    ``(num_blocks, kv_heads, block_size, d_head)``; each slot's cache is a
+    chain of block ids in ``tables`` ``(slots, blocks_per_slot)``.  Where
+    `gather_paged_kv` materializes a ``(slots, blocks_per_slot*block_size)``
+    transient per layer per tick before any kernel runs, here the grid is
+    ``(slots*kv_heads, blocks_per_slot)`` and the BLOCK TABLE IS CONSUMED
+    INSIDE THE K/V BlockSpec INDEX MAPS: ``tables``/``pos`` ride scalar
+    prefetch (SMEM), so grid step ``(b, j)`` DMAs pool block
+    ``tables[slot, min(j, pos[slot] // block_size)]`` directly into VMEM.
+    HBM traffic per tick drops to one streaming read of the LIVE blocks —
+    the gather's extra write+read round trip of the whole transient is
+    gone, and (as in :func:`decode_attention`) blocks beyond the causal
+    frontier clamp to the frontier block so their DMAs are elided.
+
+    ``k_scale``/``v_scale`` ``(num_blocks, kv_heads)`` f32 must be given
+    exactly when the pool is int8-quantized (per-block-per-head scales, the
+    serving pool's ``kv_dtype="int8"`` layout); the kernel dequantizes each
+    block in registers, so the HBM side of the stream stays 1 byte/value.
+    TPU note: int8 tiles want ``block_size`` >= 32 (sublane alignment at 8
+    bits); the interpreter path (CPU tests) has no such constraint.
+
+    ``pos`` is the per-slot causal frontier ``(slots,)`` (scalar broadcast
+    accepted).  Returns ``(slots, num_heads, d_head)`` like
+    :func:`decode_attention`.
+    """
+    if interpret is None:
+        from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
+
+        interpret = interpret_mode()
+    slots, num_heads, d = q.shape
+    num_blocks, kv_heads, block_size, d2 = k_pool.shape
+    if d2 != d or v_pool.shape != k_pool.shape:
+        raise ValueError(
+            f"shape mismatch: q {q.shape}, k_pool {k_pool.shape}, "
+            f"v_pool {v_pool.shape}"
+        )
+    if tables.ndim != 2 or tables.shape[0] != slots:
+        raise ValueError(
+            f"tables {tables.shape} must be (slots={slots}, blocks_per_slot)"
+        )
+    if num_heads % kv_heads:
+        raise ValueError(
+            f"num_heads={num_heads} not divisible by kv_heads={kv_heads}"
+        )
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None) or (
+        quantized != (k_pool.dtype == jnp.int8)
+    ):
+        raise ValueError(
+            "k_scale/v_scale must both be given exactly for int8 pools"
+        )
+    if quantized and k_scale.shape != (num_blocks, kv_heads):
+        raise ValueError(
+            f"k_scale {k_scale.shape} must be (num_blocks={num_blocks}, "
+            f"kv_heads={kv_heads})"
+        )
+    group = num_heads // kv_heads
+    g_pad = pl.cdiv(group, SUBLANES) * SUBLANES
+    nbs = tables.shape[1]
+    skv = slots * kv_heads
+
+    qg = q.reshape(slots, kv_heads, group, d).reshape(skv, group, d)
+    qg = jnp.pad(qg, ((0, 0), (0, g_pad - group), (0, 0)))
+    pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (slots,))
+    tables = jnp.asarray(tables, jnp.int32)
+
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        scale=1.0 / (d**0.5),
+        block_size=block_size,
+        num_blocks_per_slot=nbs,
+        kv_heads=kv_heads,
+        quantized=quantized,
+    )
+    # Index maps receive the scalar-prefetch refs as trailing args: the
+    # block-table lookup happens HERE, steering each grid step's DMA to
+    # its pool block.  Steps beyond the frontier clamp to the frontier
+    # block (same id -> the pipeline elides the refetch) and are
+    # compute-predicated off in the kernel, exactly like the dense kernel.
+    qspec = pl.BlockSpec(
+        (1, g_pad, d), lambda b, j, t, p: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+
+    def kv_index(b, j, t, p):
+        s = b // kv_heads
+        return (t[s, jnp.minimum(j, p[s] // block_size)], b % kv_heads, 0, 0)
+
+    kvspec = pl.BlockSpec(
+        (1, 1, block_size, d), kv_index, memory_space=pltpu.VMEM
+    )
+    in_specs = [qspec, kvspec, kvspec]
+    inputs = [qg, k_pool, v_pool]
+    if quantized:
+
+        def scale_index(b, j, t, p):
+            s = b // kv_heads
+            return (t[s, jnp.minimum(j, p[s] // block_size)], 0)
+
+        sspec = pl.BlockSpec(
+            (1, kv_heads), scale_index, memory_space=pltpu.VMEM
+        )
+        in_specs += [sspec, sspec]
+        inputs += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(skv, nbs),
+        in_specs=in_specs,
+        out_specs=qspec,
+        scratch_shapes=[
+            pltpu.VMEM((g_pad, d), jnp.float32),      # output accumulator
+            pltpu.VMEM((g_pad, LANES), jnp.float32),  # running row max
+            pltpu.VMEM((g_pad, LANES), jnp.float32),  # running denominator
+        ],
+    )
+    out_dtype = q.dtype
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((skv, g_pad, d), out_dtype),
+        interpret=interpret,
+    )(tables, pos_arr, *inputs)
+    return out[:, :group, :].reshape(slots, num_heads, d)
+
+
 def xla_decode_attention(q, k_cache, v_cache, pos):
     """Materialized-scores formulation: the grouped einsum straight against
     the compact GQA cache (the per-token hot path reads only

@@ -59,8 +59,13 @@ class ModelConfig:
     ffn_impl: str = "xla"
     #: Decode-step attention against the KV cache: "xla" (grouped einsum,
     #: materialized scores) | "pallas" (flash-decoding streamed reduction,
-    #: kernels/pallas/decode_attention.py).  Inference-only knob — the
-    #: training attention path is attention_impl.
+    #: kernels/pallas/decode_attention.py) | "paged" (paged-NATIVE flash
+    #: decode: the block table is consumed inside the kernel's index maps,
+    #: so the serving tick reads K/V straight out of the block pool with no
+    #: contiguous gather transient; only meaningful with the paged serving
+    #: engine — the dense cache has no block table, so dense decode treats
+    #: it as "pallas").  Inference-only knob — the training attention path
+    #: is attention_impl.
     decode_attention_impl: str = "xla"
     flash_block_size: int = 256  # q/k tile size for the flash kernel
     #: attention_impl="flash_fused" auto-falls-back to the plain flash
@@ -105,10 +110,10 @@ class ModelConfig:
             raise ValueError(
                 f'moe_dispatch={self.moe_dispatch!r} must be "einsum" or "gather"'
             )
-        if self.decode_attention_impl not in ("xla", "pallas"):
+        if self.decode_attention_impl not in ("xla", "pallas", "paged"):
             raise ValueError(
                 f"decode_attention_impl={self.decode_attention_impl!r} "
-                'must be "xla" or "pallas"'
+                'must be "xla", "pallas" or "paged"'
             )
         if self.ffn_type == "moe" and not (
             1 <= self.router_top_k <= self.n_experts

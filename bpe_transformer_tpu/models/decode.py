@@ -259,8 +259,10 @@ def decode_step(
             new_cache.append({"k": k_cache, "v": v_cache})
             # Both impls read the COMPACT GQA cache — the per-token hot path
             # reads only num_kv_heads * ctx bytes; expanding heads here
-            # would forfeit GQA's decode-bandwidth win.
-            if config.decode_attention_impl == "pallas":
+            # would forfeit GQA's decode-bandwidth win.  "paged" names the
+            # block-pool-native kernel; the dense cache has no block table,
+            # so it degrades to the contiguous flash-decoding kernel here.
+            if config.decode_attention_impl in ("pallas", "paged"):
                 # Flash-decoding kernel: the cache streams through VMEM
                 # once, scores never reach HBM
                 # (kernels/pallas/decode_attention.py; parity pinned by
@@ -299,18 +301,39 @@ def decode_step(
 
 
 def init_kv_pool(
-    config: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.float32
+    config: ModelConfig,
+    num_blocks: int,
+    block_size: int,
+    dtype=jnp.float32,
+    kv_dtype: str | None = None,
 ) -> KVCache:
     """A paged KV pool: per layer ``(num_blocks, kv_heads, block_size,
     d_head)`` K and V block arrays.  Block 0 is the serving layer's trash
     block (masked writes are steered to it); a request's cache is a chain
-    of block ids, not a row index."""
+    of block ids, not a row index.
+
+    ``kv_dtype="int8"`` stores quantized K/V at one byte per value with
+    per-block-per-head f32 scales in parallel ``k_scale``/``v_scale``
+    pools ``(num_blocks, kv_heads)`` — HBM traffic per decoded token drops
+    ~2x vs bf16 (4x vs f32) and the freed bytes buy more blocks at fixed
+    memory.  A block's scale covers its whole ``(block_size, d_head)``
+    tile; writers keep it valid by rescale-on-grow (see
+    :func:`_quantize_decode_row`).  ``kv_dtype=None`` stores at ``dtype``
+    (the activation width) with no scale pools.
+    """
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f'kv_dtype={kv_dtype!r} must be None or "int8"')
     kv_heads = config.num_kv_heads or config.num_heads
     shape = (num_blocks, kv_heads, block_size, config.d_head)
-    return [
-        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-        for _ in range(config.num_layers)
-    ]
+    store = jnp.int8 if kv_dtype == "int8" else dtype
+    layers: KVCache = []
+    for _ in range(config.num_layers):
+        layer = {"k": jnp.zeros(shape, store), "v": jnp.zeros(shape, store)}
+        if kv_dtype == "int8":
+            layer["k_scale"] = jnp.zeros((num_blocks, kv_heads), jnp.float32)
+            layer["v_scale"] = jnp.zeros((num_blocks, kv_heads), jnp.float32)
+        layers.append(layer)
+    return layers
 
 
 def gather_paged_kv(buf: Array, tables: Array) -> Array:
@@ -329,6 +352,61 @@ def gather_paged_kv(buf: Array, tables: Array) -> Array:
     gathered = buf[tables]  # (S, nb, kv, bs, dh)
     s, nb, kv, bs, dh = gathered.shape
     return jnp.transpose(gathered, (0, 2, 1, 3, 4)).reshape(s, kv, nb * bs, dh)
+
+
+def gather_paged_kv_dequant(
+    buf: Array, scale: Array, tables: Array, dtype
+) -> Array:
+    """:func:`gather_paged_kv` for an int8 pool: gather the quantized
+    blocks AND their per-block-per-head scales through the table, dequant
+    to ``dtype``.  This is the XLA reference read path (and chunked
+    prefill's) — the paged-native kernel dequantizes in registers without
+    ever materializing this buffer."""
+    bs = buf.shape[2]
+    gathered = gather_paged_kv(buf, tables)          # (S, kv, nb*bs, dh)
+    scales = jnp.transpose(scale[tables], (0, 2, 1))  # (S, kv, nb)
+    scales = jnp.repeat(scales, bs, axis=2)[..., None]
+    return (gathered.astype(jnp.float32) * scales).astype(dtype)
+
+
+def _quantize_decode_row(
+    pool_arr: Array, scale_arr: Array, new_row: Array, write_ids, offsets
+) -> tuple[Array, Array]:
+    """Scatter one new KV row per slot into an int8 block pool, keeping the
+    per-block-per-head scale sound under incremental writes.
+
+    ``new_row`` (slots, kv_heads, d_head) lands at ``(write_ids[s], :,
+    offsets[s], :)``.  The block scale grows monotonically within one
+    occupancy: ``offset == 0`` starts a FRESH block (blocks are recycled
+    without zeroing, so the previous owner's scale must not leak) and
+    resets the base scale to 0; otherwise the new row's absmax is folded
+    in and — when the scale grew — the block's already-written int8 rows
+    are rescaled by ``old/new`` (<= 1, so values stay in range; the
+    precision given up on old rows is the cost of per-block rather than
+    per-token scales).  One block per slot is touched — activation-sized
+    work, no pool-wide traffic.
+    """
+    blk = pool_arr[write_ids].astype(jnp.float32)       # (S, kv, bs, d)
+    s_old = scale_arr[write_ids]                        # (S, kv)
+    s_base = jnp.where(offsets[:, None] == 0, 0.0, s_old)
+    amax = jnp.max(jnp.abs(new_row.astype(jnp.float32)), axis=-1)  # (S, kv)
+    s_new = jnp.maximum(s_base, amax / 127.0)
+    safe = jnp.maximum(s_new, 1e-30)
+    # factor 0 on fresh blocks zeroes the recycled garbage rows too.
+    factor = s_base / safe
+    blk = jnp.round(blk * factor[:, :, None, None])
+    row_q = jnp.clip(
+        jnp.round(new_row.astype(jnp.float32) / safe[:, :, None]), -127, 127
+    )
+    sel = (
+        jax.lax.broadcasted_iota(jnp.int32, blk.shape, 2)
+        == offsets[:, None, None, None]
+    )
+    blk = jnp.where(sel, row_q[:, :, None, :], blk)
+    return (
+        pool_arr.at[write_ids].set(blk.astype(jnp.int8)),
+        scale_arr.at[write_ids].set(s_new),
+    )
 
 
 def paged_decode_step(
@@ -351,9 +429,13 @@ def paged_decode_step(
     slot's logical block index to a pool block id (0 = trash).  The new
     K/V is scattered into the pool at ``(tables[slot, pos // block_size],
     pos % block_size)`` — inactive slots scatter to the trash block, so one
-    compiled program serves every occupancy pattern — then attention reads
-    the slot's contiguous view through :func:`gather_paged_kv`, honoring
-    ``config.decode_attention_impl`` exactly like the dense step.
+    compiled program serves every occupancy pattern (int8 pools quantize
+    the row at scatter time, :func:`_quantize_decode_row`).  Attention then
+    honors ``config.decode_attention_impl``: ``"paged"`` runs the
+    paged-NATIVE flash kernel straight against the pool (the block table is
+    consumed inside the kernel's index maps — no contiguous transient);
+    ``"pallas"``/``"xla"`` keep the :func:`gather_paged_kv` reference path
+    (dequantizing on gather for int8 pools).
     """
     x = embedding(params["token_embeddings"], token[:, None])  # (S, 1, d)
     positions = pos[:, None]
@@ -362,6 +444,7 @@ def paged_decode_step(
     write_ids = jnp.take_along_axis(tables, block_col[:, None], axis=1)[:, 0]
     if active is not None:
         write_ids = jnp.where(active, write_ids, 0)
+    quantized = "k_scale" in pool[0]
 
     new_pool = []
     for block_params, layer_pool in zip(params["layers"], pool):
@@ -372,27 +455,62 @@ def paged_decode_step(
             # Scatter the one new token's K/V into each slot's frontier
             # block (advanced-index scatter: (S,) block ids x (S,) offsets
             # address (S, kv_heads, d_head) values).
-            k_pool = layer_pool["k"].at[write_ids, :, offsets, :].set(
-                k[:, :, 0, :]
-            )
-            v_pool = layer_pool["v"].at[write_ids, :, offsets, :].set(
-                v[:, :, 0, :]
-            )
-            new_pool.append({"k": k_pool, "v": v_pool})
-            k_cache = gather_paged_kv(k_pool, tables)
-            v_cache = gather_paged_kv(v_pool, tables)
-            if config.decode_attention_impl == "pallas":
-                from bpe_transformer_tpu.kernels.pallas.decode_attention import (
-                    decode_attention,
+            k_scale = v_scale = None
+            if quantized:
+                k_pool, k_scale = _quantize_decode_row(
+                    layer_pool["k"], layer_pool["k_scale"],
+                    k[:, :, 0, :], write_ids, offsets,
                 )
-
-                att = decode_attention(q[:, :, 0], k_cache, v_cache, pos)
+                v_pool, v_scale = _quantize_decode_row(
+                    layer_pool["v"], layer_pool["v_scale"],
+                    v[:, :, 0, :], write_ids, offsets,
+                )
+                new_pool.append(
+                    {"k": k_pool, "v": v_pool,
+                     "k_scale": k_scale, "v_scale": v_scale}
+                )
             else:
+                k_pool = layer_pool["k"].at[write_ids, :, offsets, :].set(
+                    k[:, :, 0, :]
+                )
+                v_pool = layer_pool["v"].at[write_ids, :, offsets, :].set(
+                    v[:, :, 0, :]
+                )
+                new_pool.append({"k": k_pool, "v": v_pool})
+            if config.decode_attention_impl == "paged":
                 from bpe_transformer_tpu.kernels.pallas.decode_attention import (
-                    xla_decode_attention,
+                    paged_decode_attention,
                 )
 
-                att = xla_decode_attention(q[:, :, 0], k_cache, v_cache, pos)
+                att = paged_decode_attention(
+                    q[:, :, 0], k_pool, v_pool, tables, pos,
+                    k_scale=k_scale, v_scale=v_scale,
+                )
+            else:
+                if quantized:
+                    k_cache = gather_paged_kv_dequant(
+                        k_pool, k_scale, tables, h.dtype
+                    )
+                    v_cache = gather_paged_kv_dequant(
+                        v_pool, v_scale, tables, h.dtype
+                    )
+                else:
+                    k_cache = gather_paged_kv(k_pool, tables)
+                    v_cache = gather_paged_kv(v_pool, tables)
+                if config.decode_attention_impl == "pallas":
+                    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+                        decode_attention,
+                    )
+
+                    att = decode_attention(q[:, :, 0], k_cache, v_cache, pos)
+                else:
+                    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+                        xla_decode_attention,
+                    )
+
+                    att = xla_decode_attention(
+                        q[:, :, 0], k_cache, v_cache, pos
+                    )
             att = merge_heads(att[:, :, None, :])
             return linear(att, block_params["attn"]["output_proj"])
 
@@ -438,6 +556,14 @@ def paged_chunk_prefill(
     Attention here is the materialized-scores formulation (transient
     O(chunk x context) score buffer) regardless of ``attention_impl`` —
     the chunk-vs-whole-cache shape has no flash kernel yet.
+
+    int8 pools: chunks always start block-aligned (the radix-shared prefix
+    is whole blocks; non-final chunks are block multiples), so every block
+    this chunk touches is freshly owned — its per-block scale is RESET to
+    the max over the chunk's rows in that block (a scatter-max after a
+    scatter-zero; the recycled block's leftover scale never leaks), then
+    the rows quantize against it.  A final partial block's scale keeps
+    growing under decode's rescale-on-grow writes.
     """
     _, cb = chunk_tokens.shape
     ctx = config.context_length
@@ -450,6 +576,7 @@ def paged_chunk_prefill(
     idx_in_table = jnp.clip(safe_positions // block_size, 0, nb - 1)
     write_ids = jnp.where(in_chunk, table_row[idx_in_table], 0)
     offsets = safe_positions % block_size
+    quantized = "k_scale" in pool[0]
 
     x = embedding(params["token_embeddings"], chunk_tokens)
     scale = 1.0 / jnp.sqrt(jnp.asarray(config.d_head, jnp.float32))
@@ -458,21 +585,59 @@ def paged_chunk_prefill(
         jnp.arange(nb * block_size)[None, :] <= (start + jnp.arange(cb))[:, None]
     )
 
+    def _quant_chunk_rows(pool_arr, scale_arr, rows):
+        """Per-block scatter of this chunk's (cb, kv, d) rows: reset the
+        written blocks' scales, scatter-max the rows' absmax in, quantize
+        each row against its block's fresh scale."""
+        amax = jnp.max(jnp.abs(rows.astype(jnp.float32)), axis=-1)  # (cb, kv)
+        amax = jnp.where(in_chunk[:, None], amax, 0.0)
+        scales = scale_arr.at[write_ids, :].set(0.0)
+        scales = scales.at[write_ids, :].max(amax / 127.0)
+        per_row = jnp.maximum(scales[write_ids], 1e-30)  # (cb, kv)
+        rows_q = jnp.clip(
+            jnp.round(rows.astype(jnp.float32) / per_row[..., None]),
+            -127, 127,
+        )
+        return (
+            pool_arr.at[write_ids, :, offsets, :].set(rows_q.astype(jnp.int8)),
+            scales,
+        )
+
     new_pool = []
     for block_params, layer_pool in zip(params["layers"], pool):
 
         def attend(h, block_params=block_params, layer_pool=layer_pool):
             q, k, v = _project_qkv(h, block_params["attn"], config)
             q, k = _rope_qk(q, k, safe_positions, config)
-            k_pool = layer_pool["k"].at[write_ids, :, offsets, :].set(
-                jnp.transpose(k[0], (1, 0, 2))
-            )
-            v_pool = layer_pool["v"].at[write_ids, :, offsets, :].set(
-                jnp.transpose(v[0], (1, 0, 2))
-            )
-            new_pool.append({"k": k_pool, "v": v_pool})
-            k_cache = gather_paged_kv(k_pool, table_row[None])
-            v_cache = gather_paged_kv(v_pool, table_row[None])
+            if quantized:
+                k_pool, k_scale = _quant_chunk_rows(
+                    layer_pool["k"], layer_pool["k_scale"],
+                    jnp.transpose(k[0], (1, 0, 2)),
+                )
+                v_pool, v_scale = _quant_chunk_rows(
+                    layer_pool["v"], layer_pool["v_scale"],
+                    jnp.transpose(v[0], (1, 0, 2)),
+                )
+                new_pool.append(
+                    {"k": k_pool, "v": v_pool,
+                     "k_scale": k_scale, "v_scale": v_scale}
+                )
+                k_cache = gather_paged_kv_dequant(
+                    k_pool, k_scale, table_row[None], h.dtype
+                )
+                v_cache = gather_paged_kv_dequant(
+                    v_pool, v_scale, table_row[None], h.dtype
+                )
+            else:
+                k_pool = layer_pool["k"].at[write_ids, :, offsets, :].set(
+                    jnp.transpose(k[0], (1, 0, 2))
+                )
+                v_pool = layer_pool["v"].at[write_ids, :, offsets, :].set(
+                    jnp.transpose(v[0], (1, 0, 2))
+                )
+                new_pool.append({"k": k_pool, "v": v_pool})
+                k_cache = gather_paged_kv(k_pool, table_row[None])
+                v_cache = gather_paged_kv(v_pool, table_row[None])
             k_full = _expand_kv(k_cache, config)
             v_full = _expand_kv(v_cache, config)
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_full) * scale

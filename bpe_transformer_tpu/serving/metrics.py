@@ -397,6 +397,15 @@ def render_prometheus(
              "Prompt tokens queued in chunked prefill (the prefill/decode "
              "interleave backlog).",
              [({}, engine_stats.get("prefill_pending_tokens"))])
+        emit("kv_pool_bytes", "gauge",
+             "Resident bytes of the paged KV block pool (int8 pools "
+             "include their scale pools).",
+             [({}, engine_stats.get("kv_pool_bytes"))])
+        emit("kv_bytes_per_token", "gauge",
+             "KV footprint per token position at pool dtype width across "
+             "layers — the unit of the attention read stream (int8 halves "
+             "bf16, quarters f32).",
+             [({}, engine_stats.get("kv_bytes_per_token"))])
 
     if resources:
         emit("compile_events_total", "counter",

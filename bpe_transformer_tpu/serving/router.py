@@ -21,6 +21,12 @@ operational surface — no new protocol:
   on the next-best replica** (generation is deterministic per seed, so a
   replayed request returns the same tokens), so a rolling restart loses
   zero requests;
+* an optional ``"session"`` body key makes routing STICKY: the key hashes
+  to one replica of the fixed fleet list, and while that replica is
+  available it is tried first (weighted order is only the fallback on
+  drain/death), so a multi-turn conversation keeps landing where its
+  radix prefix blocks already live and re-prefills nothing.  The
+  affinity hit rate is surfaced in ``/statusz`` + ``/metrics``;
 * ``GET /statusz`` (the fleet table: per-replica health + routing
   counters) and ``GET /metrics`` (Prometheus: routed/retried/failed
   counters per replica, per-replica health gauges) make the router
@@ -37,6 +43,7 @@ import json
 import threading
 import time
 import urllib.request
+import zlib
 from urllib.parse import urlsplit
 
 __all__ = ["ReplicaState", "Router", "make_router_http_server", "main"]
@@ -137,6 +144,12 @@ class Router:
         self.requests_routed = 0
         self.requests_retried = 0
         self.requests_failed = 0
+        #: Session-affinity accounting: requests that carried a session
+        #: key, and how many were SERVED by their sticky replica (a miss
+        #: means the sticky home was draining/dead and the weighted
+        #: fallback answered — its prefix blocks start cold there).
+        self.session_requests = 0
+        self.affinity_hits = 0
         self._thread: threading.Thread | None = None
         self._running = False
 
@@ -228,15 +241,41 @@ class Router:
 
     # -------------------------------------------------------------- routing
 
-    def pick_order(self) -> list[ReplicaState]:
+    def pick_order(
+        self,
+        session: str | None = None,
+        *,
+        sticky: ReplicaState | None = None,
+    ) -> list[ReplicaState]:
         """Available replicas, best weight first; round-robin rotation
-        breaks exact ties so equal replicas share load evenly."""
+        breaks exact ties so equal replicas share load evenly.
+
+        A ``session`` key prepends its STICKY replica (stable hash over the
+        fixed fleet list, so stickiness survives health flaps of OTHER
+        replicas) when it is available — multi-turn traffic lands where its
+        radix prefix blocks live; the weighted order remains the failover
+        tail, so a draining/dead sticky home degrades to normal balancing
+        rather than an error.  A caller that already resolved the sticky
+        home passes it as ``sticky`` (skips the re-hash)."""
         with self._lock:
             avail = [r for r in self.replicas if r.available]
             self._rr += 1
             rotation = self._rr
         rotated = avail[rotation % len(avail):] + avail[: rotation % len(avail)] if avail else []
-        return sorted(rotated, key=lambda r: -r.weight())
+        order = sorted(rotated, key=lambda r: -r.weight())
+        if sticky is None and session is not None:
+            sticky = self.sticky_replica(session)
+        if sticky is not None and sticky in order:
+            order.remove(sticky)
+            order.insert(0, sticky)
+        return order
+
+    def sticky_replica(self, session: str) -> ReplicaState:
+        """The session's affinity home: a stable hash into the FIXED
+        replica list (never the currently-available subset — availability
+        churn elsewhere must not reshuffle every session)."""
+        digest = zlib.crc32(str(session).encode("utf-8"))
+        return self.replicas[digest % len(self.replicas)]
 
     def _post_generate(self, replica: ReplicaState, body: bytes):
         """POST /generate with a short CONNECT timeout and the full
@@ -280,10 +319,28 @@ class Router:
 
     def handle_generate(self, body: bytes) -> tuple[int, dict]:
         """Proxy one generate request with failover: try replicas in
-        weight order; connection failures, mid-request deaths, and 503s
-        (draining replica, full queue) re-queue the request on the
-        next-best replica."""
-        order = self.pick_order()
+        weight order (the request's sticky session replica first, when it
+        has one and it is available); connection failures, mid-request
+        deaths, and 503s (draining replica, full queue) re-queue the
+        request on the next-best replica."""
+        session = None
+        # The router treats the body as opaque bytes; only a request that
+        # can actually carry a session key pays the JSON parse (long
+        # sessionless prompt_ids bodies stay zero-parse on the proxy path).
+        if body and b'"session"' in body:
+            try:
+                parsed = json.loads(body)
+                if isinstance(parsed, dict):
+                    session = parsed.get("session")
+            except ValueError:
+                pass  # the replica will 400 it; routing just goes unsticky
+        sticky = (
+            self.sticky_replica(session) if session is not None else None
+        )
+        if session is not None:
+            with self._lock:
+                self.session_requests += 1
+        order = self.pick_order(session, sticky=sticky)
         if not order:
             with self._lock:
                 self.requests_failed += 1
@@ -301,6 +358,8 @@ class Router:
                     with self._lock:
                         replica.routed += 1
                         self.requests_routed += 1
+                        if sticky is not None and replica is sticky:
+                            self.affinity_hits += 1
                     payload["replica"] = replica.url
                     return 200, payload
                 detail = str(payload.get("error", ""))
@@ -349,6 +408,7 @@ class Router:
                 self.requests_retried,
                 self.requests_failed,
             )
+            sessions, hits = self.session_requests, self.affinity_hits
         return {
             "uptime_s": round(self._clock() - self._t0, 3),
             "replicas": replicas,
@@ -356,6 +416,13 @@ class Router:
             "requests_routed": routed,
             "requests_retried": retried,
             "requests_failed": failed,
+            # Session affinity (sticky routing): how much multi-turn
+            # traffic actually landed on its prefix-block home.
+            "session_requests": sessions,
+            "affinity_hits": hits,
+            "affinity_hit_rate": (
+                round(hits / sessions, 6) if sessions else None
+            ),
         }
 
     def prometheus_metrics(self, prefix: str = "bpe_tpu_router") -> str:
@@ -366,6 +433,7 @@ class Router:
                 self.requests_retried,
                 self.requests_failed,
             )
+            sessions, hits = self.session_requests, self.affinity_hits
         # serving/metrics.py is jax-free at import: the router can share
         # the exposition formatter without touching an accelerator runtime.
         from bpe_transformer_tpu.serving.metrics import emit_prometheus
@@ -382,6 +450,12 @@ class Router:
              [({}, retried)])
         emit("requests_failed_total", "counter",
              "Requests no replica could serve.", [({}, failed)])
+        emit("session_requests_total", "counter",
+             "Requests that carried a session key (sticky routing).",
+             [({}, sessions)])
+        emit("affinity_hits_total", "counter",
+             "Session requests served by their sticky replica.",
+             [({}, hits)])
         emit("replica_healthy", "gauge", "Replica reachable and worker alive.",
              [({"replica": r["url"]}, int(r["healthy"])) for r in replicas])
         emit("replica_draining", "gauge", "Replica draining (rolling restart).",

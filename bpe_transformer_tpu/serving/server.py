@@ -71,6 +71,11 @@ class Request:
     #: Seconds the request may wait IN THE QUEUE before it is failed fast
     #: with ``finish_reason="deadline"`` (None: wait indefinitely).
     deadline_s: float | None = None
+    #: Optional session key (multi-turn conversations): the fleet router
+    #: hashes it to a sticky replica so follow-up turns land where the
+    #: session's radix prefix blocks live.  The replica itself only
+    #: carries it (request metadata) — affinity is a routing concern.
+    session: str | None = None
     request_id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex
     )
@@ -187,6 +192,7 @@ class ServingEngine:
         prefill_chunk: int | None = None,
         prefill_token_budget: int | None = None,
         prefix_cache: bool = True,
+        kv_dtype: str | None = None,
     ):
         # Count XLA compiles (the engine's bucketed prefills included) into
         # the process-wide telemetry.resources counter before the first
@@ -202,6 +208,7 @@ class ServingEngine:
                 num_blocks=num_kv_blocks,
                 prefill_buckets=prefill_buckets, min_bucket=min_bucket,
                 prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+                kv_dtype=kv_dtype,
             )
         else:
             self.engine = SlotPoolEngine(
@@ -409,6 +416,7 @@ class ServingEngine:
         seed: int = 0,
         stop_id: int | None = None,
         deadline_s: float | None = None,
+        session: str | None = None,
         timeout: float | None = None,
     ) -> Result:
         """Blocking one-call generation."""
@@ -426,6 +434,7 @@ class ServingEngine:
                 seed=seed,
                 stop_id=self.default_stop_id if stop_id is None else stop_id,
                 deadline_s=deadline_s,
+                session=session,
             )
         )
         return handle.result(timeout)
@@ -465,6 +474,7 @@ class ServingEngine:
         if self.paged:
             stats.update(self.engine.gauges())
             stats["block_size"] = self.engine.block_size
+            stats["kv_dtype"] = self.engine.kv_dtype
             stats["admit_backlog"] = len(self._admit_backlog)
         return stats
 
@@ -506,6 +516,7 @@ class ServingEngine:
             page["kvpool"] = {
                 **self.engine.gauges(),
                 "block_size": self.engine.block_size,
+                "kv_dtype": self.engine.kv_dtype,
                 "admit_backlog": len(self._admit_backlog),
             }
         return page
@@ -951,6 +962,12 @@ class ServingEngine:
                     "prefill_pending_tokens": gauges[
                         "prefill_pending_tokens"
                     ],
+                    # KV-memory economics (ISSUE 9): resident pool bytes
+                    # (int8 quarters f32 at fixed block count) and the
+                    # per-token KV write footprint — the report/compare
+                    # gate's KV-memory regression rows.
+                    "kv_pool_bytes": gauges["kv_pool_bytes"],
+                    "kv_bytes_per_token": gauges["kv_bytes_per_token"],
                 }
             )
         self._last_record_t = now
@@ -1041,6 +1058,7 @@ def make_http_server(
                     seed=int(body.get("seed", 0)),
                     stop_id=body.get("stop_id"),
                     deadline_s=body.get("deadline_s"),
+                    session=body.get("session"),
                 )
             except QueueFullError as exc:
                 return self._reply(503, {"error": str(exc)})

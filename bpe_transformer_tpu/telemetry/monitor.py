@@ -77,6 +77,9 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
                         "prefill_pending_tokens"):
                 if key in record:
                     state[f"kv_{key}"] = record[key]
+            for key in ("kv_pool_bytes", "kv_bytes_per_token"):
+                if record.get(key) is not None:
+                    state[key] = record[key]
         elif kind == "resources":
             for key in ("host_rss_bytes", "live_buffer_bytes",
                         "hbm_bytes_in_use", "hbm_peak_bytes_in_use",
@@ -208,6 +211,8 @@ def fold_prometheus(samples: dict, prefix: str = "bpe_tpu") -> dict:
         # Paged-KV pool gauges (absent on dense replicas).
         "kv_blocks_total": get("kv_blocks_total"),
         "kv_blocks_free": get("kv_blocks_free"),
+        "kv_pool_bytes": get("kv_pool_bytes"),
+        "kv_bytes_per_token": get("kv_bytes_per_token"),
         "kv_blocks_shared": get("kv_blocks_shared"),
         "kv_prefix_hits": get("prefix_cache_hits_total"),
         "kv_prefix_misses": get("prefix_cache_misses_total"),
@@ -326,6 +331,10 @@ def render_frame(state: dict, source: str) -> str:
             parts.append(
                 f"prefill backlog {_num(state['kv_prefill_pending_tokens'])}"
             )
+        if state.get("kv_pool_bytes"):
+            parts.append(f"pool {state['kv_pool_bytes'] / 2**20:.1f}M")
+        if state.get("kv_bytes_per_token"):
+            parts.append(f"{_num(state['kv_bytes_per_token'])}B/tok")
         lines.append("  kv     " + "  ".join(parts))
 
     mem_parts = []

@@ -300,6 +300,10 @@ def summarize(records: list[dict]) -> dict:
             "prefill_pending_tokens": _stats(
                 [r.get("prefill_pending_tokens") for r in kvpool_records]
             ),
+            # KV-memory economics (static per run — last sample wins):
+            # the int8-KV win reads directly off these two.
+            "kv_pool_bytes": last.get("kv_pool_bytes"),
+            "kv_bytes_per_token": last.get("kv_bytes_per_token"),
         }
 
     health_last = {}
@@ -716,6 +720,16 @@ def render_report(records: list[dict]) -> str:
                 f"  chunked-prefill backlog max {_fmt(pending.get('max'))} "
                 f"tokens (mean {_fmt(pending.get('mean'))})"
             )
+        if kv.get("kv_pool_bytes") is not None:
+            per_tok = kv.get("kv_bytes_per_token")
+            lines.append(
+                f"  pool {kv['kv_pool_bytes'] / 2**20:.1f} MiB"
+                + (
+                    f"  kv/token {_fmt(per_tok)} B"
+                    if per_tok is not None
+                    else ""
+                )
+            )
 
     rs = s["resources"]
     if rs:
@@ -949,6 +963,14 @@ COMPARE_METRICS: dict = {
     "kv_blocks_free": (
         lambda s: ((s.get("kvpool") or {}).get("blocks_free", {})
                    or {}).get("min"), "higher"),
+    # KV-memory regression gate (ISSUE 9): a run whose per-token KV bytes
+    # or resident pool bytes grow back against an int8 baseline lost the
+    # quantization win — gate it like any throughput regression.
+    "kv_bytes_per_token": (
+        lambda s: (s.get("kvpool") or {}).get("kv_bytes_per_token"),
+        "lower"),
+    "kv_pool_bytes": (
+        lambda s: (s.get("kvpool") or {}).get("kv_pool_bytes"), "lower"),
     # Per-chip state bytes (optimizer sharding's memory win): a run whose
     # opt_state_bytes shrinks 1/N against the unsharded baseline shows up
     # as an "improved" row; growing back is a gated regression.

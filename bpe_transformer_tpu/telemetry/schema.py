@@ -84,8 +84,13 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
     # emitted on the engine-record cadence: block occupancy
     # (``blocks_{total,free,shared}``), radix prefix-cache effectiveness
     # (cumulative token ``prefix_{hits,misses}`` and the derived
-    # ``prefix_hit_rate``, null before any lookup), and the
-    # chunked-prefill backlog (optional ``prefill_pending_tokens``).
+    # ``prefix_hit_rate``, null before any lookup), the chunked-prefill
+    # backlog (optional ``prefill_pending_tokens``), and the KV-memory
+    # economics (optional ``kv_pool_bytes`` — resident pool bytes, scale
+    # pools included — and ``kv_bytes_per_token`` — the per-position KV
+    # footprint at pool width, the attention read stream's unit, which
+    # int8 quantization halves/quarters; both feed the
+    # report --baseline regression gate; older streams predate them).
     "kvpool": {
         "kind", "t", "blocks_total", "blocks_free", "blocks_shared",
         "prefix_hits", "prefix_misses",

@@ -303,9 +303,38 @@ def cmd_serve(args) -> int:
         from bpe_transformer_tpu.utils.compile_cache import enable_compile_cache
 
         enable_compile_cache(args.compile_cache)
+    if args.kv_dtype == "int8" and not args.paged:
+        print("serve: --kv-dtype int8 needs --paged (the int8 scale pools "
+              "live in the block pool)", file=sys.stderr)
+        return 2
+    if args.decode_attention == "paged" and not args.paged:
+        print("serve: --decode-attention paged needs --paged (the kernel "
+              "reads through the block table)", file=sys.stderr)
+        return 2
+    if (
+        args.kv_dtype == "int8"
+        and args.decode_attention == "paged"
+        and args.block_size < 32
+    ):
+        # Mosaic's 8-bit tiles need >= 32 sublanes: on a real chip the
+        # first tick would die inside the kernel, long after startup.  The
+        # CPU interpreter has no such constraint, so tiny-block tests pass.
+        import jax
+
+        if jax.default_backend() == "tpu":
+            print("serve: --kv-dtype int8 with --decode-attention paged "
+                  "needs --block-size >= 32 on TPU (int8 tile sublane "
+                  f"alignment), got {args.block_size}", file=sys.stderr)
+            return 2
     payload, model_config, tokenizer = _load_inference_state(
         args, need_tokenizer=True
     )
+    if args.decode_attention:
+        import dataclasses
+
+        model_config = dataclasses.replace(
+            model_config, decode_attention_impl=args.decode_attention
+        )
     stop_id = None
     if tokenizer.special_tokens:
         stop_id = tokenizer.encode(tokenizer.special_tokens[0])[0]
@@ -335,6 +364,7 @@ def cmd_serve(args) -> int:
         prefill_chunk=args.prefill_chunk,
         prefill_token_budget=args.prefill_budget,
         prefix_cache=not args.no_prefix_cache,
+        kv_dtype=None if args.kv_dtype == "act" else args.kv_dtype,
     )
     try:
         with serving:
@@ -437,6 +467,20 @@ def cmd_warmup(args) -> int:
     )
     from bpe_transformer_tpu.utils.compile_cache import enable_compile_cache
 
+    if (
+        args.paged
+        and args.kv_dtype in ("int8", "both")
+        and args.decode_attention == "paged"
+        and args.block_size < 32
+        and jax.default_backend() == "tpu"
+    ):
+        # Same constraint cmd_serve enforces: Mosaic int8 tiles need
+        # >= 32 sublanes, and warming would die inside the first tick.
+        print("warmup: --kv-dtype int8 with --decode-attention paged needs "
+              "--block-size >= 32 on TPU (int8 tile sublane alignment), "
+              f"got {args.block_size}", file=sys.stderr)
+        return 2
+
     install_compile_counter()
     enable_compile_cache(args.compile_cache)
 
@@ -453,36 +497,70 @@ def cmd_warmup(args) -> int:
         model_config = _load_model_config(args)
         params = init_params(jax.random.PRNGKey(0), model_config)
 
+    if args.decode_attention:
+        import dataclasses
+
+        model_config = dataclasses.replace(
+            model_config, decode_attention_impl=args.decode_attention
+        )
+
+    factories = []
+    kv_dtypes: list[str | None] = [None]
     if args.paged:
         from bpe_transformer_tpu.serving import PagedEngine
 
-        # prefix_cache OFF: warmup's point is compiling every ladder rung,
-        # and its repeated dummy prompts would otherwise share a prefix and
-        # shrink later rungs' chunks into already-compiled programs.
-        engine = PagedEngine(
-            params, model_config, slots=args.slots,
-            block_size=args.block_size, num_blocks=args.num_kv_blocks,
-            prefill_chunk=args.prefill_chunk, prefix_cache=False,
-        )
+        # Warm EVERY pool dtype the fleet may restart with (default both):
+        # the int8 and activation-width pools lower to different programs,
+        # and a --kv-dtype int8 replica restarting against a cache warmed
+        # only at full width would cold-compile its whole ladder.
+        kv_dtypes = {
+            "act": [None], "int8": ["int8"], "both": [None, "int8"],
+        }[args.kv_dtype]
+        for kv_dtype in kv_dtypes:
+            # prefix_cache OFF: warmup's point is compiling every ladder
+            # rung, and its repeated dummy prompts would otherwise share a
+            # prefix and shrink later rungs' chunks into already-compiled
+            # programs.
+            factories.append(lambda kv_dtype=kv_dtype: PagedEngine(
+                params, model_config, slots=args.slots,
+                block_size=args.block_size, num_blocks=args.num_kv_blocks,
+                prefill_chunk=args.prefill_chunk, prefix_cache=False,
+                kv_dtype=kv_dtype,
+            ))
     else:
         from bpe_transformer_tpu.serving import SlotPoolEngine
 
-        engine = SlotPoolEngine(params, model_config, slots=args.slots)
+        factories.append(
+            lambda: SlotPoolEngine(params, model_config, slots=args.slots)
+        )
 
     ctx = model_config.context_length
-    for bucket in engine.buckets:
-        plen = min(bucket, ctx - 2)
-        event = engine.admit(
-            [1] * plen, max_new_tokens=2, temperature=0.0
-        )
-        while not event.finished:
-            events = engine.tick()
-            event = next(e for e in events if e.slot == event.slot)
+    programs = 0
+    buckets = None
+    # One engine alive at a time: with --num-kv-blocks sized to the serve
+    # config's HBM budget, holding the act-width AND int8 pools resident
+    # together would OOM warmup on exactly the machine serve fits on.
+    for factory in factories:
+        engine = factory()
+        if buckets is None:
+            buckets = list(engine.buckets)
+        for bucket in engine.buckets:
+            plen = min(bucket, ctx - 2)
+            event = engine.admit(
+                [1] * plen, max_new_tokens=2, temperature=0.0
+            )
+            while not event.finished:
+                events = engine.tick()
+                event = next(e for e in events if e.slot == event.slot)
+        programs += engine.compiled_programs()
+        del engine
 
     summary = {
-        "programs_compiled": engine.compiled_programs(),
-        "buckets": list(engine.buckets),
+        "programs_compiled": programs,
+        "buckets": buckets,
         "engine": "paged" if args.paged else "dense",
+        "decode_attention": model_config.decode_attention_impl,
+        "kv_dtypes": [d or "act" for d in kv_dtypes] if args.paged else None,
         "cache_dir": str(args.compile_cache),
         "cache_hits": compile_cache_hits(),
     }
@@ -1016,6 +1094,20 @@ def build_parser() -> argparse.ArgumentParser:
                    "p99 under heavy prefill traffic")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable the radix prefix cache (with --paged)")
+    p.add_argument("--kv-dtype", choices=("act", "int8"), default="act",
+                   help="KV block storage width (with --paged): 'act' "
+                   "stores at the activation dtype; 'int8' quantizes "
+                   "blocks with per-block-per-head f32 scales — ~2x less "
+                   "HBM traffic per token vs bf16 (4x vs f32), 2-4x more "
+                   "blocks at fixed memory")
+    p.add_argument("--decode-attention",
+                   choices=("xla", "pallas", "paged"), default=None,
+                   help="decode-step attention: 'paged' (with --paged) is "
+                   "the block-pool-native flash kernel — the block table "
+                   "is consumed inside the kernel's index maps, deleting "
+                   "the per-tick contiguous KV gather; 'pallas' is flash "
+                   "decode over the gathered cache; default: checkpoint "
+                   "config (xla)")
     p.add_argument("--special-token", action="append", default=None,
                    help='repeatable; default: ["<|endoftext|>"]')
     p.set_defaults(fn=cmd_serve)
@@ -1065,6 +1157,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--num-kv-blocks", type=int, default=None)
     p.add_argument("--prefill-chunk", type=int, default=None)
+    p.add_argument("--kv-dtype", choices=("act", "int8", "both"),
+                   default="both",
+                   help="which paged pool dtypes to warm (default both: "
+                   "a replica restarting with either --kv-dtype hits the "
+                   "cache)")
+    p.add_argument("--decode-attention",
+                   choices=("xla", "pallas", "paged"), default=None,
+                   help="warm this decode-attention ladder (use 'paged' "
+                   "for --decode-attention paged replicas)")
     p.set_defaults(fn=cmd_warmup, default_preset="tinystories-4l")
 
     p = sub.add_parser(

@@ -180,6 +180,7 @@ def _optimize(opt_class) -> torch.Tensor:
     return model.weight.detach()
 
 
+@pytest.mark.slow
 def test_adamw_cls_matches_torch():
     expected = _optimize(torch.optim.AdamW)
     actual = _optimize(get_adamw_cls())

@@ -70,6 +70,7 @@ def test_decode_step_matches_forward(setup):
     _stepwise_decode_parity(params, ids, CFG, forward(params, ids, CFG), 4)
 
 
+@pytest.mark.slow
 def test_generate_cached_greedy_matches_uncached(setup):
     """temperature=0: the cached sampler and the sliding-window sampler must
     produce identical token sequences."""
@@ -112,6 +113,7 @@ def test_generate_cached_greedy_matches_uncached(setup):
     ],
     ids=["post_norm", "moe_top1", "moe_top2_post_norm"],
 )
+@pytest.mark.slow
 def test_cached_decode_parity_block_variants(variant):
     """Round-2 coverage: the cached path handles post-norm and MoE blocks
     (capacity generous so per-call routing has no drops) with logits parity
@@ -245,6 +247,7 @@ def test_moe_decode_default_capacity_no_drops():
     _stepwise_decode_parity(params, ids, cfg, forward(params, ids, nodrop), 4)
 
 
+@pytest.mark.slow
 def test_moe_decode_step_dropfree_with_degenerate_capacity():
     """Even when the full-length expert capacity is below the batch size
     (many experts, tiny context), single-token decode steps must stay
@@ -330,6 +333,7 @@ def test_pallas_decode_attention_impl_matches_xla(setup):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_pallas_decode_attention_impl_gqa():
     """The kernel path reads the COMPACT GQA cache (no head expansion):
     per-step logits match the full forward on a grouped-query config."""
@@ -580,6 +584,7 @@ def test_generate_cached_with_tp_sharded_params():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+@pytest.mark.slow
 def test_pallas_decode_attention_impl_moe_block():
     """The flash-decoding kernel composes with MoE blocks (attention is
     FFN-independent, but the integration deserves its own pin): per-step

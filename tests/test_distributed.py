@@ -57,6 +57,7 @@ def _oracle_loss() -> float:
     return float(metrics["loss"])
 
 
+@pytest.mark.slow
 def test_two_process_distributed_dp_step():
     # Bounded by the communicate(timeout=240) below, not a pytest plugin.
     port = _free_port()

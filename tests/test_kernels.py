@@ -30,6 +30,7 @@ def test_gelu_matches_reference_formula(shape):
     )
 
 
+@pytest.mark.slow
 def test_gelu_matches_torch_tanh_gelu():
     import torch
     import torch.nn.functional as F
@@ -90,6 +91,7 @@ def test_flash_attention_gradients_match_xla():
         ((1, 1, 128, 64), 64, 64, False),  # non-causal backward
     ],
 )
+@pytest.mark.slow
 def test_flash_backward_blockwise_parity(shape, block_q, block_k, causal):
     """The FA-2 Pallas backward (dQ/dK/dV kernels, no S^2 materialization)
     matches the materialized-scores XLA vjp across padding/blocking shapes."""
@@ -136,6 +138,7 @@ def test_flash_backward_bf16_grad_dtype():
         assert g.dtype == jnp.bfloat16
 
 
+@pytest.mark.slow
 def test_fused_rope_table_gradients_match_xla():
     """cos/sin table grads of the fused kernel's vjp match the XLA oracle
     (tables are non-trainable in the model, but the vjp stays honest)."""
@@ -202,6 +205,7 @@ def test_fused_rope_flash_attention_matches_xla(batch, heads, seq, d):
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_fused_rope_flash_attention_gradients_match_xla():
     from bpe_transformer_tpu.ops.rope import rope_tables
 
@@ -224,6 +228,7 @@ def test_fused_rope_flash_attention_gradients_match_xla():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_model_fused_flash_attention_matches_xla_impl():
     import dataclasses
 
@@ -285,6 +290,7 @@ def test_flash_fused_crossover_dispatch(monkeypatch):
 
 
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.slow
 def test_ring_attention_matches_full(causal):
     mesh = make_mesh({"data": 8})
     rng = np.random.default_rng(4)
@@ -298,6 +304,7 @@ def test_ring_attention_matches_full(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_gradients_flow():
     mesh = make_mesh({"data": 8})
     rng = np.random.default_rng(5)
@@ -540,3 +547,166 @@ def test_decode_attention_gpt2_shape():
             np.asarray(out, np.float32), np.asarray(ref, np.float32),
             atol=3e-2, err_msg=f"pos {pos}",
         )
+
+
+# ------------------------------------------------ paged-native flash decode
+
+
+def _paged_pool(rng, num_blocks, kv_heads, block_size, d, dtype=np.float32):
+    return jnp.asarray(
+        rng.standard_normal((num_blocks, kv_heads, block_size, d)).astype(
+            dtype
+        )
+    )
+
+
+@pytest.mark.parametrize(
+    "slots,heads,kv_heads,block_size,nbs,d",
+    [
+        (3, 8, 4, 8, 4, 16),    # GQA, the serving test shape
+        (2, 4, 4, 16, 4, 64),   # MHA, production-ish block
+        (1, 6, 1, 8, 8, 48),    # MQA, deep chain + odd head dim
+    ],
+)
+def test_paged_decode_attention_matches_gathered_xla(
+    slots, heads, kv_heads, block_size, nbs, d
+):
+    """The paged-NATIVE kernel (block table consumed in the index maps)
+    equals the gather-then-attend reference at ragged per-slot frontiers
+    — including slots parked on the trash block (inactive)."""
+    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+        paged_decode_attention,
+        xla_decode_attention,
+    )
+    from bpe_transformer_tpu.models.decode import gather_paged_kv
+
+    rng = np.random.default_rng(7)
+    num_blocks = slots * nbs + 1
+    k_pool = _paged_pool(rng, num_blocks, kv_heads, block_size, d)
+    v_pool = _paged_pool(rng, num_blocks, kv_heads, block_size, d)
+    # Distinct non-trash blocks per slot, deliberately shuffled: the
+    # kernel must follow the table, not pool order.
+    perm = rng.permutation(np.arange(1, num_blocks))
+    tables = jnp.asarray(perm.reshape(slots, nbs), jnp.int32)
+    ctx = nbs * block_size
+    pos = jnp.asarray(
+        [0, ctx - 1, ctx // 2][:slots] + [3] * max(0, slots - 3), jnp.int32
+    )[:slots]
+    q = jnp.asarray(rng.standard_normal((slots, heads, d)).astype(np.float32))
+
+    out = paged_decode_attention(q, k_pool, v_pool, tables, pos,
+                                 interpret=True)
+    ref = xla_decode_attention(
+        q, gather_paged_kv(k_pool, tables), gather_paged_kv(v_pool, tables),
+        pos,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_decode_attention_int8_matches_dequant_reference():
+    """int8 blocks + per-block-per-head scales: the kernel's in-register
+    dequant equals attention over the explicitly dequantized gathered
+    cache (same numbers, no transient)."""
+    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+        paged_decode_attention,
+        xla_decode_attention,
+    )
+    from bpe_transformer_tpu.models.decode import gather_paged_kv
+
+    rng = np.random.default_rng(11)
+    slots, heads, kv_heads, block_size, nbs, d = 2, 8, 4, 8, 4, 16
+    num_blocks = slots * nbs + 1
+    kf = _paged_pool(rng, num_blocks, kv_heads, block_size, d)
+    vf = _paged_pool(rng, num_blocks, kv_heads, block_size, d)
+    k_scale = jnp.asarray(
+        (np.abs(rng.standard_normal((num_blocks, kv_heads))) / 40 + 0.01)
+        .astype(np.float32)
+    )
+    v_scale = jnp.asarray(
+        (np.abs(rng.standard_normal((num_blocks, kv_heads))) / 40 + 0.01)
+        .astype(np.float32)
+    )
+    kq = jnp.clip(
+        jnp.round(kf / k_scale[:, :, None, None]), -127, 127
+    ).astype(jnp.int8)
+    vq = jnp.clip(
+        jnp.round(vf / v_scale[:, :, None, None]), -127, 127
+    ).astype(jnp.int8)
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, num_blocks)).reshape(slots, nbs),
+        jnp.int32,
+    )
+    pos = jnp.asarray([9, 31], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((slots, heads, d)).astype(np.float32))
+
+    out = paged_decode_attention(
+        q, kq, vq, tables, pos, k_scale=k_scale, v_scale=v_scale,
+        interpret=True,
+    )
+    kd = kq.astype(jnp.float32) * k_scale[:, :, None, None]
+    vd = vq.astype(jnp.float32) * v_scale[:, :, None, None]
+    ref = xla_decode_attention(
+        q, gather_paged_kv(kd, tables), gather_paged_kv(vd, tables), pos
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_decode_attention_single_compile_across_state():
+    """tables/pos ride scalar prefetch: one jitted program serves every
+    table layout and frontier (the paged tick's bounded-compile
+    contract)."""
+    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+        paged_decode_attention,
+        xla_decode_attention,
+    )
+    from bpe_transformer_tpu.models.decode import gather_paged_kv
+
+    rng = np.random.default_rng(3)
+    slots, heads, kv_heads, block_size, nbs, d = 2, 4, 2, 8, 4, 16
+    num_blocks = slots * nbs + 1
+    k_pool = _paged_pool(rng, num_blocks, kv_heads, block_size, d)
+    v_pool = _paged_pool(rng, num_blocks, kv_heads, block_size, d)
+    f = jax.jit(
+        lambda q, k, v, t, p: paged_decode_attention(
+            q, k, v, t, p, interpret=True
+        )
+    )
+    q = jnp.asarray(rng.standard_normal((slots, heads, d)).astype(np.float32))
+    for seed in (0, 1, 2):
+        r2 = np.random.default_rng(seed)
+        tables = jnp.asarray(
+            r2.permutation(np.arange(1, num_blocks)).reshape(slots, nbs),
+            jnp.int32,
+        )
+        pos = jnp.asarray(r2.integers(0, nbs * block_size, slots), jnp.int32)
+        out = f(q, k_pool, v_pool, tables, pos)
+        ref = xla_decode_attention(
+            q, gather_paged_kv(k_pool, tables),
+            gather_paged_kv(v_pool, tables), pos,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+    assert f._cache_size() == 1
+
+
+def test_paged_decode_attention_rejects_bad_shapes():
+    from bpe_transformer_tpu.kernels.pallas.decode_attention import (
+        paged_decode_attention,
+    )
+
+    q = jnp.zeros((2, 4, 16))
+    pool = jnp.zeros((9, 2, 8, 16))
+    tables = jnp.zeros((2, 4), jnp.int32)
+    pos = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="tables"):
+        paged_decode_attention(q, pool, pool, jnp.zeros((3, 4), jnp.int32),
+                               pos, interpret=True)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        paged_decode_attention(q, pool, jnp.zeros((9, 2, 8, 8)), tables,
+                               pos, interpret=True)
+    with pytest.raises(ValueError, match="int8"):
+        paged_decode_attention(q, pool, pool, tables, pos,
+                               k_scale=jnp.zeros((9, 2)), interpret=True)
+    with pytest.raises(ValueError, match="not divisible"):
+        paged_decode_attention(jnp.zeros((2, 5, 16)), pool, pool, tables,
+                               pos, interpret=True)

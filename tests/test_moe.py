@@ -204,6 +204,7 @@ def test_moe_lm_trains():
 
 
 @pytest.mark.parametrize("dispatch", ["einsum", "gather"])
+@pytest.mark.slow
 def test_ep_step_matches_single_device(dispatch):
     """dp_ep GSPMD step on a (data, expert) mesh reproduces the single-device
     update (routing and capacity drops are deterministic) — for BOTH dispatch
@@ -395,6 +396,7 @@ def test_pp_moe_loop_trains():
     assert summary["history"][-1]["loss"] < summary["history"][0]["loss"]
 
 
+@pytest.mark.slow
 def test_fsdp_ep_step_matches_single_device():
     """fsdp_ep: dense params sharded ZeRO-style over data while expert
     stacks shard over the expert axis — the full CLI strategy matrix row."""

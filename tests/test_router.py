@@ -443,3 +443,64 @@ def test_router_two_replicas_drain_failover_and_rejoin():
             server.shutdown()
             server.server_close()
             serving.close()
+
+
+def test_router_session_affinity_sticky_and_fallback():
+    """ISSUE 9 satellite: a "session" body key makes routing sticky — the
+    hashed home replica is tried FIRST even when weighted order prefers a
+    peer — with weighted fallback (and an honest affinity-miss count)
+    when the home is down; sessionless traffic keeps pure weighting."""
+    import zlib
+
+    # a: index 0, deliberately the WORSE-weighted replica.
+    a = _FakeReplica(slots=2, active=1)
+    b = _FakeReplica(slots=8)
+    try:
+        router = Router([a.url, b.url])
+        router.poll_once()
+        # A session that hashes to index 0 (replica a).
+        session = next(
+            s for s in (str(i) for i in range(64))
+            if zlib.crc32(s.encode()) % 2 == 0
+        )
+        assert router.sticky_replica(session).url == a.url
+
+        def body(sess=None):
+            payload = {"prompt_ids": [1, 2], "max_new_tokens": 2}
+            if sess is not None:
+                payload["session"] = sess
+            return json.dumps(payload).encode()
+
+        for _ in range(2):
+            code, payload = router.handle_generate(body(session))
+            assert code == 200 and payload["replica"] == a.url, (
+                "sticky home must beat weighted order"
+            )
+        # Sessionless traffic still goes to the better-weighted replica.
+        code, payload = router.handle_generate(body())
+        assert code == 200 and payload["replica"] == b.url
+
+        page = router.statusz()
+        assert page["session_requests"] == 2
+        assert page["affinity_hits"] == 2
+        assert page["affinity_hit_rate"] == 1.0
+
+        # Home down -> weighted fallback serves the session (counted as a
+        # miss: its prefix blocks start cold on the peer).
+        a.close()
+        code, payload = router.handle_generate(body(session))
+        assert code == 200 and payload["replica"] == b.url
+        page = router.statusz()
+        assert page["session_requests"] == 3
+        assert page["affinity_hits"] == 2
+        assert abs(page["affinity_hit_rate"] - 2 / 3) < 1e-6
+
+        prom = router.prometheus_metrics()
+        assert "bpe_tpu_router_session_requests_total 3" in prom
+        assert "bpe_tpu_router_affinity_hits_total 2" in prom
+    finally:
+        for replica in (a, b):
+            try:
+                replica.close()
+            except Exception:  # noqa: BLE001 — a may already be closed
+                pass

@@ -64,6 +64,7 @@ def setup():
 # ------------------------------------------------------------------ engine
 
 
+@pytest.mark.slow
 def test_batched_parity_with_sequential_sampling(setup):
     """ACCEPTANCE: at temperature=0 the engine serving ragged prompts
     through a 3-slot pool produces byte-identical completions to sequential

@@ -146,6 +146,7 @@ def test_restore_opt_state_all_crossings():
 # ------------------------------------------------------------ step parity
 
 
+@pytest.mark.slow
 def test_zero1_dp_step_matches_plain_dp():
     """The reduce-scatter/all-gather update reproduces the pmean+replicated
     AdamW step exactly, and per-chip optimizer bytes drop ~1/N."""
@@ -331,6 +332,7 @@ def test_zero1_rejects_unsupported_combinations():
 # -------------------------------------------------------- donation audit
 
 
+@pytest.mark.slow
 def test_train_step_donation_no_copies():
     """All three train-step variants donate params+opt-state (the update
     happens in place in device memory: inputs are invalidated), while the
@@ -471,6 +473,7 @@ def _loop_common(tmp_path, **overrides):
     return LoopConfig(**base)
 
 
+@pytest.mark.slow
 def test_loop_zero1_end_to_end(tmp_path):
     """End to end through train() with prefetch on: resources records carry
     the ~1/N per-chip opt-state bytes (vs the dense state's, computed

@@ -1059,6 +1059,7 @@ def test_dynamics_enabled_train_step_exports_per_layer_stats():
     assert "first_nonfinite" not in flat  # clean run
 
 
+@pytest.mark.slow
 def test_dynamics_rides_scanned_and_grad_accum_variants():
     import jax
 
@@ -1511,6 +1512,7 @@ def _counting_attr_train(monkeypatch, byte_data, tmp_path, attribution_every):
     return load_records(jsonl), counts
 
 
+@pytest.mark.slow
 def test_attribution_loop_emits_records_at_bounded_fetch_cost(
     monkeypatch, tmp_path, byte_data
 ):
@@ -1700,6 +1702,7 @@ def test_report_serving_total_p99_and_dominant_phase(capsys):
     assert "slow tail dominated by decode" in out
 
 
+@pytest.mark.slow
 def test_profile_cli_smoke(tmp_path, capsys):
     """ACCEPTANCE (CPU degraded mode): bpe-tpu profile runs the cost model
     + measured split end to end on CPU, writes a schema-valid attribution

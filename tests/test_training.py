@@ -77,6 +77,7 @@ def test_dp_training_runs(byte_data):
     assert np.isfinite(summary["final_train_loss"])
 
 
+@pytest.mark.slow
 def test_cli_end_to_end(tmp_path, tiny_corpus, capsys):
     """The full user journey: train-tokenizer -> tokenize -> train -> eval ->
     generate, all through the CLI."""
@@ -295,6 +296,7 @@ def test_chunked_loss_step_matches_full(byte_data):
     )
 
 
+@pytest.mark.slow
 def test_scanned_train_step_matches_sequential():
     """inner_steps>1 (lax.scan over the update) is the SAME math as the
     per-step path: identical params after N updates on identical batches."""
@@ -359,6 +361,7 @@ def test_loop_inner_steps_trains_and_logs(tmp_path):
     assert summary["history"][-1]["loss"] < summary["history"][0]["loss"]
 
 
+@pytest.mark.slow
 def test_grad_accum_matches_full_batch_step():
     """accum_steps microbatch gradients averaged in-scan == one step on the
     concatenated batch (the loss is a mean over equal-size microbatches)."""

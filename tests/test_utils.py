@@ -29,6 +29,7 @@ def test_time_fn_reports_timings():
     assert 0 < out["best_s"] <= out["mean_s"]
 
 
+@pytest.mark.slow
 def test_profile_trace_writes_artifacts(tmp_path):
     logdir = tmp_path / "trace"
     with profile_trace(str(logdir)):
