@@ -65,12 +65,18 @@ def _pctl(values, q):
 def _make_engine(params, config, *, concurrency, n_requests, args):
     from bpe_transformer_tpu.serving import ServingEngine
 
+    draft_spec = None
+    if args.speculate:
+        from bpe_transformer_tpu.serving import DraftSpec
+
+        draft_spec = DraftSpec(truncate_layers=args.draft_layers)
     return ServingEngine(
         params, config, slots=concurrency, max_queue=n_requests + 1,
         paged=args.paged, block_size=args.block_size,
         prefill_chunk=args.prefill_chunk,
         prefill_token_budget=args.prefill_budget,
         kv_dtype=None if args.kv_dtype == "act" else args.kv_dtype,
+        speculate_k=args.speculate, draft_spec=draft_spec,
     )
 
 
@@ -132,7 +138,7 @@ def _paged_row_fields(serving, baseline):
             "prefix_cache_misses", 0
         )
         rate = round(hits / (hits + misses), 6) if hits + misses else None
-    return {
+    out = {
         "engine": stats.get("engine_kind", "dense"),
         "prefill_compute_s": round(
             _prefill_compute_s(stats) - _prefill_compute_s(baseline), 4
@@ -147,6 +153,45 @@ def _paged_row_fields(serving, baseline):
         "kv_bytes_per_token": stats.get("kv_bytes_per_token"),
         "decode_p95_s": stats["phase_p95_s"]["decode"],
     }
+    if stats.get("spec_k") is not None:
+        # Speculative-decoding evidence (ISSUE 10), warmup excluded: the
+        # acceptance rate of the timed traffic, tokens emitted per target
+        # verify pass (1.0 = non-speculative, k+1 = ceiling), and the
+        # draft's share of spec-tick wall — the overhead acceptance pays.
+        proposed = stats["spec_proposed_tokens"] - baseline.get(
+            "spec_proposed_tokens", 0
+        )
+        accepted = stats["spec_accepted_tokens"] - baseline.get(
+            "spec_accepted_tokens", 0
+        )
+        steps = stats["spec_target_steps"] - baseline.get(
+            "spec_target_steps", 0
+        )
+        emitted = stats["spec_emitted_tokens"] - baseline.get(
+            "spec_emitted_tokens", 0
+        )
+        draft_s = stats["spec_draft_time_s"] - baseline.get(
+            "spec_draft_time_s", 0.0
+        )
+        tick_s = stats["spec_tick_time_s"] - baseline.get(
+            "spec_tick_time_s", 0.0
+        )
+        out.update({
+            "speculate_k": stats["spec_k"],
+            "accept_rate": (
+                round(accepted / proposed, 6) if proposed else None
+            ),
+            "tokens_per_target_step": (
+                round(emitted / steps, 6) if steps else None
+            ),
+            "draft_overhead_frac": (
+                round(draft_s / tick_s, 6) if tick_s > 0 else None
+            ),
+            "rewound_tokens": stats["spec_rewound_tokens"] - baseline.get(
+                "spec_rewound_tokens", 0
+            ),
+        })
+    return out
 
 
 def run_cell(params, config, *, concurrency, n_requests, new_tokens, args,
@@ -469,6 +514,16 @@ def main() -> int:
                         help="decode-step attention impl ('paged': the "
                         "block-pool-native flash kernel, no gather "
                         "transient; needs --paged)")
+    parser.add_argument("--speculate", type=int, default=0, metavar="K",
+                        help="speculative decoding (needs --paged): a "
+                        "truncated-layer draft proposes K tokens/slot per "
+                        "tick, one target verify pass judges them; rows "
+                        "carry accept_rate / tokens_per_target_step / "
+                        "draft_overhead_frac")
+    parser.add_argument("--draft-layers", type=int, default=1,
+                        help="draft = the target's first N transformer "
+                        "blocks (shared weights, zero extra memory; "
+                        "with --speculate)")
     parser.add_argument("--restart", action="store_true",
                         help="restart-to-traffic mode: time a replica "
                         "from spawn to first token through the router "
@@ -481,6 +536,9 @@ def main() -> int:
         return 2
     if args.kv_dtype == "int8" and not args.paged:
         print("--kv-dtype int8 needs --paged", file=sys.stderr)
+        return 2
+    if args.speculate and not args.paged:
+        print("--speculate needs --paged", file=sys.stderr)
         return 2
 
     if args.restart:
@@ -543,6 +601,8 @@ def main() -> int:
             engine += f"-{args.kv_dtype}"
         if args.decode_attention:
             engine += f"-{args.decode_attention}"
+        if args.speculate:
+            engine += f"-spec{args.speculate}"
         print(
             json.dumps(
                 {

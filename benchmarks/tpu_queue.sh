@@ -312,6 +312,20 @@ run_job serve_open_pnative_i8 900 "$CAP/serving_paged.jsonl" \
   --paged --block-size 32 --prefill-chunk 64 --prefill-budget 128 \
   --decode-attention paged --kv-dtype int8
 
+# Speculative decoding (ISSUE 10): the same arrival process through the
+# spec engine — a 1-layer truncated-view draft proposes 4 tokens per slot
+# per tick, one batched target verify pass judges them, rejected KV rolls
+# back through the kvpool rewind.  Rows carry accept_rate /
+# tokens_per_target_step / draft_overhead_frac; the self-report at the
+# end judges them against the paged headline (tokens_per_target_step >> 1
+# is the win — each emitted token costs a fraction of a target HBM
+# sweep).
+run_job serve_open_spec 900 "$CAP/serving_paged.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --concurrency 8 --requests 64 --qps 8 --shared-prefix-len 64 \
+  --paged --block-size 16 --prefill-chunk 64 --prefill-budget 128 \
+  --speculate 4 --draft-layers 1
+
 # Restart-to-traffic (ROADMAP item 5): one row timing a serve replica
 # from SPAWN to first token through the router's rejoin path, cold vs
 # `bpe-tpu warmup`-warmed compile cache — the rolling-deploy window.
@@ -588,6 +602,58 @@ print("  ".join(parts))
 PY
 )
   [ -n "$NATIVE_LINE" ] && log "paged-native/int8 self-report: $NATIVE_LINE"
+fi
+# Speculative-decoding self-report (jax-free, CPU-only): the newest spec
+# row's acceptance evidence vs the paged headline under the same Poisson
+# arrivals — accept_rate, emitted tokens per target verify pass (>1 means
+# each token cost a fraction of a target HBM sweep), the draft's wall
+# overhead, and the tok/s + p99 guardrails.
+if [ -s "$CAP/serving_paged.jsonl" ]; then
+  SPEC_LINE=$(env JAX_PLATFORMS=cpu python - "$CAP/serving_paged.jsonl" <<'PY'
+import json, sys
+
+spec = paged = None
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if not ln:
+        continue
+    try:
+        r = json.loads(ln)
+    except json.JSONDecodeError:
+        continue
+    if "qps_target" not in r:
+        continue
+    if r.get("engine") == "spec":
+        spec = r  # newest spec row wins
+    elif r.get("engine") == "paged" and r.get("decode_attention") in (
+        None, "xla"
+    ) and r.get("kv_dtype") != "int8":
+        paged = r  # the gather-path paged headline
+if spec is None:
+    sys.exit(0)
+
+
+def num(v, d=4):
+    return f"{v:,.{d}g}" if isinstance(v, (int, float)) else "n/a"
+
+
+parts = [
+    f"k={spec.get('speculate_k')}",
+    f"accept_rate {num(spec.get('accept_rate'))}",
+    f"tok/target-step {num(spec.get('tokens_per_target_step'))}",
+    f"draft overhead {num(spec.get('draft_overhead_frac'))}",
+    f"tok/s {num(spec.get('gen_tok_per_s'))}"
+    + (f" (paged {num(paged.get('gen_tok_per_s'))})" if paged else ""),
+    f"p99 {num(spec.get('latency_p99_s'))}s"
+    + (f" (paged {num(paged.get('latency_p99_s'))}s)" if paged else ""),
+]
+tpts = spec.get("tokens_per_target_step")
+if isinstance(tpts, (int, float)) and tpts <= 1.0:
+    parts.append("WARNING: speculation emitted <= 1 token per target step")
+print("  ".join(parts))
+PY
+)
+  [ -n "$SPEC_LINE" ] && log "speculative-decoding self-report: $SPEC_LINE"
 fi
 # Restart-to-traffic self-report (jax-free, CPU-only): the newest restart
 # row's cold vs warmed spawn->first-token seconds — ROADMAP item 5's

@@ -657,6 +657,144 @@ def paged_chunk_prefill(
     return head_logits(last, head), new_pool
 
 
+def paged_verify_step(
+    params: Params,
+    tokens: Array,
+    positions: Array,
+    rooms: Array,
+    pool: KVCache,
+    tables: Array,
+    config: ModelConfig,
+    lm_head: Array | None = None,
+    active: Array | None = None,
+    *,
+    block_size: int,
+) -> tuple[Array, KVCache]:
+    """Batched multi-position scoring pass — the speculative-decoding
+    verify program's forward (`serving/spec/`), generalizing
+    :func:`paged_decode_step` from one token per slot to ``K+1``.
+
+    ``tokens`` (slots, K+1): each slot's not-yet-written last token followed
+    by its K draft proposals; ``positions`` (slots,) the absolute position
+    of ``tokens[:, 0]``; ``rooms`` (slots,) how many PROPOSAL rows are real
+    for this slot (rows ``0..rooms[s]`` are written/scored; beyond that the
+    scatter steers to the trash block and the outputs are host-ignored —
+    one fixed-``K`` program serves every per-slot headroom).  All K+1
+    tokens' K/V scatter into the pool through the block table exactly as a
+    chunk prefill would (a K-length chunk IS a scoring pass), then every
+    row attends to the slot's full gathered cache under the causal frontier
+    ``key_pos <= positions + row``.  Returns logits ``(slots, K+1, vocab)``
+    — row ``j`` is the target distribution for position ``positions+j+1``
+    — and the updated pool.
+
+    The serving layer rolls the written frontier back over rejected rows
+    afterwards (`PagedEngine.rewind`): positions beyond the accepted
+    prefix hold stale K/V that the mask keeps invisible until the next
+    verify overwrites them.
+
+    int8 pools quantize rows SEQUENTIALLY via a ``lax.scan`` over the K+1
+    rows with the decode-row quantizer (`_quantize_decode_row`), preserving
+    its rescale-on-grow semantics: rows land mid-block next to earlier
+    valid rows, so the chunk-prefill scale RESET would corrupt them.  The
+    pass's readers then see each block's FINAL scale (plain ticks see the
+    scale as of their own step), so int8 verify logits match K+1 plain
+    ticks within quantization error, not bitwise — the act-width path is
+    exact.  Attention is the materialized-scores formulation (as in
+    :func:`paged_chunk_prefill`): the chunk-vs-whole-cache shape has no
+    flash kernel, and ``decode_attention_impl`` only governs the 1-token
+    tick.
+    """
+    s, k1 = tokens.shape
+    ctx = config.context_length
+    nb = tables.shape[1]
+    pos_j = positions[:, None] + jnp.arange(k1)[None, :]  # (S, K+1)
+    safe_pos = jnp.clip(pos_j, 0, ctx - 1)
+    valid = (jnp.arange(k1)[None, :] <= rooms[:, None]) & (pos_j <= ctx - 1)
+    if active is not None:
+        valid = valid & active[:, None]
+    idx = jnp.clip(safe_pos // block_size, 0, nb - 1)
+    write_ids = jnp.where(valid, jnp.take_along_axis(tables, idx, axis=1), 0)
+    offsets = safe_pos % block_size
+    quantized = "k_scale" in pool[0]
+
+    x = embedding(params["token_embeddings"], tokens)  # (S, K+1, d)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(config.d_head, jnp.float32))
+    # (S, K+1, ctx) causal frontier: key j visible to row i iff j <= pos_i.
+    mask = jnp.arange(nb * block_size)[None, None, :] <= pos_j[:, :, None]
+
+    def _quant_verify_rows(pool_arr, scale_arr, rows):
+        """Sequential per-row int8 scatter (rows (S, K+1, kv, d)): each row
+        applies the decode quantizer against the scale state the previous
+        row left — the same write order as K+1 plain decode ticks."""
+
+        def step(carry, inp):
+            arr, sc = carry
+            row, ids, off = inp
+            return _quantize_decode_row(arr, sc, row, ids, off), None
+
+        (pool_arr, scale_arr), _ = jax.lax.scan(
+            step,
+            (pool_arr, scale_arr),
+            (
+                jnp.swapaxes(rows, 0, 1),
+                jnp.swapaxes(write_ids, 0, 1),
+                jnp.swapaxes(offsets, 0, 1),
+            ),
+        )
+        return pool_arr, scale_arr
+
+    new_pool = []
+    for block_params, layer_pool in zip(params["layers"], pool):
+
+        def attend(h, block_params=block_params, layer_pool=layer_pool):
+            q, k, v = _project_qkv(h, block_params["attn"], config)
+            q, k = _rope_qk(q, k, safe_pos, config)
+            k_rows = jnp.swapaxes(k, 1, 2)  # (S, K+1, kv, d)
+            v_rows = jnp.swapaxes(v, 1, 2)
+            if quantized:
+                k_pool, k_scale = _quant_verify_rows(
+                    layer_pool["k"], layer_pool["k_scale"], k_rows
+                )
+                v_pool, v_scale = _quant_verify_rows(
+                    layer_pool["v"], layer_pool["v_scale"], v_rows
+                )
+                new_pool.append(
+                    {"k": k_pool, "v": v_pool,
+                     "k_scale": k_scale, "v_scale": v_scale}
+                )
+                k_cache = gather_paged_kv_dequant(
+                    k_pool, k_scale, tables, h.dtype
+                )
+                v_cache = gather_paged_kv_dequant(
+                    v_pool, v_scale, tables, h.dtype
+                )
+            else:
+                k_pool = layer_pool["k"].at[write_ids, :, offsets, :].set(
+                    k_rows
+                )
+                v_pool = layer_pool["v"].at[write_ids, :, offsets, :].set(
+                    v_rows
+                )
+                new_pool.append({"k": k_pool, "v": v_pool})
+                k_cache = gather_paged_kv(k_pool, tables)
+                v_cache = gather_paged_kv(v_pool, tables)
+            k_full = _expand_kv(k_cache, config)
+            v_full = _expand_kv(v_cache, config)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_full) * scale
+            scores = jnp.where(mask[:, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(
+                scores.astype(jnp.float32), axis=-1
+            ).astype(h.dtype)
+            att = merge_heads(jnp.einsum("bhqk,bhkd->bhqd", probs, v_full))
+            return linear(att, block_params["attn"]["output_proj"])
+
+        x = _block_apply(x, block_params, config, attend)
+
+    x = _norm(x, params["ln_final"], config)
+    head = lm_head_weight(params, config) if lm_head is None else lm_head
+    return head_logits(x, head), new_pool
+
+
 def _sample_from_logits(
     logits, key, temperature: float, top_k: int | None, top_p: float | None = None
 ):

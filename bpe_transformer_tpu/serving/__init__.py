@@ -40,16 +40,22 @@ __getattr__ = lazy_attrs(
         "make_http_server": "server",
         "PagedEngine": "kvpool.paged_engine",
         "NoFreeBlocksError": "kvpool.blocks",
+        "DraftSpec": "spec.draft",
+        "DraftModel": "spec.draft",
+        "SpecEngine": "spec.engine",
         "Router": "router",
         "make_router_http_server": "router",
     },
 )
 
 __all__ = [
+    "DraftModel",
+    "DraftSpec",
     "FifoScheduler",
     "LatencyHistogram",
     "NoFreeBlocksError",
     "PagedEngine",
+    "SpecEngine",
     "PrefillBudget",
     "QueueFullError",
     "Request",

@@ -66,19 +66,17 @@ def default_prefill_buckets(
     return tuple(buckets)
 
 
-def sample_tokens(logits, keys, temps, top_ks, top_ps):
-    """Per-row sampling with RUNTIME knobs: ``temps`` (0 = greedy),
-    ``top_ks`` (0 = disabled), ``top_ps`` (>= 1 effectively disabled).
+def filter_logits(logits, temps, top_ks, top_ps):
+    """Temperature-scale + top-k/top-p mask ``(batch, vocab)`` logits with
+    RUNTIME ``(batch,)`` knobs — the filtering half of :func:`sample_tokens`.
 
-    Mirrors `models/decode._sample_from_logits` semantics per row — scale by
-    temperature, top-k threshold with ties kept, then nucleus filtering on
-    the top-k-renormalized distribution — but with every knob a traced
-    ``(batch,)`` vector, so one compiled program serves any knob mix.  The
-    cost is a full O(V log V) sort instead of ``lax.top_k`` — the price of
-    runtime ``k``; at serving batch sizes the decode forward dominates.
+    Split out so the speculative-decoding accept/resample math
+    (`serving/spec/`) can reach the *modified distribution* itself
+    (``softmax`` of this return value), not just a sample from it: the
+    Leviathan acceptance rule must compare draft and target probabilities
+    under exactly the knobs the sampler would have applied.
     """
     vocab = logits.shape[-1]
-    greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
 
     # top-k: keep everything >= the k-th largest (ties included, matching
@@ -96,8 +94,23 @@ def sample_tokens(logits, keys, temps, top_ks, top_ps):
     keep = (cum - probs) < top_ps[:, None]  # mass BEFORE each token
     keep = keep.at[:, 0].set(True)  # the argmax always survives
     cutoff = jnp.min(jnp.where(keep, sorted_m, jnp.inf), axis=-1)
-    masked = jnp.where(masked < cutoff[:, None], -jnp.inf, masked)
+    return jnp.where(masked < cutoff[:, None], -jnp.inf, masked)
 
+
+def sample_tokens(logits, keys, temps, top_ks, top_ps):
+    """Per-row sampling with RUNTIME knobs: ``temps`` (0 = greedy),
+    ``top_ks`` (0 = disabled), ``top_ps`` (>= 1 effectively disabled).
+
+    Mirrors `models/decode._sample_from_logits` semantics per row — scale by
+    temperature, top-k threshold with ties kept, then nucleus filtering on
+    the top-k-renormalized distribution (:func:`filter_logits`) — but with
+    every knob a traced ``(batch,)`` vector, so one compiled program serves
+    any knob mix.  The cost is a full O(V log V) sort instead of
+    ``lax.top_k`` — the price of runtime ``k``; at serving batch sizes the
+    decode forward dominates.
+    """
+    greedy = jnp.argmax(logits, axis=-1)
+    masked = filter_logits(logits, temps, top_ks, top_ps)
     sampled = jax.vmap(jax.random.categorical)(keys, masked)
     return jnp.where(temps > 0.0, sampled, greedy)
 

@@ -106,6 +106,17 @@ def _paged_tick_program(
     return nxt, positions, keys_next, pool
 
 
+def _copy_block_program(pool, src, dst):
+    """Copy one block's rows (K/V and, for int8 pools, their scale rows)
+    from pool block ``src`` to ``dst`` — the device half of a
+    copy-on-write rewind (`PagedEngine.rewind`).  ``src``/``dst`` are
+    traced scalars, so every copy shares one compiled program."""
+    return [
+        {name: arr.at[dst].set(arr[src]) for name, arr in layer.items()}
+        for layer in pool
+    ]
+
+
 @dataclasses.dataclass
 class PagedSlotInfo:
     """Host-side bookkeeping for one occupied slot (prefill + decode)."""
@@ -259,6 +270,9 @@ class PagedEngine:
                 _paged_tick_program, config=config, block_size=block_size
             )
         )
+        # Copy-on-write block copy (rewind into a shared block): compiled
+        # only the first time a CoW rewind actually runs.
+        self._copy_jit = jax.jit(_copy_block_program)
 
         self.ticks = 0
         self.tokens_emitted = 0
@@ -276,8 +290,13 @@ class PagedEngine:
     def compiled_programs(self) -> int:
         """XLA programs compiled by this engine so far — bounded by
         ``len(self.buckets) + 1`` (one chunk program per bucket + the
-        tick)."""
-        return self._chunk_jit._cache_size() + self._tick_jit._cache_size()
+        tick), plus one more once a copy-on-write :meth:`rewind` has
+        run."""
+        return (
+            self._chunk_jit._cache_size()
+            + self._tick_jit._cache_size()
+            + self._copy_jit._cache_size()
+        )
 
     def bucket_for(self, length: int) -> int:
         """The smallest chunk bucket holding ``length`` tokens (lengths
@@ -386,6 +405,109 @@ class PagedEngine:
         span = min(prompt_len + eff, ctx)
         return -(-span // self.block_size)  # ceil
 
+    def _alloc_blocks(self, n: int) -> list:
+        """Allocate ``n`` fresh blocks, evicting prefix-cache LRU leaves to
+        cover a shortfall first (the same discipline :meth:`begin` applies
+        to admissions); raises :class:`NoFreeBlocksError` when the pool
+        cannot cover it even then."""
+        shortfall = n - self.allocator.free_count
+        if shortfall > 0 and self.prefix_cache is not None:
+            self.prefix_cache.evict(shortfall)
+        return self.allocator.alloc(n)
+
+    def extend_blocks(self, slot: int, upto_len: int) -> None:
+        """Grow ``slot``'s block chain to cover ``upto_len`` token
+        positions (speculative-decoding scratch: the verify pass writes a
+        few positions beyond the admission's worst-case reservation, and
+        :meth:`rewind` returns whatever the acceptance didn't keep).
+        Raises :class:`NoFreeBlocksError` when the pool is dry — the
+        caller shrinks its speculation window instead of parking."""
+        info = self._slots[slot]
+        if info is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        need = -(-min(upto_len, self.config.context_length) // self.block_size)
+        extra = need - len(info.block_ids)
+        if extra <= 0:
+            return
+        fresh = self._alloc_blocks(extra)
+        start = len(info.block_ids)
+        info.block_ids.extend(fresh)
+        self._tables[slot, start: start + len(fresh)] = fresh
+
+    def rewind(
+        self, slot: int, new_len: int, *, keep_blocks: int | None = None
+    ) -> dict:
+        """Roll ``slot``'s written-KV frontier back to ``new_len`` tokens:
+        positions ``0 .. new_len-1`` stay valid, everything beyond is
+        abandoned (speculative-decoding rejection, or any host-side
+        re-scoring that truncates a sequence).
+
+        * **frontier rollback within a block** is pure bookkeeping — the
+          abandoned rows stay in the pool but every reader masks keys by
+          the slot's position, so they are invisible until overwritten;
+        * **block release across boundaries** — chain blocks wholly beyond
+          the frontier are deref'd (returned to the pool when this was the
+          last reference).  ``keep_blocks`` floors the chain length:
+          mid-flight callers pass their admission-time reservation so a
+          rewind can never give away blocks the request still needs to
+          finish (only speculative scratch beyond it is released);
+        * **copy-on-write** — if the block the NEXT write lands in is
+          shared (radix-indexed, or referenced by another slot), it is
+          replaced by a fresh device copy and the shared copy is never
+          mutated.  The copy may evict prefix-cache leaves and raises
+          :class:`NoFreeBlocksError` when the pool cannot supply the
+          replacement block;
+        * **int8 pools** — block scales are monotone within an occupancy:
+          a rewound row's magnitude stays folded into its block's scale
+          until the block is fully vacated (the next write at offset 0
+          resets it).  Valid rows keep their values (they were rescaled by
+          ``old/new`` whenever the scale grew); writes after the rewind
+          quantize against the possibly-inflated scale, so their precision
+          is bounded by it — the cost of per-block scales, documented
+          rather than repaired.
+
+        Returns ``{"released": n_blocks, "cow": bool}``.  The caller owns
+        position/sampling state — this is a KV-memory primitive.
+        """
+        info = self._slots[slot]
+        if info is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        if slot in self._prefilling:
+            raise ValueError(f"slot {slot} is mid-prefill; cannot rewind")
+        if new_len < 0 or new_len > self.config.context_length:
+            raise ValueError(
+                f"new_len={new_len} outside [0, "
+                f"{self.config.context_length}]"
+            )
+        bs = self.block_size
+        needed = -(-new_len // bs)
+        floor = max(needed, keep_blocks or 0)
+        released = 0
+        if floor < len(info.block_ids):
+            dropped = info.block_ids[floor:]
+            info.block_ids = info.block_ids[:floor]
+            self.allocator.deref(dropped)
+            released = len(dropped)
+            self._tables[slot, floor:] = 0
+        # The block the next write lands in must be exclusively owned:
+        # rewinding into a radix-shared region would otherwise scribble
+        # over blocks other chains still read.
+        cow = False
+        idx = new_len // bs
+        if idx < len(info.block_ids):
+            shared = info.block_ids[idx]
+            if self.allocator.refcount(shared) > 1:
+                fresh = self._alloc_blocks(1)[0]
+                self._pool = self._copy_jit(
+                    self._pool, np.int32(shared), np.int32(fresh)
+                )
+                self.allocator.deref([shared])
+                info.block_ids[idx] = fresh
+                self._tables[slot, idx] = fresh
+                cow = True
+        info.shared_len = min(info.shared_len, new_len)
+        return {"released": released, "cow": cow}
+
     def begin(
         self,
         prompt_ids,
@@ -420,12 +542,8 @@ class PagedEngine:
         matched: list[int] = []
         if self.prefix_cache is not None:
             matched = self.prefix_cache.match([int(t) for t in prompt])
-        new_needed = need - len(matched)
-        shortfall = new_needed - self.allocator.free_count
-        if shortfall > 0 and self.prefix_cache is not None:
-            self.prefix_cache.evict(shortfall)
         try:
-            fresh = self.allocator.alloc(new_needed)
+            fresh = self._alloc_blocks(need - len(matched))
         except NoFreeBlocksError:
             if matched:
                 self.allocator.deref(matched)

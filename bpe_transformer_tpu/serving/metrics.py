@@ -406,6 +406,32 @@ def render_prometheus(
              "layers — the unit of the attention read stream (int8 halves "
              "bf16, quarters f32).",
              [({}, engine_stats.get("kv_bytes_per_token"))])
+        # Speculative-decoding gauges (present only when the engine is a
+        # SpecEngine): acceptance rate and emitted-tokens-per-verify-pass
+        # are the whole subsystem's health in two numbers.
+        emit("spec_k", "gauge",
+             "Speculation window: draft tokens proposed per slot per tick.",
+             [({}, engine_stats.get("spec_k"))])
+        emit("spec_proposed_tokens_total", "counter",
+             "Draft tokens judged by target verify passes.",
+             [({}, engine_stats.get("spec_proposed_tokens"))])
+        emit("spec_accepted_tokens_total", "counter",
+             "Judged draft tokens the target accepted.",
+             [({}, engine_stats.get("spec_accepted_tokens"))])
+        emit("spec_accept_rate", "gauge",
+             "Cumulative draft-token acceptance rate "
+             "(accepted / proposed).",
+             [({}, engine_stats.get("spec_accept_rate"))])
+        emit("spec_tokens_per_target_step", "gauge",
+             "Decode tokens emitted per target verify pass (1.0 = "
+             "non-speculative; k+1 = every guess accepted + bonus).",
+             [({}, engine_stats.get("spec_tokens_per_target_step"))])
+        emit("spec_rewound_tokens_total", "counter",
+             "Stale KV positions rolled back after rejected speculation.",
+             [({}, engine_stats.get("spec_rewound_tokens"))])
+        emit("spec_draft_frac", "gauge",
+             "Fraction of spec-tick wall time spent in the draft propose.",
+             [({}, engine_stats.get("spec_draft_frac"))])
 
     if resources:
         emit("compile_events_total", "counter",

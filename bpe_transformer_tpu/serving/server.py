@@ -193,12 +193,36 @@ class ServingEngine:
         prefill_token_budget: int | None = None,
         prefix_cache: bool = True,
         kv_dtype: str | None = None,
+        speculate_k: int = 0,
+        draft_spec=None,
     ):
         # Count XLA compiles (the engine's bucketed prefills included) into
         # the process-wide telemetry.resources counter before the first
         # program builds.
         install_compile_counter()
-        if paged:
+        if speculate_k and not paged:
+            raise ValueError(
+                "speculate_k needs paged=True (the verify pass scores "
+                "through the paged scatter; the KV rewind lives in the "
+                "block pool)"
+            )
+        if speculate_k:
+            from bpe_transformer_tpu.serving.spec.engine import SpecEngine
+
+            if draft_spec is None:
+                raise ValueError(
+                    "speculate_k needs a draft_spec (DraftSpec or a "
+                    "prebuilt DraftModel)"
+                )
+            self.engine = SpecEngine(
+                params, config, draft=draft_spec, speculate_k=speculate_k,
+                slots=slots, block_size=block_size,
+                num_blocks=num_kv_blocks,
+                prefill_buckets=prefill_buckets, min_bucket=min_bucket,
+                prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+                kv_dtype=kv_dtype,
+            )
+        elif paged:
             from bpe_transformer_tpu.serving.kvpool.paged_engine import (
                 PagedEngine,
             )
@@ -216,6 +240,10 @@ class ServingEngine:
                 prefill_buckets=prefill_buckets, min_bucket=min_bucket,
             )
         self.paged = paged
+        #: Speculative decoding active (the engine is a SpecEngine): the
+        #: stats/statusz/metrics surfaces grow the acceptance gauges and
+        #: the engine-record cadence emits kind="spec" records.
+        self.spec = bool(speculate_k)
         #: Chunked-prefill fairness (paged only): prefill tokens allowed
         #: between consecutive decode ticks (None = run chunks to
         #: completion, the dense engine's schedule).
@@ -460,7 +488,9 @@ class ServingEngine:
         engine adds the kvpool gauges (block occupancy, prefix-cache
         hit/miss counters, chunked-prefill queue depth)."""
         stats = {
-            "engine_kind": "paged" if self.paged else "dense",
+            "engine_kind": (
+                "spec" if self.spec else "paged" if self.paged else "dense"
+            ),
             "slots": self.engine.n_slots,
             "active_slots": self.engine.active_count,
             "queue_depth": self.scheduler.depth,
@@ -487,7 +517,9 @@ class ServingEngine:
         page = {
             "manifest": self.manifest,
             "uptime_s": round(self.metrics.uptime_s(), 3),
-            "engine_kind": "paged" if self.paged else "dense",
+            "engine_kind": (
+                "spec" if self.spec else "paged" if self.paged else "dense"
+            ),
             # The fleet router reads these to route around a replica that
             # is shutting down (PR-5 drain) or whose worker died, and to
             # weight by free capacity.  Load is reported as OCCUPANCY, not
@@ -495,6 +527,7 @@ class ServingEngine:
             # and a block-starved parked admission is queued work — a
             # replica saturated with prefills must not look idle.
             "draining": self._draining,
+            "speculate_k": self.engine.k if self.spec else None,
             "compiled_programs": self.engine.compiled_programs(),
             "compile_events": resources["compile_events"],
             "prefill_buckets": list(self.engine.buckets),
@@ -970,6 +1003,27 @@ class ServingEngine:
                     "kv_bytes_per_token": gauges["kv_bytes_per_token"],
                 }
             )
+            if self.spec:
+                # Speculative-decoding acceptance on the same cadence: the
+                # accept rate and emitted-tokens-per-verify-pass the
+                # report/monitor/compare surfaces read (ISSUE 10).
+                self._telemetry.emit(
+                    {
+                        "kind": "spec",
+                        "t": round(now - self._t0, 6),
+                        "k": gauges["spec_k"],
+                        "proposed": gauges["spec_proposed_tokens"],
+                        "accepted": gauges["spec_accepted_tokens"],
+                        "emitted": self.engine.spec_emitted,
+                        "target_steps": gauges["spec_target_steps"],
+                        "accept_rate": gauges["spec_accept_rate"],
+                        "tokens_per_target_step": gauges[
+                            "spec_tokens_per_target_step"
+                        ],
+                        "rewound": gauges["spec_rewound_tokens"],
+                        "draft_frac": gauges["spec_draft_frac"],
+                    }
+                )
         self._last_record_t = now
         self._last_record_tokens = tokens
 

@@ -80,6 +80,13 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
             for key in ("kv_pool_bytes", "kv_bytes_per_token"):
                 if record.get(key) is not None:
                     state[key] = record[key]
+        elif kind == "spec":
+            # Speculative-decoding snapshot (serving/spec/): acceptance
+            # rate + emitted-per-verify-pass, the serve panel's spec view.
+            for key in ("k", "accept_rate", "tokens_per_target_step",
+                        "rewound", "draft_frac", "proposed", "accepted"):
+                if key in record:
+                    state[f"spec_{key}"] = record[key]
         elif kind == "resources":
             for key in ("host_rss_bytes", "live_buffer_bytes",
                         "hbm_bytes_in_use", "hbm_peak_bytes_in_use",
@@ -217,6 +224,12 @@ def fold_prometheus(samples: dict, prefix: str = "bpe_tpu") -> dict:
         "kv_prefix_hits": get("prefix_cache_hits_total"),
         "kv_prefix_misses": get("prefix_cache_misses_total"),
         "kv_prefill_pending_tokens": get("prefill_pending_tokens"),
+        # Speculative-decoding gauges (absent on non-spec replicas).
+        "spec_k": get("spec_k"),
+        "spec_accept_rate": get("spec_accept_rate"),
+        "spec_tokens_per_target_step": get("spec_tokens_per_target_step"),
+        "spec_rewound": get("spec_rewound_tokens_total"),
+        "spec_draft_frac": get("spec_draft_frac"),
         "host_rss_bytes": get("host_rss_bytes"),
         "live_buffer_bytes": get("live_buffer_bytes"),
         "hbm_bytes_in_use": get("hbm_bytes_in_use"),
@@ -336,6 +349,21 @@ def render_frame(state: dict, source: str) -> str:
         if state.get("kv_bytes_per_token"):
             parts.append(f"{_num(state['kv_bytes_per_token'])}B/tok")
         lines.append("  kv     " + "  ".join(parts))
+
+    if state.get("spec_k") is not None:
+        parts = [f"k {_num(state['spec_k'])}"]
+        if state.get("spec_accept_rate") is not None:
+            parts.append(f"accept {state['spec_accept_rate']:.0%}")
+        if state.get("spec_tokens_per_target_step") is not None:
+            parts.append(
+                f"tok/target step "
+                f"{_num(state['spec_tokens_per_target_step'], 3)}"
+            )
+        if state.get("spec_draft_frac") is not None:
+            parts.append(f"draft {state['spec_draft_frac']:.0%}")
+        if state.get("spec_rewound"):
+            parts.append(f"rewound {_num(state['spec_rewound'])}")
+        lines.append("  spec   " + "  ".join(parts))
 
     mem_parts = []
     if state.get("hbm_bytes_in_use") is not None:

@@ -306,6 +306,27 @@ def summarize(records: list[dict]) -> dict:
             "kv_bytes_per_token": last.get("kv_bytes_per_token"),
         }
 
+    # Speculative-decoding trajectory (kind="spec", serving/spec/): every
+    # counter is cumulative, so the LAST sample is the run's verdict —
+    # accept_rate tells whether the draft earns its keep,
+    # tokens_per_target_step how many HBM sweeps each emitted token cost.
+    spec_records = [r for r in records if r.get("kind") == "spec"]
+    spec_summary = None
+    if spec_records:
+        last = spec_records[-1]
+        spec_summary = {
+            "n": len(spec_records),
+            "k": last.get("k"),
+            "proposed": last.get("proposed"),
+            "accepted": last.get("accepted"),
+            "accept_rate": last.get("accept_rate"),
+            "emitted": last.get("emitted"),
+            "target_steps": last.get("target_steps"),
+            "tokens_per_target_step": last.get("tokens_per_target_step"),
+            "rewound": last.get("rewound"),
+            "draft_frac": last.get("draft_frac"),
+        }
+
     health_last = {}
     for record in steps:
         for key, value in record.items():
@@ -562,6 +583,7 @@ def summarize(records: list[dict]) -> dict:
         },
         "serving": serving,
         "kvpool": kvpool_summary,
+        "spec": spec_summary,
         "resources": resource_summary,
         "attribution": attribution_summary,
         "dynamics": dynamics_summary,
@@ -730,6 +752,36 @@ def render_report(records: list[dict]) -> str:
                     else ""
                 )
             )
+
+    sp = s.get("spec")
+    if sp:
+        lines.append(f"== speculative decoding ({sp['n']} samples) ==")
+        rate = sp.get("accept_rate")
+        lines.append(
+            f"  k {_fmt(sp['k'])}"
+            f"  proposed {_fmt(sp['proposed'])}"
+            f"  accepted {_fmt(sp['accepted'])}"
+            + (f"  accept rate {rate:.1%}" if isinstance(rate, float) else "")
+        )
+        tpts = sp.get("tokens_per_target_step")
+        lines.append(
+            f"  emitted {_fmt(sp['emitted'])} tokens over "
+            f"{_fmt(sp['target_steps'])} target verify passes"
+            + (
+                f"  ({tpts:.2f} tokens/target step)"
+                if isinstance(tpts, float)
+                else ""
+            )
+        )
+        frac = sp.get("draft_frac")
+        lines.append(
+            f"  rewound {_fmt(sp['rewound'])} stale KV positions"
+            + (
+                f"  draft overhead {frac:.1%} of tick wall"
+                if isinstance(frac, float)
+                else ""
+            )
+        )
 
     rs = s["resources"]
     if rs:
@@ -971,6 +1023,15 @@ COMPARE_METRICS: dict = {
         "lower"),
     "kv_pool_bytes": (
         lambda s: (s.get("kvpool") or {}).get("kv_pool_bytes"), "lower"),
+    # Speculative-decoding effectiveness (kind="spec"): a workload whose
+    # draft acceptance falls — or whose emitted-tokens-per-verify-pass
+    # sinks toward 1.0 — lost the tick-count win speculation pays for
+    # (draft drift, a broken rewind, a mis-sized K).
+    "accept_rate": (
+        lambda s: (s.get("spec") or {}).get("accept_rate"), "higher"),
+    "tokens_per_target_step": (
+        lambda s: (s.get("spec") or {}).get("tokens_per_target_step"),
+        "higher"),
     # Per-chip state bytes (optimizer sharding's memory win): a run whose
     # opt_state_bytes shrinks 1/N against the unsharded baseline shows up
     # as an "improved" row; growing back is a gated regression.
@@ -1021,6 +1082,10 @@ def baseline_capture_metrics(capture: dict) -> dict:
         ("params_bytes", "params_bytes_per_chip"),
         ("host_gap_frac", "host_gap_frac"),
         ("collective_frac", "collective_frac"),
+        # Speculative-serving capture rows (bench_serving.py --speculate):
+        # acceptance evidence gates against a later stream's spec records.
+        ("accept_rate", "accept_rate"),
+        ("tokens_per_target_step", "tokens_per_target_step"),
     ):
         value = capture.get(cap_key)
         if isinstance(value, (int, float)) and math.isfinite(value):

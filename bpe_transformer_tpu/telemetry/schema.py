@@ -95,6 +95,20 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
         "kind", "t", "blocks_total", "blocks_free", "blocks_shared",
         "prefix_hits", "prefix_misses",
     },
+    # Speculative-decoding snapshot (serving/server.py, SpecEngine only),
+    # emitted on the engine-record cadence: the fixed window ``k``, the
+    # cumulative draft tokens judged (``proposed``) and kept
+    # (``accepted``), decode tokens emitted by spec ticks (``emitted``)
+    # over ``target_steps`` verify passes, plus the derived
+    # ``accept_rate`` (accepted/proposed, null before any tick),
+    # ``tokens_per_target_step`` (the "ticks saved" number — 1.0 is
+    # non-speculative decode, k+1 the ceiling), ``rewound`` stale KV
+    # positions rolled back, and the draft's share of tick wall time
+    # (optional ``draft_frac``).  ``accept_rate`` and
+    # ``tokens_per_target_step`` feed the report compare gate.
+    "spec": {
+        "kind", "t", "k", "proposed", "accepted", "emitted", "target_steps",
+    },
     # Run trailer: record counts + clean verdict (spans.py Telemetry.footer).
     "footer": {"kind", "t", "record_counts"},
     # Step/val metrics (NO kind key): at least a step number plus one

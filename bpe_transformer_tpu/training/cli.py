@@ -296,6 +296,37 @@ def cmd_serve(args) -> int:
     if args.prompts_file and not args.output:
         print("serve: --prompts-file needs --output", file=sys.stderr)
         return 2
+    # Speculative-decoding flags fail fast BEFORE any accelerator work
+    # (PR 9 style): DraftSpec is jax-free, so a malformed draft config or
+    # a structurally impossible combination costs milliseconds, not a
+    # model load + compile.  The vocab cross-check against the resolved
+    # target config runs right after checkpoint-config resolution below.
+    draft_spec = None
+    if args.speculate:
+        if args.speculate < 1:
+            print(f"serve: --speculate must be >= 1, got {args.speculate}",
+                  file=sys.stderr)
+            return 2
+        if not args.paged:
+            print("serve: --speculate needs --paged (the verify pass "
+                  "scores through the paged scatter; the KV rewind lives "
+                  "in the block pool)", file=sys.stderr)
+            return 2
+        if not args.draft_config:
+            print("serve: --speculate needs --draft-config (a DraftSpec "
+                  "JSON: tiny geometry or truncate_layers)",
+                  file=sys.stderr)
+            return 2
+        from bpe_transformer_tpu.serving.spec.draft import DraftSpec
+
+        try:
+            draft_spec = DraftSpec.from_json(args.draft_config)
+        except (OSError, ValueError, TypeError) as exc:
+            print(f"serve: bad --draft-config: {exc}", file=sys.stderr)
+            return 2
+    elif args.draft_config:
+        print("serve: --draft-config needs --speculate K", file=sys.stderr)
+        return 2
     if args.compile_cache:
         # Before the engine compiles its bucket ladder: a rolling-restart
         # replica warm-starts from the cache instead of re-paying every
@@ -335,6 +366,16 @@ def cmd_serve(args) -> int:
         model_config = dataclasses.replace(
             model_config, decode_attention_impl=args.decode_attention
         )
+    if draft_spec is not None:
+        # Vocab/geometry compatibility against the RESOLVED target config:
+        # rejection sampling compares distributions over one shared
+        # vocabulary, so a mismatched draft is a configuration error the
+        # server must refuse at startup, not a degraded mode.
+        try:
+            draft_spec.validate_against(model_config)
+        except ValueError as exc:
+            print(f"serve: {exc}", file=sys.stderr)
+            return 2
     stop_id = None
     if tokenizer.special_tokens:
         stop_id = tokenizer.encode(tokenizer.special_tokens[0])[0]
@@ -365,6 +406,8 @@ def cmd_serve(args) -> int:
         prefill_token_budget=args.prefill_budget,
         prefix_cache=not args.no_prefix_cache,
         kv_dtype=None if args.kv_dtype == "act" else args.kv_dtype,
+        speculate_k=args.speculate,
+        draft_spec=draft_spec,
     )
     try:
         with serving:
@@ -452,13 +495,136 @@ def cmd_route(args) -> int:
     return route_main(forwarded)
 
 
+def _warmup_train(args) -> int:
+    """``bpe-tpu warmup --train``: AOT-compile the TRAINING step (+ eval)
+    programs into the persistent compile cache — the supervisor respawn
+    loop's warm-restart path (ROADMAP item 5 remainder).  A respawned
+    ``bpe-tpu train --compile-cache DIR --resume ...`` child then loads
+    its update program from disk instead of re-paying the cold compile
+    after every preemption or crash.
+
+    The cache key is the LOWERED program, so this mirrors the exact step
+    construction ``training/loop.py`` performs for the same flags: same
+    ModelConfig, same TrainHParams constants (hyperparameters are baked
+    into the jit as Python scalars — a different ``--lr`` is a different
+    program), same batch/accum/inner-steps shapes.  Single-device path
+    only (the supervisor story); mesh-parallel runs warm on their own
+    first step."""
+    import jax
+    import jax.numpy as jnp
+
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.optim.adamw import adamw_init
+    from bpe_transformer_tpu.telemetry.resources import (
+        compile_cache_hits,
+        install_compile_counter,
+    )
+    from bpe_transformer_tpu.training.train_step import (
+        TrainHParams,
+        make_eval_step,
+        make_train_step,
+    )
+    from bpe_transformer_tpu.utils.compile_cache import enable_compile_cache
+
+    if args.grad_accum_steps > 1 and args.inner_steps > 1:
+        print("warmup: --grad-accum-steps and --inner-steps are mutually "
+              "exclusive (as in bpe-tpu train)", file=sys.stderr)
+        return 2
+    if args.grad_accum_steps > 1 and args.batch_size % args.grad_accum_steps:
+        print(f"warmup: --batch-size {args.batch_size} must be a multiple "
+              f"of --grad-accum-steps {args.grad_accum_steps}",
+              file=sys.stderr)
+        return 2
+
+    install_compile_counter()
+    enable_compile_cache(args.compile_cache)
+
+    if args.checkpoint:
+        payload, model_config, _ = _load_inference_state(
+            args, need_tokenizer=False
+        )
+        params = jax.device_put(payload["params"])
+    else:
+        # The cache key is the lowered program (shapes/config), not the
+        # weights: random init warms the same entries a checkpoint would.
+        model_config = _load_model_config(args)
+        params = init_params(jax.random.PRNGKey(0), model_config)
+
+    hparams = TrainHParams(
+        max_learning_rate=args.lr,
+        min_learning_rate=(
+            args.min_lr if args.min_lr is not None else args.lr / 10
+        ),
+        warmup_iters=args.warmup,
+        cosine_cycle_iters=args.lr_cycle if args.lr_cycle else args.steps,
+        weight_decay=args.weight_decay,
+        grad_clip_norm=args.grad_clip,
+    )
+    ctx = model_config.context_length
+    batch = args.batch_size
+    # Eval first: the train step donates params/opt_state, so it runs last.
+    eval_step = make_eval_step(model_config)
+    dummy = jnp.zeros((batch, ctx), jnp.int32)
+    jax.block_until_ready(eval_step(params, dummy, dummy))
+
+    health = args.health_stats
+    dynamics = args.dynamics_every > 0
+    if args.inner_steps > 1:
+        from bpe_transformer_tpu.training.train_step import (
+            make_scanned_train_step,
+        )
+
+        step = make_scanned_train_step(
+            model_config, hparams, args.inner_steps,
+            health=health, dynamics=dynamics,
+        )
+        x = jnp.zeros((args.inner_steps, batch, ctx), jnp.int32)
+    elif args.grad_accum_steps > 1:
+        from bpe_transformer_tpu.training.train_step import (
+            make_grad_accum_train_step,
+        )
+
+        step = make_grad_accum_train_step(
+            model_config, hparams, args.grad_accum_steps,
+            health=health, dynamics=dynamics,
+        )
+        x = jnp.zeros(
+            (args.grad_accum_steps, batch // args.grad_accum_steps, ctx),
+            jnp.int32,
+        )
+    else:
+        step = make_train_step(
+            model_config, hparams, health=health, dynamics=dynamics
+        )
+        x = dummy
+    opt_state = adamw_init(params)
+    new_params, new_opt, metrics = step(params, opt_state, x, x)
+    jax.block_until_ready(metrics["loss"])
+    del new_params, new_opt
+
+    print(json.dumps({
+        "mode": "train",
+        "programs_compiled": step._cache_size() + eval_step._cache_size(),
+        "batch_size": batch,
+        "grad_accum_steps": args.grad_accum_steps,
+        "inner_steps": args.inner_steps,
+        "health_stats": health,
+        "cache_dir": str(args.compile_cache),
+        "cache_hits": compile_cache_hits(),
+    }))
+    return 0
+
+
 def cmd_warmup(args) -> int:
     """AOT-compile the serving program ladder into the persistent compile
     cache, so a router-triggered replica restart (or first boot on a fresh
     host sharing the cache dir) reaches traffic without paying the
     20-40 s/program cold compiles — ROADMAP item 5's rolling-deploy
-    story, stub-sized: warm the exact programs ``bpe-tpu serve`` with the
-    same config/engine knobs will request."""
+    story: warm the exact programs ``bpe-tpu serve`` with the same
+    config/engine knobs will request (``--speculate`` adds the draft
+    prefill ladder + propose + verify programs), or — with ``--train`` —
+    the training-step programs the supervisor respawn loop resumes
+    into."""
     import jax
 
     from bpe_transformer_tpu.telemetry.resources import (
@@ -466,6 +632,37 @@ def cmd_warmup(args) -> int:
         install_compile_counter,
     )
     from bpe_transformer_tpu.utils.compile_cache import enable_compile_cache
+
+    if args.train:
+        if args.speculate or args.paged:
+            print("warmup: --train warms the training-step programs; it "
+                  "composes with serving flags in separate invocations, "
+                  "not one", file=sys.stderr)
+            return 2
+        return _warmup_train(args)
+
+    # Speculative-decoding fast-fail (PR 9 style): structural checks and
+    # the jax-free DraftSpec parse before any model/compile work; the
+    # vocab cross-check runs right after config resolution below.
+    draft_spec = None
+    if args.speculate:
+        if not args.paged:
+            print("warmup: --speculate needs --paged", file=sys.stderr)
+            return 2
+        if not args.draft_config:
+            print("warmup: --speculate needs --draft-config",
+                  file=sys.stderr)
+            return 2
+        from bpe_transformer_tpu.serving.spec.draft import DraftSpec
+
+        try:
+            draft_spec = DraftSpec.from_json(args.draft_config)
+        except (OSError, ValueError, TypeError) as exc:
+            print(f"warmup: bad --draft-config: {exc}", file=sys.stderr)
+            return 2
+    elif args.draft_config:
+        print("warmup: --draft-config needs --speculate K", file=sys.stderr)
+        return 2
 
     if (
         args.paged
@@ -503,6 +700,12 @@ def cmd_warmup(args) -> int:
         model_config = dataclasses.replace(
             model_config, decode_attention_impl=args.decode_attention
         )
+    if draft_spec is not None:
+        try:
+            draft_spec.validate_against(model_config)
+        except ValueError as exc:
+            print(f"warmup: {exc}", file=sys.stderr)
+            return 2
 
     factories = []
     kv_dtypes: list[str | None] = [None]
@@ -516,16 +719,25 @@ def cmd_warmup(args) -> int:
         kv_dtypes = {
             "act": [None], "int8": ["int8"], "both": [None, "int8"],
         }[args.kv_dtype]
+        # ONE kwargs list for both engine classes: a knob added here warms
+        # the same ladder serve compiles, spec or not.
+        if args.speculate:
+            from bpe_transformer_tpu.serving import SpecEngine
+
+            cls: type = SpecEngine
+            extra = dict(draft=draft_spec, speculate_k=args.speculate)
+        else:
+            cls, extra = PagedEngine, {}
         for kv_dtype in kv_dtypes:
             # prefix_cache OFF: warmup's point is compiling every ladder
             # rung, and its repeated dummy prompts would otherwise share a
             # prefix and shrink later rungs' chunks into already-compiled
             # programs.
-            factories.append(lambda kv_dtype=kv_dtype: PagedEngine(
+            factories.append(lambda kv_dtype=kv_dtype: cls(
                 params, model_config, slots=args.slots,
                 block_size=args.block_size, num_blocks=args.num_kv_blocks,
                 prefill_chunk=args.prefill_chunk, prefix_cache=False,
-                kv_dtype=kv_dtype,
+                kv_dtype=kv_dtype, **extra,
             ))
     else:
         from bpe_transformer_tpu.serving import SlotPoolEngine
@@ -544,7 +756,15 @@ def cmd_warmup(args) -> int:
         engine = factory()
         if buckets is None:
             buckets = list(engine.buckets)
-        for bucket in engine.buckets:
+        # Speculative engines walk the DRAFT prefill ladder (it runs to
+        # the full context; chunked prefill splits long rungs into the
+        # already-walked chunk buckets), so draft prefill + propose +
+        # verify all warm alongside the target chunk programs.  The
+        # max_new_tokens budget of 2 still exercises a full spec tick.
+        ladder = (
+            engine.draft_buckets if args.speculate else engine.buckets
+        )
+        for bucket in ladder:
             plen = min(bucket, ctx - 2)
             event = engine.admit(
                 [1] * plen, max_new_tokens=2, temperature=0.0
@@ -558,7 +778,10 @@ def cmd_warmup(args) -> int:
     summary = {
         "programs_compiled": programs,
         "buckets": buckets,
-        "engine": "paged" if args.paged else "dense",
+        "engine": (
+            "spec" if args.speculate else "paged" if args.paged else "dense"
+        ),
+        "speculate": args.speculate or None,
         "decode_attention": model_config.decode_attention_impl,
         "kv_dtypes": [d or "act" for d in kv_dtypes] if args.paged else None,
         "cache_dir": str(args.compile_cache),
@@ -1108,6 +1331,22 @@ def build_parser() -> argparse.ArgumentParser:
                    "the per-tick contiguous KV gather; 'pallas' is flash "
                    "decode over the gathered cache; default: checkpoint "
                    "config (xla)")
+    p.add_argument("--speculate", type=int, default=0, metavar="K",
+                   help="speculative decoding (with --paged + "
+                   "--draft-config): a small draft model proposes K "
+                   "tokens per slot per tick, one batched target verify "
+                   "pass scores all of them, and rejection sampling "
+                   "accepts a prefix — the sampling distribution is "
+                   "provably preserved (greedy output is token-identical "
+                   "to non-speculative greedy); each accepted token "
+                   "saves a full target decode tick")
+    p.add_argument("--draft-config", default=None, metavar="JSON",
+                   help="DraftSpec JSON for --speculate: "
+                   '{"truncate_layers": N} shares the target\'s first N '
+                   "blocks (zero extra weight memory), or a tiny "
+                   'geometry {"d_model", "num_layers", "num_heads", '
+                   '"d_ff"[, "num_kv_heads", "seed"]}; the vocabulary '
+                   "must match the target (validated up front)")
     p.add_argument("--special-token", action="append", default=None,
                    help='repeatable; default: ["<|endoftext|>"]')
     p.set_defaults(fn=cmd_serve)
@@ -1166,6 +1405,40 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("xla", "pallas", "paged"), default=None,
                    help="warm this decode-attention ladder (use 'paged' "
                    "for --decode-attention paged replicas)")
+    p.add_argument("--speculate", type=int, default=0, metavar="K",
+                   help="warm the speculative-decoding programs (with "
+                   "--paged + --draft-config): target chunk ladder + "
+                   "verify + draft prefill ladder + propose, exactly "
+                   "what serve --speculate K compiles")
+    p.add_argument("--draft-config", default=None, metavar="JSON",
+                   help="DraftSpec JSON for --speculate (same format as "
+                   "serve --draft-config)")
+    p.add_argument("--train", action="store_true",
+                   help="warm the TRAINING step (+ eval) programs "
+                   "instead of a serving ladder — the supervisor respawn "
+                   "loop's warm-restart path; mirror the train run's "
+                   "--batch-size/--lr/... so the lowered program matches")
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="(--train) batch size of the run to warm")
+    p.add_argument("--steps", type=int, default=1000,
+                   help="(--train) --steps of the run to warm (the "
+                   "cosine cycle length is baked into the program)")
+    p.add_argument("--lr", type=float, default=3e-4,
+                   help="(--train) learning rate of the run to warm")
+    p.add_argument("--min-lr", type=float, default=None)
+    p.add_argument("--warmup", type=int, default=100,
+                   help="(--train) LR warmup iters of the run to warm")
+    p.add_argument("--lr-cycle", type=int, default=None)
+    p.add_argument("--weight-decay", type=float, default=0.01)
+    p.add_argument("--grad-clip", type=float, default=1.0)
+    p.add_argument("--grad-accum-steps", type=int, default=1,
+                   help="(--train) gradient-accumulation microbatches")
+    p.add_argument("--inner-steps", type=int, default=1,
+                   help="(--train) scanned inner steps per dispatch")
+    p.add_argument("--health-stats", action="store_true",
+                   help="(--train) warm the health-stats step variant")
+    p.add_argument("--dynamics-every", type=int, default=0,
+                   help="(--train) warm the dynamics step variant")
     p.set_defaults(fn=cmd_warmup, default_preset="tinystories-4l")
 
     p = sub.add_parser(

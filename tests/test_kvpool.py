@@ -827,9 +827,304 @@ def test_cli_serve_flag_validation():
     from bpe_transformer_tpu.training.cli import cmd_serve
 
     base = dict(prompts_file=None, output=None, compile_cache=None,
-                paged=False)
+                paged=False, speculate=0, draft_config=None)
     args = argparse.Namespace(kv_dtype="int8", decode_attention=None, **base)
     assert cmd_serve(args) == 2
     args = argparse.Namespace(kv_dtype="act", decode_attention="paged",
                               **base)
     assert cmd_serve(args) == 2
+
+
+# --------------------------------------------- KV rewind primitive (ISSUE 10)
+
+
+def _drive_to_decode(engine, prompt, **knobs):
+    """begin + run all prefill chunks; returns the ACTIVE slot (the test
+    owns ticks/rewinds from here)."""
+    slot = engine.begin(prompt, **knobs)
+    event = engine.prefill_step(slot)
+    while event is None:
+        event = engine.prefill_step(slot)
+    assert not event.finished
+    return slot
+
+
+def test_rewind_within_block_is_bookkeeping(setup):
+    """Frontier rollback inside a block releases nothing and copies
+    nothing: abandoned rows stay in the pool, invisible behind the
+    position mask."""
+    params, prompts = setup
+    engine = PagedEngine(
+        params, CFG, slots=1, block_size=8, min_bucket=8, prefix_cache=False
+    )
+    slot = _drive_to_decode(engine, prompts[2], max_new_tokens=4,
+                            temperature=0.0)
+    blocks_before = list(engine._slots[slot].block_ids)
+    free_before = engine.allocator.free_count
+    result = engine.rewind(slot, 13)
+    assert result == {"released": 0, "cow": False}
+    assert engine._slots[slot].block_ids == blocks_before
+    assert engine.allocator.free_count == free_before
+    engine.release(slot)
+    assert engine.allocator.free_count == engine.allocator.usable_blocks
+
+
+def test_rewind_across_block_boundary_releases_blocks(setup):
+    """ACCEPTANCE (satellite): blocks wholly beyond the rewound frontier
+    return to the pool — except below the ``keep_blocks`` floor, which
+    pins the admission reservation mid-flight."""
+    params, prompts = setup
+    engine = PagedEngine(
+        params, CFG, slots=1, block_size=8, min_bucket=8, prefix_cache=False
+    )
+    # 12-token prompt + 12 new = 24 positions = 3 blocks reserved.
+    slot = _drive_to_decode(engine, prompts[2], max_new_tokens=12,
+                            temperature=0.0)
+    assert len(engine._slots[slot].block_ids) == 3
+    # Speculative scratch: grow to the full context (4 blocks).
+    engine.extend_blocks(slot, 32)
+    assert len(engine._slots[slot].block_ids) == 4
+    free_before = engine.allocator.free_count
+    # keep_blocks floors at the reservation: only the scratch comes back.
+    result = engine.rewind(slot, 13, keep_blocks=3)
+    assert result["released"] == 1 and not result["cow"]
+    assert engine.allocator.free_count == free_before + 1
+    assert len(engine._slots[slot].block_ids) == 3
+    assert list(engine._tables[slot][3:]) == [0]
+    # Without the floor the frontier math rules: 13 tokens need 2 blocks.
+    result = engine.rewind(slot, 13)
+    assert result["released"] == 1
+    assert len(engine._slots[slot].block_ids) == 2
+    # Rewinding further than the floor allows is a no-op on the chain.
+    result = engine.rewind(slot, 2, keep_blocks=2)
+    assert result["released"] == 0
+    assert len(engine._slots[slot].block_ids) == 2
+    engine.release(slot)
+    assert engine.allocator.free_count == engine.allocator.usable_blocks
+
+
+def test_rewind_validation_errors(setup):
+    params, prompts = setup
+    engine = PagedEngine(
+        params, CFG, slots=1, block_size=8, min_bucket=8, prefill_chunk=8,
+        prefix_cache=False,
+    )
+    with pytest.raises(ValueError, match="not occupied"):
+        engine.rewind(0, 4)
+    with pytest.raises(ValueError, match="not occupied"):
+        engine.extend_blocks(0, 16)
+    slot = engine.begin(prompts[3], max_new_tokens=4, temperature=0.0)
+    assert engine.prefill_step(slot) is None  # still mid-prefill
+    with pytest.raises(ValueError, match="mid-prefill"):
+        engine.rewind(slot, 4)
+    event = engine.prefill_step(slot)
+    while event is None:
+        event = engine.prefill_step(slot)
+    with pytest.raises(ValueError, match="outside"):
+        engine.rewind(slot, -1)
+    with pytest.raises(ValueError, match="outside"):
+        engine.rewind(slot, CFG.context_length + 1)
+    engine.release(slot)
+
+
+def test_rewind_into_radix_shared_block_copies_on_write(setup):
+    """ACCEPTANCE (satellite): rewinding the frontier into a radix-shared
+    block replaces it with a fresh device copy — the cache's copy is
+    never mutated, other chains keep reading the original bytes, and the
+    copy is bit-identical at copy time."""
+    params, prompts = setup
+    engine = PagedEngine(
+        params, CFG, slots=2, block_size=8, min_bucket=8
+    )
+    prompt = prompts[3][:16]  # 2 full blocks
+    # First generation indexes the prompt's full blocks into the cache.
+    ref = _run(engine, prompt, max_new_tokens=2, temperature=0.0)
+    # Re-admit: the first block arrives radix-shared (match cap plen-1).
+    slot = _drive_to_decode(engine, prompt, max_new_tokens=2,
+                            temperature=0.0)
+    info = engine._slots[slot]
+    assert info.shared_len == 8
+    shared = info.block_ids[0]
+    rc_before = engine.allocator.refcount(shared)
+    assert rc_before >= 2  # cache + this slot
+    old_rows = {
+        layer_idx: np.asarray(layer["k"])[shared].copy()
+        for layer_idx, layer in enumerate(engine._pool)
+    }
+    result = engine.rewind(slot, 4)
+    assert result["cow"] and result["released"] >= 1
+    fresh = info.block_ids[0]
+    assert fresh != shared
+    assert engine._tables[slot][0] == fresh
+    # The shared copy lost exactly this slot's reference; the cache still
+    # serves it, bytes untouched.
+    assert engine.allocator.refcount(shared) == rc_before - 1
+    assert engine.prefix_cache.match([int(t) for t in prompt]) == [shared]
+    engine.allocator.deref([shared])  # drop the match's reference
+    for layer_idx, layer in enumerate(engine._pool):
+        np.testing.assert_array_equal(
+            np.asarray(layer["k"])[fresh], old_rows[layer_idx]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(layer["k"])[shared], old_rows[layer_idx]
+        )
+    # CoW costs exactly one extra compiled program, once.
+    assert engine._copy_jit._cache_size() == 1
+    engine.release(slot)
+    # A later identical prompt still hits the (unmutated) cached prefix.
+    assert _run(engine, prompt, max_new_tokens=2, temperature=0.0) == ref
+
+
+def test_rewind_then_regrow_int8_scales_coherent(setup):
+    """ACCEPTANCE (satellite): int8 block scales stay sound across rewind
+    -> regrow.  Within one occupancy the scale is monotone (rewound rows'
+    magnitude stays folded in — documented, not repaired); a released
+    block re-acquired and written at offset 0 RESETS its base scale, so
+    recycled-block leftovers never leak."""
+    params, prompts = setup
+    engine = PagedEngine(
+        params, CFG, slots=1, block_size=8, min_bucket=8,
+        prefix_cache=False, kv_dtype="int8",
+    )
+    prompt = prompts[0]  # 3 tokens
+    slot = _drive_to_decode(engine, prompt, max_new_tokens=24,
+                            temperature=0.0)
+    # Decode across the first block boundary: positions 3..11.
+    for _ in range(9):
+        engine.tick()
+    assert int(engine._positions[slot]) == 12
+    b1 = engine._slots[slot].block_ids[1]  # holds positions 8..11
+    scale_before = np.asarray(engine._pool[0]["k_scale"])[b1].copy()
+    assert (scale_before > 0).all()
+    # Mid-block rewind (stale rows 10..11), then regrow: the engine's
+    # decode cursor is host state, so emulate the spec engine's usage —
+    # roll KV back and the cursor with it.
+    engine.rewind(slot, 10, keep_blocks=2)
+    engine._positions[slot] = 10
+    for _ in range(4):
+        engine.tick()
+    scale_after = np.asarray(engine._pool[0]["k_scale"])[b1]
+    assert np.isfinite(scale_after).all()
+    assert (scale_after >= scale_before - 1e-7).all(), (
+        "block scale shrank mid-occupancy: rewound rows' magnitude must "
+        "stay folded into the scale until the block is vacated"
+    )
+    # Cross-boundary rewind: release block b1 entirely, then regrow into
+    # a recycled block — offset-0 write resets the base scale (no leak
+    # from the previous occupancy).
+    engine.rewind(slot, 8, keep_blocks=1)
+    assert len(engine._slots[slot].block_ids) == 1
+    engine._positions[slot] = 8
+    engine.extend_blocks(slot, 16)
+    b1_new = engine._slots[slot].block_ids[1]
+    engine.tick()  # writes position 8 = offset 0 of the regrown block
+    fresh_scale = np.asarray(engine._pool[0]["k_scale"])[b1_new]
+    row = np.asarray(engine._pool[0]["k"])[b1_new][:, 0, :]
+    assert (fresh_scale > 0).all()
+    # Reset semantics: the fresh base scale fits exactly one row — the
+    # quantized row must hit the int8 rail (127) for the max head.
+    assert np.abs(row).max() == 127, (
+        "offset-0 regrow did not reset the block scale to the new row"
+    )
+    out_tokens = []
+    while len(out_tokens) < 4:
+        for e in engine.tick():
+            out_tokens.append(e.token)
+    assert all(0 <= t < CFG.vocab_size for t in out_tokens)
+    engine.release(slot)
+
+
+def test_allocator_no_leak_under_rewind_churn(setup):
+    """ACCEPTANCE (satellite): randomized admit / extend / rewind /
+    release churn returns every block — the allocator's free count ends
+    where it started and nothing stays shared."""
+    params, prompts = setup
+    engine = PagedEngine(
+        params, CFG, slots=2, block_size=8, min_bucket=8, prefix_cache=False
+    )
+    usable = engine.allocator.usable_blocks
+    rng = np.random.default_rng(7)
+    for round_idx in range(12):
+        prompt = prompts[int(rng.integers(0, len(prompts)))]
+        new = int(rng.integers(1, 10))
+        try:
+            slot = _drive_to_decode(
+                engine, prompt, max_new_tokens=new, temperature=0.0
+            )
+        except NoFreeBlocksError:
+            continue
+        keep = engine.blocks_needed(len(prompt), new)
+        for _ in range(int(rng.integers(0, 3))):
+            try:
+                engine.extend_blocks(
+                    slot, int(engine._positions[slot]) + int(
+                        rng.integers(1, 8)
+                    )
+                )
+            except NoFreeBlocksError:
+                pass
+            engine.tick()
+            if engine._slots[slot] is None:
+                break  # the tick finished the request (auto-released)
+            engine.rewind(
+                slot, int(engine._positions[slot]), keep_blocks=keep
+            )
+        if engine._slots[slot] is not None:
+            engine.release(slot)
+    assert engine.allocator.free_count == usable
+    assert engine.allocator.shared_count == 0
+
+
+# -------------------------------------------------------- warmup --train
+
+
+@pytest.mark.slow
+def test_warmup_train_cli_warms_supervisor_respawn(tmp_path):
+    """ACCEPTANCE (satellite, ROADMAP item 5 remainder): `bpe-tpu warmup
+    --train` AOT-compiles the training step into the persistent cache,
+    and a REAL `bpe-tpu train --compile-cache` run with matching flags is
+    served from disk — its resources records count cache hits, i.e. the
+    supervisor respawn loop restarts warm."""
+    cache_dir = tmp_path / "xla_cache"
+    data = tmp_path / "tokens.bin"
+    np.random.default_rng(0).integers(
+        0, 200, size=4096, dtype=np.uint16
+    ).tofile(data)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+           "PYTHONPATH": str(REPO)}
+    flags = ["--preset", "ts-test", "--batch-size", "4", "--steps", "3",
+             "--log-every", "1"]
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "bpe_transformer_tpu.training.cli",
+         "warmup", "--train", "--compile-cache", str(cache_dir),
+         "--preset", "ts-test", "--batch-size", "4", "--steps", "3"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["mode"] == "train"
+    assert summary["programs_compiled"] == 2  # train step + eval step
+    assert summary["cache_hits"] == 0
+    assert any(cache_dir.rglob("*")), "warmup --train wrote no cache entries"
+
+    jsonl = tmp_path / "train.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "bpe_transformer_tpu.training.cli",
+         "train", "--data", str(data), "--compile-cache", str(cache_dir),
+         "--metrics-jsonl", str(jsonl),
+         "--eval-every", "1000", "--checkpoint-every", "1000"] + flags,
+        capture_output=True, text=True, timeout=600, env=env, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    records = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+    hits = [
+        r.get("compile_cache_hits")
+        for r in records
+        if r.get("kind") == "resources"
+        and r.get("compile_cache_hits") is not None
+    ]
+    assert hits and max(hits) > 0, (
+        "the warmed train run paid cold compiles (no cache hits in its "
+        f"resources records: {hits})"
+    )
