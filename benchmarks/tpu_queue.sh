@@ -326,6 +326,24 @@ run_job serve_open_spec 900 "$CAP/serving_paged.jsonl" \
   --paged --block-size 16 --prefill-chunk 64 --prefill-budget 128 \
   --speculate 4 --draft-layers 1
 
+# int8-weight quantized decode + fused sample-in-kernel (ISSUE 11): the
+# paged-native arrival process served with (a) per-channel int8 matmul
+# weights dequantized in registers and the tick tail fused into one
+# kernel, and (b) the same on the speculative engine (quantized verify +
+# fused accept/residual).  Rows carry tick_weight_bytes / params_bytes /
+# tick_arithmetic_intensity next to the serve_open_pnative headline, so
+# the ~2x weight-stream cut and its tok/s effect land machine-checked.
+run_job serve_open_w8 900 "$CAP/serving_paged.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --concurrency 8 --requests 64 --qps 8 --shared-prefix-len 64 \
+  --paged --block-size 16 --prefill-chunk 64 --prefill-budget 128 \
+  --decode-attention paged --weight-dtype int8 --fused-sampling
+run_job serve_open_w8_spec 900 "$CAP/serving_paged.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --concurrency 8 --requests 64 --qps 8 --shared-prefix-len 64 \
+  --paged --block-size 16 --prefill-chunk 64 --prefill-budget 128 \
+  --speculate 4 --draft-layers 1 --weight-dtype int8 --fused-sampling
+
 # Restart-to-traffic (ROADMAP item 5): one row timing a serve replica
 # from SPAWN to first token through the router's rejoin path, cold vs
 # `bpe-tpu warmup`-warmed compile cache — the rolling-deploy window.
@@ -654,6 +672,66 @@ print("  ".join(parts))
 PY
 )
   [ -n "$SPEC_LINE" ] && log "speculative-decoding self-report: $SPEC_LINE"
+fi
+# Quantized-weight decode self-report (jax-free, CPU-only): the newest
+# int8-weight row vs the act-width paged-native headline under the same
+# Poisson arrivals — the per-tick weight bytes the quantization halves,
+# the tok/s + p99 guardrails, and the analytic tick-roofline floor.
+if [ -s "$CAP/serving_paged.jsonl" ]; then
+  W8_LINE=$(env JAX_PLATFORMS=cpu python - "$CAP/serving_paged.jsonl" <<'PY'
+import json, sys
+
+w8 = headline = None
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if not ln:
+        continue
+    try:
+        r = json.loads(ln)
+    except json.JSONDecodeError:
+        continue
+    if "qps_target" not in r:
+        continue
+    if r.get("weight_dtype") == "int8" and r.get("engine") != "spec":
+        w8 = r  # newest int8-weight row wins
+    elif (
+        r.get("decode_attention") == "paged"
+        and r.get("weight_dtype") in (None, "float32", "bfloat16")
+        and r.get("engine") != "spec"
+    ):
+        headline = r  # the act-width paged-native headline
+if w8 is None:
+    sys.exit(0)
+
+
+def num(v, d=4):
+    return f"{v:,.{d}g}" if isinstance(v, (int, float)) else "n/a"
+
+
+parts = [
+    f"tick weight bytes {num(w8.get('tick_weight_bytes'))}"
+    + (
+        f" (act {num(headline.get('tick_weight_bytes'))})"
+        if headline else ""
+    ),
+    f"tok/s {num(w8.get('gen_tok_per_s'))}"
+    + (f" (act {num(headline.get('gen_tok_per_s'))})" if headline else ""),
+    f"p99 {num(w8.get('latency_p99_s'))}s"
+    + (f" (act {num(headline.get('latency_p99_s'))}s)" if headline else ""),
+    f"tick AI {num(w8.get('tick_arithmetic_intensity'))} flops/B",
+    f"floor {num(w8.get('tick_projected_s'))}s/tick",
+    "fused" if w8.get("fused_sampling") else "unfused",
+]
+tw, hw = w8.get("tick_weight_bytes"), (headline or {}).get("tick_weight_bytes")
+if isinstance(tw, (int, float)) and isinstance(hw, (int, float)) and hw:
+    ratio = tw / hw
+    parts.append(f"weight-stream ratio {ratio:.2f}x")
+    if ratio > 0.6:
+        parts.append("WARNING: int8 weight stream not ~2x smaller")
+print("  ".join(parts))
+PY
+)
+  [ -n "$W8_LINE" ] && log "int8-weight decode self-report: $W8_LINE"
 fi
 # Restart-to-traffic self-report (jax-free, CPU-only): the newest restart
 # row's cold vs warmed spawn->first-token seconds — ROADMAP item 5's

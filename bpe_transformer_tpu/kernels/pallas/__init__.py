@@ -9,12 +9,20 @@ from bpe_transformer_tpu.kernels.pallas.flash_attention import (
     flash_attention_with_rope,
 )
 from bpe_transformer_tpu.kernels.pallas.gelu import gelu, gelu_reference
+from bpe_transformer_tpu.kernels.pallas.quant_matmul import quant_matmul
+from bpe_transformer_tpu.kernels.pallas.sample import (
+    fused_head_sample,
+    fused_verify_head,
+)
 
 __all__ = [
     "decode_attention",
     "paged_decode_attention",
     "flash_attention",
     "flash_attention_with_rope",
+    "fused_head_sample",
+    "fused_verify_head",
     "gelu",
     "gelu_reference",
+    "quant_matmul",
 ]

@@ -1,0 +1,119 @@
+"""Int8-weight matmul with in-register dequantization.
+
+The serving engines store matmul weights as ``{"q": int8 (d_out, d_in),
+"scale": f32 (d_out,)}`` (`ops/quant.py`): 1 byte per value in HBM, one
+f32 scale per output channel.  This kernel is the read path — the weight
+twin of the PR 9 paged decode kernel's KV dequant: each grid step DMAs
+one int8 row tile into VMEM, converts it to f32 **in registers**, runs
+the dot at f32 accumulation, and applies the per-row scale to the tile's
+output columns.  A dequantized copy of the weight never exists in HBM,
+so the decode tick's weight stream is the int8 bytes — the ~2x-vs-bf16
+cut the quantization exists for.
+
+Because the scale is per OUTPUT channel the matmul factors exactly
+(``y[.., o] = scale[o] * sum_i x[.., i] q[o, i]``), so dequantization is
+one multiply per output element *after* the reduction — the MXU sees a
+plain f32 dot over the converted tile.
+
+Shapes: ``x (m, d_in)`` activations (any dtype; converted to f32 for the
+accumulation), ``q (d_out, d_in)`` int8, ``scale (d_out,)`` f32; returns
+``(m, d_out)`` **f32** (callers cast down; `head_logits` keeps the f32 —
+logits stay float32-clean).  The grid tiles BOTH axes: ``d_out`` row
+tiles (the weight stream) and ``m`` row tiles — the same dispatch serves
+the 1-token decode tick (m = slots, one tile) and full prefill buckets
+(m = bucket length), so the activation block must never assume
+decode-sized m or a long bucket would blow the VMEM budget.  TPU note:
+int8 weight tiles want 32-sublane alignment, so the d_out tile prefers
+multiples of 32; dimensions with no aligned divisor fall back to a
+single whole-axis tile (physically lane/sublane-padded by the layout,
+like the decode kernel's narrow head dims).  Interpret mode runs
+everywhere else (CPU tests), as with the sibling kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+SUBLANES = 8
+#: Preferred int8 sublane alignment (min int8 tile is (32, 128)).
+INT8_SUBLANES = 32
+
+
+def _pick_block(n: int, target: int = 512, step: int = INT8_SUBLANES) -> int:
+    """Largest divisor of ``n`` that is a multiple of ``step`` and <=
+    ``target``; falls back to ``n`` itself (whole-axis tile) when no
+    aligned divisor exists."""
+    best = 0
+    b = step
+    while b <= min(target, n):
+        if n % b == 0:
+            best = b
+        b += step
+    return best or n
+
+
+def _quant_matmul_kernel(x_ref, q_ref, s_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32)          # (block_m, d_in)
+    w = q_ref[...].astype(jnp.float32)          # (block_n, d_in) — in regs
+    out = jax.lax.dot_general(
+        x, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                            # (block_m, block_n)
+    # scale rides as (block_n, 1); transpose to broadcast over rows.
+    o_ref[...] = out * s_ref[...].reshape(1, -1)
+
+
+def quant_matmul(
+    x: jax.Array,
+    q: jax.Array,
+    scale: jax.Array,
+    *,
+    block_n: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``x @ (q * scale[:, None]).T`` with the dequant in registers (see
+    module docstring).  ``x`` may have any leading shape; returns f32
+    ``(*leading, d_out)``."""
+    if interpret is None:
+        from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
+
+        interpret = interpret_mode()
+    *lead, d_in = x.shape
+    n, d_in2 = q.shape
+    if d_in2 != d_in or scale.shape != (n,):
+        raise ValueError(
+            f"shape mismatch: x {x.shape}, q {q.shape}, scale {scale.shape}"
+        )
+    m = 1
+    for dim in lead:
+        m *= dim
+    x2 = x.reshape(m, d_in)
+    m_pad = pl.cdiv(max(m, 1), SUBLANES) * SUBLANES
+    if m_pad != m:
+        x2 = jnp.pad(x2, ((0, m_pad - m), (0, 0)))
+    bn = block_n or _pick_block(n)
+    if n % bn:
+        raise ValueError(f"block_n={bn} must divide d_out={n}")
+    # Tile m too: a full prefill bucket's activations must not ride VMEM
+    # whole (m_pad is a SUBLANES multiple, so a divisor always exists).
+    bm = _pick_block(m_pad, target=256, step=SUBLANES)
+
+    out = pl.pallas_call(
+        _quant_matmul_kernel,
+        grid=(m_pad // bm, n // bn),
+        in_specs=[
+            pl.BlockSpec((bm, d_in), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, d_in), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, 1), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m_pad, n), jnp.float32),
+        interpret=interpret,
+    )(x2, q, scale.reshape(n, 1))
+    return out[:m].reshape(*lead, n)

@@ -1,0 +1,413 @@
+"""Fused final-projection + sampling: the decode tick's tail as ONE kernel.
+
+The unfused tick tail is a chain: head matmul -> (slots, vocab) f32
+logits to HBM -> `filter_logits` (TWO full O(V log V) sorts for runtime
+top-k/top-p) -> masked logits to HBM -> `jax.random.categorical` (gumbel
+noise + argmax) — several vocab-sized HBM round trips and a pile of XLA
+sort programs to emit ONE token per slot.  This kernel collapses the
+whole tail: the head streams through VMEM once (int8 weights dequantize
+in registers, `ops/quant.py` layout), logits accumulate in a VMEM
+scratch and never reach HBM, and the filtering + sampling run in the
+same program.
+
+**Sort-free exact filtering.**  Runtime top-k/top-p need order
+statistics (the k-th largest logit; the nucleus cutoff), which XLA gets
+from full sorts.  Here both cutoffs come from a 32-step *radix descent
+over order-preserving uint32 keys*: map each f32 logit to a uint32 whose
+integer order equals the float order (sign-flip trick), then build the
+threshold bit by bit from the MSB, counting (top-k) or mass-summing
+(top-p) against each candidate prefix.  32 vectorized passes over the
+VMEM-resident logits replace the sort — and the thresholds are EXACT
+(they land on representable key values), so the keep sets match
+`serving.engine.filter_logits`'s sorted-cutoff semantics bit for bit
+(the only fp caveat: the nucleus mass comparison sums in a different
+order than the sorted cumsum, so a logit sitting within one ulp of the
+nucleus boundary can flip — measure-zero for real logits).
+
+**Sampling.**  ``jax.random.categorical(key, masked)`` IS
+``argmax(masked + gumbel(key, shape))`` — so the caller draws the gumbel
+noise from the very key the unfused path would hand to ``categorical``
+and passes it in; the kernel adds it to the masked logits and takes the
+argmax (first occurrence, matching ``jnp.argmax``).  Greedy rows
+(temp 0) take the raw-logits argmax, exactly as `sample_tokens`.
+
+Two entry points share the machinery:
+
+* :func:`fused_head_sample` — the tick tail: one token per row.
+* :func:`fused_verify_head` — the speculative-decoding verify tail
+  (`serving/spec/engine.py`): per scored row, the greedy token, the
+  filtered target probability of the judged draft token (the ``p(d)`` of
+  the Leviathan accept rule), and a residual-distribution sample
+  (``max(p − q, 0)`` normalized, the rejection bonus) — so the verify
+  program's only vocab-sized tensors outside the kernel are the draft's
+  own ``q`` (which the propose program materialized anyway) and the
+  gumbel noise.
+
+Forward-only inference kernels, like the decode-attention siblings.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bpe_transformer_tpu.ops.core import MASK_VALUE as NEG_INF
+
+SUBLANES = 8
+LANE = 128
+
+
+def _pick_block(n: int, target: int, step: int) -> int:
+    """Largest multiple-of-``step`` divisor of ``n`` up to ``target``;
+    falls back to ``n`` itself when no aligned divisor exists."""
+    best = 0
+    b = step
+    while b <= min(target, n):
+        if n % b == 0:
+            best = b
+        b += step
+    return best or n
+
+
+def _pick_block_v(v: int, target: int = 2048) -> int:
+    """Vocab tile: multiple-of-128 (lane alignment for the
+    dynamic-offset scratch stores); vocabularies with no aligned divisor
+    run as a single whole-V head block — fine for the shipped configs
+    (10k x d int8 is a ~2.5 MB tile) but a large unaligned vocab at the
+    activation width can exceed VMEM on TPU; pick a 128-multiple vocab
+    (or serve unfused) there."""
+    return _pick_block(v, target, LANE)
+
+
+def _okey(x):
+    """f32 -> uint32 whose unsigned integer order equals the float order
+    (IEEE sign-flip trick; NaN-free inputs assumed)."""
+    b = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    return jnp.where(
+        (b >> jnp.uint32(31)) > 0, ~b, b | jnp.uint32(0x80000000)
+    )
+
+
+def _argmax_first(x):
+    """Row-wise argmax, first occurrence (``jnp.argmax`` semantics)."""
+    v = x.shape[-1]
+    m = jnp.max(x, axis=-1, keepdims=True)
+    iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+    return jnp.min(jnp.where(x == m, iota, v), axis=-1, keepdims=True)
+
+
+def _topk_threshold(keys, kk):
+    """Per row, the uint32 key of the ``kk``-th largest entry (ties give
+    the shared key): radix descent for the largest ``t`` with
+    ``count(keys >= t) >= kk``.  ``keys`` (R, V) uint32, ``kk`` (R, 1)
+    int32 in [1, V]."""
+    t = jnp.zeros(kk.shape, jnp.uint32)
+    for bit in range(31, -1, -1):
+        cand = t | jnp.uint32(1 << bit)
+        cnt = jnp.sum(
+            (keys >= cand).astype(jnp.int32), axis=-1, keepdims=True
+        )
+        t = jnp.where(cnt >= kk, cand, t)
+    return t
+
+
+def _nucleus_threshold(keys, e, p_mass):
+    """Per row, the smallest uint32 ``t`` whose strictly-above mass
+    ``sum(e[keys > t])`` is below ``p_mass`` — the value-space nucleus
+    cutoff (an entry x is kept iff the mass strictly above it is < p,
+    which is exactly the sorted-cumsum keep rule of ``filter_logits``).
+    ``e`` must be 0 at already-dropped entries."""
+    t = jnp.zeros(p_mass.shape, jnp.uint32)
+    for bit in range(31, -1, -1):
+        # Max completion with this bit still 0: if even it satisfies the
+        # predicate, the minimum does too with bit 0; else the bit is 1.
+        trial = t | jnp.uint32((1 << bit) - 1)
+        g = jnp.sum(
+            jnp.where(keys > trial, e, 0.0), axis=-1, keepdims=True
+        )
+        t = jnp.where(g < p_mass, t, t | jnp.uint32(1 << bit))
+    return t
+
+
+def _filter_rows(logits, temps, top_ks, top_ps):
+    """The `filter_logits` keep-set + masked logits for (R, V) rows with
+    per-row runtime knobs, sort-free (see module docstring).  Returns
+    ``(masked, keep, e_kept, greedy)``: the -inf-masked scaled logits,
+    the boolean keep set, the kept entries' ``exp(x - rowmax)`` weights
+    (softmax numerators), and the raw-logits argmax."""
+    v = logits.shape[-1]
+    greedy = _argmax_first(logits)
+    scaled = logits / jnp.maximum(temps, 1e-6)
+    keys = _okey(scaled)
+
+    kk_raw = top_ks.astype(jnp.int32)
+    kk = jnp.where(kk_raw > 0, jnp.clip(kk_raw, 1, v), v)
+    tk = _topk_threshold(keys, kk)
+    keep_k = keys >= tk
+    masked1 = jnp.where(keep_k, scaled, NEG_INF)
+
+    m2 = jnp.max(masked1, axis=-1, keepdims=True)
+    e = jnp.where(keep_k, jnp.exp(masked1 - m2), 0.0)
+    z = jnp.sum(e, axis=-1, keepdims=True)
+    tp = _nucleus_threshold(keys, e, top_ps * z)
+    # The max (and its value-ties) always survives, as in filter_logits'
+    # keep[..., 0] = True — value-based masking keeps every tie.
+    keep = keep_k & ((keys >= tp) | (masked1 == m2))
+    masked = jnp.where(keep, masked1, NEG_INF)
+    return masked, keep, jnp.where(keep, e, 0.0), greedy
+
+
+def _accumulate_logits(x_ref, h_ref, s_ref, acc_ref, *, block_v, quantized):
+    """Grid step ``(i, j)``: head tile ``j``'s logit columns for row tile
+    ``i`` into the scratch.  int8 tiles dequantize in registers —
+    per-output-channel scale applied AFTER the f32-accumulated dot, so
+    the weight bytes that cross HBM are the int8 payload."""
+    j = pl.program_id(1)
+    x = x_ref[...].astype(jnp.float32)           # (block_r, d)
+    h = h_ref[...].astype(jnp.float32)           # (block_v, d)
+    out = jax.lax.dot_general(
+        x, h, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                             # (block_r, block_v)
+    if quantized:
+        out = out * s_ref[...].reshape(1, -1)
+    acc_ref[:, pl.ds(j * block_v, block_v)] = out
+
+
+def _sample_kernel(
+    x_ref, h_ref, *refs, block_v, num_v_blocks, quantized,
+):
+    if quantized:
+        s_ref, knobs_ref, g_ref, tok_ref, acc_ref = refs
+    else:
+        s_ref = None
+        knobs_ref, g_ref, tok_ref, acc_ref = refs
+    _accumulate_logits(
+        x_ref, h_ref, s_ref, acc_ref, block_v=block_v, quantized=quantized
+    )
+
+    @pl.when(pl.program_id(1) == num_v_blocks - 1)
+    def _finalize():
+        logits = acc_ref[...]
+        temps = knobs_ref[:, 0:1]
+        masked, _, _, greedy = _filter_rows(
+            logits, temps, knobs_ref[:, 1:2], knobs_ref[:, 2:3]
+        )
+        sampled = _argmax_first(masked + g_ref[...])
+        tok_ref[...] = jnp.where(temps > 0.0, sampled, greedy).astype(
+            jnp.int32
+        )
+
+
+def _verify_kernel(
+    x_ref, h_ref, *refs, block_v, num_v_blocks, quantized,
+):
+    if quantized:
+        (s_ref, knobs_ref, judge_ref, q_ref, g_ref,
+         greedy_ref, pd_ref, bonus_ref, acc_ref) = refs
+    else:
+        s_ref = None
+        (knobs_ref, judge_ref, q_ref, g_ref,
+         greedy_ref, pd_ref, bonus_ref, acc_ref) = refs
+    _accumulate_logits(
+        x_ref, h_ref, s_ref, acc_ref, block_v=block_v, quantized=quantized
+    )
+
+    @pl.when(pl.program_id(1) == num_v_blocks - 1)
+    def _finalize():
+        logits = acc_ref[...]
+        v = logits.shape[-1]
+        temps = knobs_ref[:, 0:1]
+        _, _, e_kept, greedy = _filter_rows(
+            logits, temps, knobs_ref[:, 1:2], knobs_ref[:, 2:3]
+        )
+        iota = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        # Filtered target distribution p: softmax over the keep set for
+        # sampled rows, the EXACT raw-argmax one-hot for greedy rows (the
+        # Leviathan rule then collapses to argmax agreement).
+        p_soft = e_kept / jnp.maximum(
+            jnp.sum(e_kept, axis=-1, keepdims=True), 1e-30
+        )
+        onehot = (iota == greedy).astype(jnp.float32)
+        p = jnp.where(temps > 0.0, p_soft, onehot)
+        pd_ref[...] = jnp.sum(
+            jnp.where(iota == judge_ref[...], p, 0.0),
+            axis=-1, keepdims=True,
+        )
+        # Residual max(p - q, 0) with the all-mass-gone fallback to p
+        # itself; the bonus/correction token is its gumbel-argmax sample
+        # (sampled rows) or plain argmax (greedy rows) — exactly the
+        # `_spec_verify_program` math, one sample per candidate row.
+        res = jnp.maximum(p - q_ref[...].astype(jnp.float32), 0.0)
+        has_mass = jnp.sum(res, axis=-1, keepdims=True) > 0
+        res = jnp.where(has_mass, res, p)
+        logres = jnp.where(res > 0, jnp.log(jnp.maximum(res, 1e-38)), NEG_INF)
+        bonus_s = _argmax_first(logres + g_ref[...])
+        bonus_g = _argmax_first(res)
+        greedy_ref[...] = greedy.astype(jnp.int32)
+        bonus_ref[...] = jnp.where(temps > 0.0, bonus_s, bonus_g).astype(
+            jnp.int32
+        )
+
+
+def _head_operands(head, v, d):
+    """Normalize the head argument: a raw ``(V, d)`` array or the int8
+    quantized dict — returns ``(inputs, in_specs, quantized)`` for the
+    blocked head tile (+ per-row scale tile when quantized)."""
+    quantized = isinstance(head, dict)
+    if quantized:
+        q, scale = head["q"], head["scale"]
+        if q.shape != (v, d) or scale.shape != (v,):
+            raise ValueError(
+                f"quantized head q {q.shape} / scale {scale.shape} must be "
+                f"({v}, {d}) / ({v},)"
+            )
+        return [q, scale.reshape(v, 1)], quantized
+    if head.shape != (v, d):
+        raise ValueError(f"head {head.shape} must be ({v}, {d})")
+    return [head], quantized
+
+
+def _run(kernel_body, hidden, head, knobs, extra_inputs, out_shapes,
+         *, vocab, interpret):
+    """Shared pallas_call assembly for both entry points: grid =
+    ``(row tiles, vocab tiles)`` with the vocab axis innermost, so each
+    row tile's logits fully accumulate in the ``(block_r, vocab)``
+    scratch before its finalize fires, then the next row tile reuses the
+    scratch (the grid iterates sequentially, last axis fastest — the
+    decode-attention kernels' accumulator pattern).  Row-tiling bounds
+    VMEM: the scratch and every vocab-sized per-row operand (gumbel, the
+    verify ``q``) live at ``block_r`` rows, not the full batch — the
+    spec verify's rows = slots·(K+1) must not ride whole."""
+    if interpret is None:
+        from bpe_transformer_tpu.kernels.pallas.runtime import interpret_mode
+
+        interpret = interpret_mode()
+    r, d = hidden.shape
+    r_pad = pl.cdiv(r, SUBLANES) * SUBLANES
+    pad = lambda a: (
+        a if a.shape[0] == r_pad
+        else jnp.pad(a, ((0, r_pad - a.shape[0]),) + ((0, 0),) * (a.ndim - 1))
+    )
+    head_inputs, quantized = _head_operands(head, vocab, d)
+    bv = _pick_block_v(vocab)
+    nv = vocab // bv
+    br = _pick_block(r_pad, 32, SUBLANES)
+
+    rowspec = lambda minor: pl.BlockSpec(
+        (br, minor), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+    )
+    in_specs = [rowspec(d)]
+    in_specs.append(
+        pl.BlockSpec((bv, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM)
+    )
+    if quantized:
+        in_specs.append(
+            pl.BlockSpec((bv, 1), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM)
+        )
+    in_specs.append(rowspec(knobs.shape[1]))
+    inputs = [pad(hidden), *head_inputs, pad(knobs)]
+    for arr in extra_inputs:
+        inputs.append(pad(arr))
+        in_specs.append(rowspec(arr.shape[1]))
+
+    kernel = functools.partial(
+        kernel_body, block_v=bv, num_v_blocks=nv, quantized=quantized
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(r_pad // br, nv),
+        in_specs=in_specs,
+        out_specs=[rowspec(1) for _ in out_shapes],
+        out_shape=[
+            jax.ShapeDtypeStruct((r_pad, 1), dt) for dt in out_shapes
+        ],
+        scratch_shapes=[pltpu.VMEM((br, vocab), jnp.float32)],
+        interpret=interpret,
+    )(*inputs)
+    return [o[:r, 0] for o in outs]
+
+
+def fused_head_sample(
+    hidden: jax.Array,
+    head,
+    temps: jax.Array,
+    top_ks: jax.Array,
+    top_ps: jax.Array,
+    gumbel: jax.Array,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """One fused tick tail: ``hidden (rows, d)`` -> sampled token ids
+    ``(rows,)`` int32 under per-row runtime knobs.
+
+    ``head`` is the LM head — a ``(vocab, d)`` array or the int8
+    quantized dict.  ``gumbel (rows, vocab)`` is the caller's noise,
+    drawn from the same key the unfused path would give
+    ``jax.random.categorical`` (which is literally gumbel + argmax), so
+    fused and unfused sampling agree token-for-token whenever the logits
+    agree bitwise; greedy rows (temp 0) are argmax and agree always.
+    """
+    rows, _ = hidden.shape
+    vocab = gumbel.shape[-1]
+    knobs = jnp.stack(
+        [
+            temps.astype(jnp.float32),
+            top_ks.astype(jnp.float32),
+            top_ps.astype(jnp.float32),
+        ],
+        axis=1,
+    )
+    (tok,) = _run(
+        _sample_kernel, hidden, head, knobs,
+        [gumbel.astype(jnp.float32)], [jnp.int32],
+        vocab=vocab, interpret=interpret,
+    )
+    return tok
+
+
+def fused_verify_head(
+    hidden: jax.Array,
+    head,
+    temps: jax.Array,
+    top_ks: jax.Array,
+    top_ps: jax.Array,
+    judge_tokens: jax.Array,
+    q_probs: jax.Array,
+    gumbel: jax.Array,
+    *,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The speculative-verify tail for ``hidden (rows, d)`` scored rows
+    (rows = slots * (K+1), row-major): returns ``(greedy, p_d, bonus)``
+    each ``(rows,)`` — the raw-argmax token, the filtered target
+    probability of ``judge_tokens`` (the accept rule's ``p(d)``; greedy
+    rows handle it outside via argmax agreement), and a sample from the
+    residual ``max(p − q_probs, 0)`` (fallback ``p``).  ``q_probs``/
+    ``gumbel`` are ``(rows, vocab)``; all knobs per row.
+    """
+    rows, _ = hidden.shape
+    vocab = q_probs.shape[-1]
+    knobs = jnp.stack(
+        [
+            temps.astype(jnp.float32),
+            top_ks.astype(jnp.float32),
+            top_ps.astype(jnp.float32),
+        ],
+        axis=1,
+    )
+    greedy, p_d, bonus = _run(
+        _verify_kernel, hidden, head, knobs,
+        [
+            judge_tokens.astype(jnp.int32).reshape(rows, 1),
+            q_probs.astype(jnp.float32),
+            gumbel.astype(jnp.float32),
+        ],
+        [jnp.int32, jnp.float32, jnp.int32],
+        vocab=vocab, interpret=interpret,
+    )
+    return greedy, p_d, bonus

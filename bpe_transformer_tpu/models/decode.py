@@ -228,6 +228,7 @@ def decode_step(
     config: ModelConfig,
     lm_head: Array | None = None,
     active: Array | None = None,
+    return_hidden: bool = False,
 ) -> tuple[Array, KVCache]:
     """One cached decode step.
 
@@ -240,6 +241,11 @@ def decode_step(
     ``active`` (batch,) bool gates the cache write per sequence: inactive
     slots keep their cache rows untouched (their logits are still computed —
     the program shape is batch-static — but the caller discards them).
+
+    ``return_hidden=True`` skips the head projection and returns the
+    final-norm hidden state ``(batch, d_model)`` instead of logits — the
+    fused sample-in-kernel tick (`kernels/pallas/sample.py`) owns the
+    projection then, so logits never materialize in HBM.
     """
     x = embedding(params["token_embeddings"], token[:, None])  # (B, 1, d)
     positions = pos[None] if jnp.ndim(pos) == 0 else pos[:, None]  # (1,)|(B,1)
@@ -286,6 +292,8 @@ def decode_step(
         x = _block_apply(x, block_params, config, attend)
 
     x = _norm(x, params["ln_final"], config)
+    if return_hidden:
+        return x[:, 0], new_cache
     head = lm_head_weight(params, config) if lm_head is None else lm_head
     logits = head_logits(x[:, 0], head)
     return logits, new_cache
@@ -418,11 +426,14 @@ def paged_decode_step(
     config: ModelConfig,
     lm_head: Array | None = None,
     active: Array | None = None,
+    return_hidden: bool = False,
     *,
     block_size: int,
 ) -> tuple[Array, KVCache]:
     """One cached decode step against the paged pool — the block-table twin
-    of :func:`decode_step`.
+    of :func:`decode_step` (``return_hidden`` as there: the fused
+    sample-in-kernel tick takes the final-norm hidden state and owns the
+    head projection).
 
     ``token``/``pos``/``active``: per-slot ``(slots,)`` vectors as in the
     serving slot pool.  ``tables`` (slots, blocks_per_slot) int32 maps each
@@ -517,6 +528,8 @@ def paged_decode_step(
         x = _block_apply(x, block_params, config, attend)
 
     x = _norm(x, params["ln_final"], config)
+    if return_hidden:
+        return x[:, 0], new_pool
     head = lm_head_weight(params, config) if lm_head is None else lm_head
     logits = head_logits(x[:, 0], head)
     return logits, new_pool
@@ -667,6 +680,7 @@ def paged_verify_step(
     config: ModelConfig,
     lm_head: Array | None = None,
     active: Array | None = None,
+    return_hidden: bool = False,
     *,
     block_size: int,
 ) -> tuple[Array, KVCache]:
@@ -791,6 +805,8 @@ def paged_verify_step(
         x = _block_apply(x, block_params, config, attend)
 
     x = _norm(x, params["ln_final"], config)
+    if return_hidden:
+        return x, new_pool
     head = lm_head_weight(params, config) if lm_head is None else lm_head
     return head_logits(x, head), new_pool
 

@@ -111,7 +111,13 @@ def _ffn(
     override); ignored by the dense FFN kinds."""
     zero = jnp.zeros((), jnp.float32)
     if config.ffn_type in (None, "swiglu"):
-        if config.ffn_impl == "pallas":
+        # int8-quantized serving weights (dict leaves, ops/quant.py) take
+        # the plain composition below — each linear dispatches to the
+        # dequant-in-register quant matmul; the fused swiglu kernel reads
+        # raw arrays.
+        if config.ffn_impl == "pallas" and not isinstance(
+            ffn_params["w1"], dict
+        ):
             from bpe_transformer_tpu.kernels.pallas.swiglu import swiglu_fused
 
             return (

@@ -15,6 +15,11 @@ from bpe_transformer_tpu.ops.core import (
 )
 from bpe_transformer_tpu.ops.grad import clip_by_global_norm, global_norm
 from bpe_transformer_tpu.ops.losses import cross_entropy
+from bpe_transformer_tpu.ops.quant import (
+    is_quantized,
+    quantize_params,
+    quantize_weight,
+)
 from bpe_transformer_tpu.ops.rope import apply_rope, rope, rope_tables
 
 __all__ = [
@@ -24,7 +29,10 @@ __all__ = [
     "cross_entropy",
     "embedding",
     "global_norm",
+    "is_quantized",
     "linear",
+    "quantize_params",
+    "quantize_weight",
     "merge_heads",
     "multihead_self_attention",
     "rmsnorm",

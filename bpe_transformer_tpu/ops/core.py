@@ -25,7 +25,17 @@ MASK_VALUE = -1e30
 
 
 def linear(x: Array, weight: Array) -> Array:
-    """``y = x @ W.T`` with torch-layout ``W: (d_out, d_in)``; no bias."""
+    """``y = x @ W.T`` with torch-layout ``W: (d_out, d_in)``; no bias.
+
+    ``weight`` may also be an int8-quantized dict (``ops/quant.py``, the
+    serving path's per-channel weights) — dispatched to the
+    dequant-in-register Pallas matmul.  Training params are plain arrays,
+    so the hot path is untouched.
+    """
+    if isinstance(weight, dict):
+        from bpe_transformer_tpu.ops.quant import quant_linear
+
+        return quant_linear(x, weight)
     return jnp.einsum("...i,oi->...o", x, weight)
 
 
@@ -38,7 +48,15 @@ def head_logits(hidden: Array, head_w: Array) -> Array:
     matmul keeps full MXU rate (f32 inputs run the systolic array at ~1/4
     speed on v5e) while the f32 output preserves logsumexp/sampling
     stability; on f32 paths it is bit-identical to an f32 matmul.
+
+    An int8-quantized ``head_w`` dict (serving path) dispatches to the
+    dequant-in-register kernel; its accumulator is already f32, so the
+    float32-clean logits contract holds unchanged.
     """
+    if isinstance(head_w, dict):
+        from bpe_transformer_tpu.ops.quant import quant_linear
+
+        return quant_linear(hidden, head_w, preserve_f32=True)
     return jax.lax.dot_general(
         hidden, head_w.astype(hidden.dtype),
         (((hidden.ndim - 1,), (1,)), ((), ())),

@@ -52,6 +52,60 @@ TOP_K_DISABLED = 0
 TOP_P_DISABLED = 2.0
 
 
+def prepare_serving_weights(params, config: ModelConfig, weight_dtype):
+    """The weight pipeline every serving engine runs at build time: cast
+    the tree + LM head to the compute dtype (mirrors ``generate_cached``),
+    then — under ``weight_dtype="int8"`` — quantize the matmul weights
+    per output channel (`ops/quant.py`), so every program the engine
+    compiles streams 1-byte weights and dequantizes in registers.
+
+    Returns ``(params, lm_head, label, params_bytes, tick_weight_bytes)``:
+    the (possibly quantized) tree and head copy, the ``weight_dtype``
+    gauge label ("int8" or the activation dtype name), resident weight
+    bytes, and the bytes ONE decode tick actually streams (block stack +
+    final norm + the head copy; the embedding row gather and the tree's
+    unused ``lm_head`` leaf stay out — they are resident, not per-tick
+    traffic).
+    """
+    if weight_dtype not in (None, "int8"):
+        raise ValueError(
+            f'weight_dtype={weight_dtype!r} must be None (activation '
+            'width) or "int8"'
+        )
+    from bpe_transformer_tpu.ops.quant import (
+        quantize_params,
+        quantize_weight,
+        tree_bytes,
+    )
+
+    act_dtype = jnp.dtype(config.activation_dtype)
+    lm_head = lm_head_weight(params, config).astype(act_dtype)
+    if act_dtype != jnp.float32:
+        params = jax.tree_util.tree_map(lambda p: p.astype(act_dtype), params)
+    if weight_dtype == "int8":
+        params = quantize_params(params, config)
+        lm_head = quantize_weight(lm_head)
+    label = "int8" if weight_dtype == "int8" else str(act_dtype)
+    params_bytes = tree_bytes(params) + tree_bytes(lm_head)
+    tick_weight_bytes = (
+        tree_bytes(params["layers"])
+        + tree_bytes(params["ln_final"])
+        + tree_bytes(lm_head)
+    )
+    return params, lm_head, label, params_bytes, tick_weight_bytes
+
+
+def gumbel_rows(keys, vocab: int):
+    """Per-row gumbel noise ``(rows, vocab)`` from per-row RNG keys —
+    the noise ``jax.random.categorical`` would draw internally from the
+    same keys, precomputed so the fused sample kernel
+    (`kernels/pallas/sample.py`) can take its argmax in-program and stay
+    token-identical to the unfused sampler."""
+    return jax.vmap(
+        lambda k: jax.random.gumbel(k, (vocab,), jnp.float32)
+    )(keys)
+
+
 def default_prefill_buckets(
     context_length: int, min_bucket: int = 16
 ) -> tuple[int, ...]:
@@ -146,18 +200,41 @@ def _prefill_program(
 
 def _tick_program(
     params, lm_head, cache, tokens, positions, active, keys, temps,
-    top_ks, top_ps, *, config: ModelConfig,
+    top_ks, top_ps, *, config: ModelConfig, fused: bool = False,
 ):
     """One engine tick: batched decode step at per-slot positions, per-slot
     runtime sampling, inactive slots frozen (cache write masked, position
-    held, token passed through)."""
-    logits, cache = decode_step(
-        params, tokens, positions, cache, config, lm_head=lm_head,
-        active=active,
-    )
+    held, token passed through).
+
+    ``fused=True`` runs the tick's tail — head projection + filtering +
+    sampling — as ONE Pallas kernel (`kernels/pallas/sample.py`): the
+    decode step returns the final-norm hidden state, the caller-side
+    gumbel noise replaces ``categorical``'s internal draw from the same
+    keys, and (slots, vocab) logits never reach HBM.  Greedy output is
+    token-identical to the unfused path; sampled output is too whenever
+    the kernel's logits match the XLA matmul bitwise.
+    """
     split = jax.vmap(jax.random.split)(keys)
     keys_next, subs = split[:, 0], split[:, 1]
-    nxt = sample_tokens(logits, subs, temps, top_ks, top_ps)
+    if fused:
+        from bpe_transformer_tpu.kernels.pallas.sample import (
+            fused_head_sample,
+        )
+
+        hidden, cache = decode_step(
+            params, tokens, positions, cache, config, lm_head=lm_head,
+            active=active, return_hidden=True,
+        )
+        gumbel = gumbel_rows(subs, config.vocab_size)
+        nxt = fused_head_sample(
+            hidden, lm_head, temps, top_ks, top_ps, gumbel
+        )
+    else:
+        logits, cache = decode_step(
+            params, tokens, positions, cache, config, lm_head=lm_head,
+            active=active,
+        )
+        nxt = sample_tokens(logits, subs, temps, top_ks, top_ps)
     nxt = jnp.where(active, nxt, tokens)
     keys_next = jnp.where(active[:, None], keys_next, keys)
     positions = jnp.where(active, positions + 1, positions)
@@ -200,6 +277,8 @@ class SlotPoolEngine:
         slots: int = 8,
         prefill_buckets: tuple[int, ...] | None = None,
         min_bucket: int = 16,
+        weight_dtype: str | None = None,
+        fused_sampling: bool = False,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -219,15 +298,25 @@ class SlotPoolEngine:
         self.buckets = buckets
 
         # Params/head cast once to the compute dtype (mirrors
-        # generate_cached); the cache lives at the same width.
+        # generate_cached), then optionally int8-quantized per output
+        # channel — every program this engine compiles streams 1-byte
+        # weights then; the cache lives at the activation width.
         act_dtype = jnp.dtype(config.activation_dtype)
-        self._lm_head = lm_head_weight(params, config).astype(act_dtype)
-        if act_dtype != jnp.float32:
-            params = jax.tree_util.tree_map(
-                lambda p: p.astype(act_dtype), params
-            )
-        self._params = params
+        (
+            self._params, self._lm_head, self.weight_dtype,
+            self.params_bytes, self.tick_weight_bytes,
+        ) = prepare_serving_weights(params, config, weight_dtype)
+        self.fused_sampling = bool(fused_sampling)
         self._cache = init_kv_cache(config, slots, dtype=act_dtype)
+        kv_heads = config.num_kv_heads or config.num_heads
+        #: KV footprint per token position across layers (k + v) at the
+        #: cache width — the decode-tick attention read stream's unit
+        #: (the dense twin of the paged engine's gauge; feeds the
+        #: decode-tick roofline).
+        self.kv_bytes_per_token = (
+            2 * config.num_layers * kv_heads * config.d_head
+            * act_dtype.itemsize
+        )
 
         # Per-slot sampling/position state is host-side numpy: tiny (N,)
         # vectors shipped with each dispatch; only the cache stays resident.
@@ -247,7 +336,9 @@ class SlotPoolEngine:
             functools.partial(_prefill_program, config=config)
         )
         self._tick_jit = jax.jit(
-            functools.partial(_tick_program, config=config)
+            functools.partial(
+                _tick_program, config=config, fused=self.fused_sampling
+            )
         )
 
         self.ticks = 0

@@ -50,13 +50,14 @@ from bpe_transformer_tpu.models.decode import (
     paged_chunk_prefill,
     paged_decode_step,
 )
-from bpe_transformer_tpu.models.transformer import lm_head_weight
 from bpe_transformer_tpu.serving.engine import (
     TOP_K_DISABLED,
     TOP_P_DISABLED,
     SlotPoolEngine,
     TickEvent,
     default_prefill_buckets,
+    gumbel_rows,
+    prepare_serving_weights,
     sample_tokens,
 )
 from bpe_transformer_tpu.serving.kvpool.blocks import (
@@ -90,16 +91,34 @@ def _chunk_program(
 def _paged_tick_program(
     params, lm_head, pool, tables, tokens, positions, active, keys, temps,
     top_ks, top_ps, *, config: ModelConfig, block_size: int,
+    fused: bool = False,
 ):
     """One engine tick over the paged pool — sampling identical to the
-    dense `_tick_program`, decode reads/writes through the block table."""
-    logits, pool = paged_decode_step(
-        params, tokens, positions, pool, tables, config, lm_head=lm_head,
-        active=active, block_size=block_size,
-    )
+    dense `_tick_program`, decode reads/writes through the block table.
+    ``fused=True`` runs the head projection + filter + sample tail as ONE
+    Pallas kernel (see the dense twin's docstring)."""
     split = jax.vmap(jax.random.split)(keys)
     keys_next, subs = split[:, 0], split[:, 1]
-    nxt = sample_tokens(logits, subs, temps, top_ks, top_ps)
+    if fused:
+        from bpe_transformer_tpu.kernels.pallas.sample import (
+            fused_head_sample,
+        )
+
+        hidden, pool = paged_decode_step(
+            params, tokens, positions, pool, tables, config,
+            lm_head=lm_head, active=active, return_hidden=True,
+            block_size=block_size,
+        )
+        gumbel = gumbel_rows(subs, config.vocab_size)
+        nxt = fused_head_sample(
+            hidden, lm_head, temps, top_ks, top_ps, gumbel
+        )
+    else:
+        logits, pool = paged_decode_step(
+            params, tokens, positions, pool, tables, config,
+            lm_head=lm_head, active=active, block_size=block_size,
+        )
+        nxt = sample_tokens(logits, subs, temps, top_ks, top_ps)
     nxt = jnp.where(active, nxt, tokens)
     keys_next = jnp.where(active[:, None], keys_next, keys)
     positions = jnp.where(active, positions + 1, positions)
@@ -157,6 +176,8 @@ class PagedEngine:
         prefill_chunk: int | None = None,
         prefix_cache: bool = True,
         kv_dtype: str | None = None,
+        weight_dtype: str | None = None,
+        fused_sampling: bool = False,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -214,12 +235,14 @@ class PagedEngine:
         )
 
         act_dtype = jnp.dtype(config.activation_dtype)
-        self._lm_head = lm_head_weight(params, config).astype(act_dtype)
-        if act_dtype != jnp.float32:
-            params = jax.tree_util.tree_map(
-                lambda p: p.astype(act_dtype), params
-            )
-        self._params = params
+        # Compute-dtype cast + optional per-channel int8 quantization:
+        # every program (chunk prefill, tick, spec verify) then streams
+        # 1-byte weights and dequantizes in registers.
+        (
+            self._params, self._lm_head, self.weight_dtype,
+            self.params_bytes, self.tick_weight_bytes,
+        ) = prepare_serving_weights(params, config, weight_dtype)
+        self.fused_sampling = bool(fused_sampling)
         self._pool = init_kv_pool(
             config, num_blocks, block_size, act_dtype, kv_dtype=kv_dtype
         )
@@ -267,7 +290,8 @@ class PagedEngine:
         )
         self._tick_jit = jax.jit(
             functools.partial(
-                _paged_tick_program, config=config, block_size=block_size
+                _paged_tick_program, config=config, block_size=block_size,
+                fused=self.fused_sampling,
             )
         )
         # Copy-on-write block copy (rewind into a shared block): compiled

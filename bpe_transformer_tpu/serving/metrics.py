@@ -372,6 +372,33 @@ def render_prometheus(
         emit("engine_compiled_programs", "gauge",
              "XLA programs compiled by this engine (bounded: buckets + 1).",
              [({}, engine_stats.get("compiled_programs"))])
+        # Quantized-decode + tick-roofline gauges (ISSUE 11): resident
+        # weight bytes (labeled by storage width), the per-tick weight
+        # sweep int8 halves, and the analytic tick roofline's headline
+        # numbers — kv stream, arithmetic intensity, memory-bound floor.
+        wd = engine_stats.get("weight_dtype")
+        emit("params_bytes", "gauge",
+             "Resident serving weight bytes (params tree + LM head copy; "
+             "int8 weight quantization shrinks this ~2x vs bf16).",
+             [({"weight_dtype": wd} if wd else {},
+               engine_stats.get("params_bytes"))])
+        emit("decode_tick_weight_bytes", "gauge",
+             "Weight bytes ONE decode tick streams from HBM (block stack "
+             "+ final norm + LM head at storage width).",
+             [({}, engine_stats.get("tick_weight_bytes"))])
+        roof = engine_stats.get("decode_roofline") or {}
+        emit("decode_tick_kv_bytes", "gauge",
+             "Live KV bytes one decode tick streams at current occupancy "
+             "(positions x per-position footprint, read + write row).",
+             [({}, roof.get("kv_bytes"))])
+        emit("decode_tick_arithmetic_intensity", "gauge",
+             "Decode-tick FLOPs per HBM byte (weights + KV + activations) "
+             "— below the chip ridge point the tick is memory-bound.",
+             [({}, roof.get("arithmetic_intensity"))])
+        emit("decode_tick_projected_seconds", "gauge",
+             "Memory-bound latency floor of one tick: total tick bytes / "
+             "peak HBM bandwidth (null off-TPU).",
+             [({}, roof.get("projected_tick_s"))])
         # Paged-KV pool gauges (present only when the engine is paged):
         # block occupancy drives the fleet router's health weighting,
         # prefix counters quantify the radix cache, pending tokens the

@@ -33,6 +33,8 @@ import uuid
 from pathlib import Path
 from typing import Iterator
 
+import numpy as np
+
 from bpe_transformer_tpu.serving.engine import SlotPoolEngine, TickEvent
 from bpe_transformer_tpu.serving.metrics import ServingMetrics, render_prometheus
 from bpe_transformer_tpu.serving.scheduler import (
@@ -193,6 +195,8 @@ class ServingEngine:
         prefill_token_budget: int | None = None,
         prefix_cache: bool = True,
         kv_dtype: str | None = None,
+        weight_dtype: str | None = None,
+        fused_sampling: bool = False,
         speculate_k: int = 0,
         draft_spec=None,
     ):
@@ -220,7 +224,8 @@ class ServingEngine:
                 num_blocks=num_kv_blocks,
                 prefill_buckets=prefill_buckets, min_bucket=min_bucket,
                 prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
-                kv_dtype=kv_dtype,
+                kv_dtype=kv_dtype, weight_dtype=weight_dtype,
+                fused_sampling=fused_sampling,
             )
         elif paged:
             from bpe_transformer_tpu.serving.kvpool.paged_engine import (
@@ -232,12 +237,14 @@ class ServingEngine:
                 num_blocks=num_kv_blocks,
                 prefill_buckets=prefill_buckets, min_bucket=min_bucket,
                 prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
-                kv_dtype=kv_dtype,
+                kv_dtype=kv_dtype, weight_dtype=weight_dtype,
+                fused_sampling=fused_sampling,
             )
         else:
             self.engine = SlotPoolEngine(
                 params, config, slots=slots,
                 prefill_buckets=prefill_buckets, min_bucket=min_bucket,
+                weight_dtype=weight_dtype, fused_sampling=fused_sampling,
             )
         self.paged = paged
         #: Speculative decoding active (the engine is a SpecEngine): the
@@ -482,6 +489,59 @@ class ServingEngine:
             return True
         return False
 
+    def decode_roofline(self) -> dict:
+        """The decode tick's analytic roofline at CURRENT occupancy
+        (`telemetry.attribution.decode_tick_roofline`): per tick, the
+        weight sweep is the engine's resident matmul-weight bytes
+        (int8-halved under ``weight_dtype="int8"``), the KV stream is the
+        live positions times the per-position footprint (int8-halved
+        under ``kv_dtype="int8"``), and activations are a documented
+        estimate — transient block tensors plus the vocab-sized tail
+        (unfused: logits + masked-logits + gumbel round trips; fused:
+        only the caller-side gumbel tensor the kernel reads)."""
+        import jax
+
+        from bpe_transformer_tpu.telemetry.attribution import (
+            decode_tick_roofline,
+        )
+        from bpe_transformer_tpu.utils.flops import decode_tick_flops
+
+        engine = self.engine
+        config = engine.config
+        active = engine.active_count
+        positions = engine._positions
+        live = int(((positions + 1) * engine._active).sum())
+        act_itemsize = np.dtype(config.activation_dtype).itemsize
+        # ~12 d_model-sized transients per token per block (q/k/v/att/
+        # norms/ffn intermediates at d_ff ~ 2.7 d) — an estimate, labeled
+        # as such.  The vocab-sized tail: unfused pays ~3 (slots, vocab)
+        # f32 round trips (logits, filter_logits' masked copy, the
+        # categorical gumbel; the sort passes are extra, uncounted);
+        # fused still pays ONE — the caller-side gumbel tensor the kernel
+        # reads (drawing it in-kernel would delete it; noted, not done) —
+        # so fusion shrinks the term 3x, never to zero.
+        act_bytes = active * config.num_layers * 12 * config.d_model * (
+            act_itemsize
+        )
+        vocab_trip = 2 * active * config.vocab_size * 4
+        act_bytes += vocab_trip if engine.fused_sampling else 3 * vocab_trip
+        row = decode_tick_roofline(
+            flops=decode_tick_flops(config, active, live),
+            weight_bytes=engine.tick_weight_bytes,
+            kv_bytes=engine.kv_bytes_per_token * (live + active),
+            act_bytes=act_bytes,
+            device_kind=jax.devices()[0].device_kind,
+        )
+        row.update(
+            {
+                "active_slots": active,
+                "live_positions": live,
+                "weight_dtype": engine.weight_dtype,
+                "fused_sampling": engine.fused_sampling,
+            }
+        )
+        return row
+
     def stats(self) -> dict:
         """Engine/queue gauges + the live request counters — the same
         aggregate ``GET /metrics`` renders, reachable offline.  A paged
@@ -499,6 +559,14 @@ class ServingEngine:
             "requests_finished": self._requests_finished,
             "compiled_programs": self.engine.compiled_programs(),
             "prefill_buckets": list(self.engine.buckets),
+            # Quantized-decode gauges (ISSUE 11): what the weights weigh,
+            # at what width, and what one tick streams — plus the
+            # analytic tick roofline the report/compare gate reads.
+            "weight_dtype": self.engine.weight_dtype,
+            "params_bytes": self.engine.params_bytes,
+            "tick_weight_bytes": self.engine.tick_weight_bytes,
+            "fused_sampling": self.engine.fused_sampling,
+            "decode_roofline": self.decode_roofline(),
             **self.metrics.snapshot(),
         }
         if self.paged:
@@ -528,6 +596,10 @@ class ServingEngine:
             # replica saturated with prefills must not look idle.
             "draining": self._draining,
             "speculate_k": self.engine.k if self.spec else None,
+            "weight_dtype": self.engine.weight_dtype,
+            "params_bytes": self.engine.params_bytes,
+            "fused_sampling": self.engine.fused_sampling,
+            "decode_roofline": self.decode_roofline(),
             "compiled_programs": self.engine.compiled_programs(),
             "compile_events": resources["compile_events"],
             "prefill_buckets": list(self.engine.buckets),
@@ -975,6 +1047,30 @@ class ServingEngine:
         # trends of a serving process are as load-bearing as tokens/sec.
         self._telemetry.emit(
             sample_resources(t=round(now - self._t0, 6))
+        )
+        # Decode-tick roofline on the same cadence (every engine kind):
+        # the weight/KV/activation byte split of one tick at current
+        # occupancy vs the chip ridge point — the record the report's
+        # roofline section and the serve_weight_bytes compare-gate row
+        # read (ISSUE 11).
+        roof = self.decode_roofline()
+        self._telemetry.emit(
+            {
+                "kind": "roofline",
+                "t": round(now - self._t0, 6),
+                "weight_bytes": roof["weight_bytes"],
+                "kv_bytes": roof["kv_bytes"],
+                "act_bytes": roof["act_bytes"],
+                "flops": roof["flops"],
+                "arithmetic_intensity": roof["arithmetic_intensity"],
+                "ridge_flops_per_byte": roof["ridge_flops_per_byte"],
+                "bound": roof["bound"],
+                "projected_tick_s": roof["projected_tick_s"],
+                "weight_frac": roof["weight_frac"],
+                "active_slots": roof["active_slots"],
+                "weight_dtype": roof["weight_dtype"],
+                "fused_sampling": roof["fused_sampling"],
+            }
         )
         if self.paged:
             # Paged-pool accounting on the same cadence: block occupancy,

@@ -158,6 +158,8 @@ class DraftModel:
             lm_head_weight,
         )
 
+        from bpe_transformer_tpu.ops.quant import is_quantized
+
         self.spec = spec
         self.config = spec.resolve(target_config)
         self.truncated = spec.truncate_layers is not None
@@ -172,18 +174,33 @@ class DraftModel:
                     jax.random.PRNGKey(spec.seed), self.config
                 )
         act_dtype = jnp.dtype(self.config.activation_dtype)
-        self.lm_head = lm_head_weight(params, self.config).astype(act_dtype)
-        # Cast only leaves that NEED it: an already-cast leaf passes
-        # through untouched, so a truncated view built from the serving
-        # engine's compute-dtype params (`SpecEngine` passes those) keeps
-        # sharing the target's arrays even off float32.
+        head = lm_head_weight(params, self.config)
+        # int8-quantized weights (ops/quant.py dicts — a truncated view of
+        # an engine built with weight_dtype="int8") pass through whole:
+        # the draft's decode programs dispatch them through the same
+        # dequant-in-register matmul the target uses, so a truncated
+        # draft stays a zero-copy view of the quantized tree.
+        self.lm_head = head if is_quantized(head) else head.astype(act_dtype)
+        # Cast only when a leaf NEEDS it: an already-cast tree passes
+        # through UNTOUCHED (same containers, same arrays), so a
+        # truncated view built from the serving engine's compute-dtype
+        # params (`SpecEngine` passes those) keeps sharing the target's
+        # arrays even off float32.  Quantized dicts are opaque leaves
+        # here — int8 payloads and f32 scales are already at their
+        # storage widths and must never be "cast".
         if any(
             leaf.dtype != act_dtype
-            for leaf in jax.tree_util.tree_leaves(params)
+            for leaf in jax.tree_util.tree_leaves(params, is_leaf=is_quantized)
+            if not is_quantized(leaf)
         ):
             params = jax.tree_util.tree_map(
-                lambda p: p if p.dtype == act_dtype else p.astype(act_dtype),
+                lambda p: (
+                    p
+                    if is_quantized(p) or p.dtype == act_dtype
+                    else p.astype(act_dtype)
+                ),
                 params,
+                is_leaf=is_quantized,
             )
         self.params = params
         #: EXTRA draft weight bytes: leaves not shared with the target's

@@ -71,7 +71,7 @@ __all__ = ["SpecEngine"]
 def _spec_verify_program(
     params, lm_head, pool, tables, base_tokens, draft_tokens, draft_probs,
     positions, rooms, active, keys, temps, top_ks, top_ps,
-    *, config: ModelConfig, block_size: int,
+    *, config: ModelConfig, block_size: int, fused: bool = False,
 ):
     """One speculative tick's target half: score K+1 positions, run the
     acceptance rule, sample the bonus/correction token — all on device,
@@ -84,62 +84,127 @@ def _spec_verify_program(
     speculation (context edge / block-starved scratch) inside the one
     fixed-K program.  Returns ``(out_tokens (S, K+1), n_emit (S,),
     keys, pool)`` — ``out_tokens[:n_emit]`` are the tick's emissions.
+
+    ``fused=True`` moves the whole vocab-sized tail — head projection,
+    `filter_logits`, the filtered probabilities ``p(d)`` the accept rule
+    reads, and the residual ``max(p − q, 0)`` bonus sample — into ONE
+    Pallas kernel (`kernels/pallas/sample.py::fused_verify_head`): the
+    (S·(K+1), vocab) logits never reach HBM and the per-row sort chain
+    is gone; what remains outside is O(S·K) acceptance bookkeeping.
+    The residual is sampled for EVERY candidate row (cheap vector math
+    against per-row gumbel noise) and row ``n_acc``'s sample is selected
+    — each row's draw is an independent categorical from that row's
+    residual law, so the emitted distribution is unchanged; greedy
+    output is token-identical to the unfused program.
     """
     s, k = draft_tokens.shape
     k1 = k + 1
     vocab = config.vocab_size
     tokens = jnp.concatenate([base_tokens[:, None], draft_tokens], axis=1)
-    logits, pool = paged_verify_step(
-        params, tokens, positions, rooms, pool, tables, config,
-        lm_head=lm_head, active=active, block_size=block_size,
-    )
-
-    # Target distribution per row under the slot's runtime knobs; greedy
-    # rows are EXACT one-hots (argmax of the raw logits), so greedy
-    # acceptance is an integer comparison, not a float threshold.
-    flat = logits.reshape(s * k1, vocab)
-    rep = lambda a: jnp.repeat(a, k1, axis=0)  # noqa: E731 — row-major rows
-    filt = filter_logits(flat, rep(temps), rep(top_ks), rep(top_ps))
-    p_soft = jax.nn.softmax(filt, axis=-1).reshape(s, k1, vocab)
-    greedy_tok = jnp.argmax(logits, axis=-1)  # (S, K+1)
-    p_greedy = jax.nn.one_hot(greedy_tok, vocab, dtype=p_soft.dtype)
-    p = jnp.where((temps > 0.0)[:, None, None], p_soft, p_greedy)
-
-    q = draft_probs.astype(p.dtype)  # (S, K, V)
-    p_d = jnp.take_along_axis(
-        p[:, :k], draft_tokens[..., None], axis=-1
-    )[..., 0]
-    q_d = jnp.take_along_axis(q, draft_tokens[..., None], axis=-1)[..., 0]
 
     split = jax.vmap(lambda kk: jax.random.split(kk, 3))(keys)
     keys_next, u_keys, b_keys = split[:, 0], split[:, 1], split[:, 2]
     u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(u_keys)
     judged = jnp.arange(k)[None, :] < rooms[:, None]
-    # Leviathan: accept d iff u*q(d) < p(d).  Greedy: q_d == 1 and p_d is
-    # 0/1, so this is exactly "target argmax == draft token".
-    accept = (u * q_d < p_d) & judged
-    n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    q = draft_probs  # (S, K, V)
+    q_d = jnp.take_along_axis(q, draft_tokens[..., None], axis=-1)[..., 0]
+    # Rows at/after the judged window verify against NO draft mass: row
+    # n_acc == min(rooms, k) is the all-accepted bonus row, whose
+    # distribution is p itself (q treated as 0 there).
+    lim = jnp.minimum(rooms, k)
+    q_pad = jnp.concatenate(
+        [q, jnp.zeros((s, 1, vocab), q.dtype)], axis=1
+    )
+    q_pad = jnp.where(
+        (jnp.arange(k1)[None, :] < lim[:, None])[..., None], q_pad, 0.0
+    )
 
-    # Bonus row: the residual max(p - q, 0) at the first rejection, p
-    # itself when every judged row accepted (row n_acc is then the first
-    # unjudged position — a free extra token per fully-accepted window).
-    row = n_acc[:, None, None]
-    p_row = jnp.take_along_axis(p, row, axis=1)[:, 0]
-    q_pad = jnp.concatenate([q, jnp.zeros((s, 1, vocab), q.dtype)], axis=1)
-    q_row = jnp.take_along_axis(q_pad, row, axis=1)[:, 0]
-    all_accepted = n_acc >= jnp.minimum(rooms, k)
-    residual = jnp.where(
-        all_accepted[:, None], p_row, jnp.maximum(p_row - q_row, 0.0)
-    )
-    # p == q exactly would accept with probability 1, so a rejection
-    # implies positive residual mass; the fallback guards rounding.
-    has_mass = jnp.sum(residual, axis=-1, keepdims=True) > 0
-    residual = jnp.where(has_mass, residual, p_row)
-    res_logits = jnp.where(residual > 0, jnp.log(residual), -jnp.inf)
-    bonus_sampled = jax.vmap(jax.random.categorical)(b_keys, res_logits)
-    bonus = jnp.where(
-        temps > 0.0, bonus_sampled, jnp.argmax(residual, axis=-1)
-    )
+    if fused:
+        from bpe_transformer_tpu.kernels.pallas.sample import (
+            fused_verify_head,
+        )
+        from bpe_transformer_tpu.serving.engine import gumbel_rows
+
+        hidden, pool = paged_verify_step(
+            params, tokens, positions, rooms, pool, tables, config,
+            lm_head=lm_head, active=active, return_hidden=True,
+            block_size=block_size,
+        )  # (S, K+1, d)
+        rep = lambda a: jnp.repeat(a, k1, axis=0)  # noqa: E731
+        judge = jnp.concatenate(
+            [draft_tokens, jnp.zeros((s, 1), draft_tokens.dtype)], axis=1
+        )
+        gumbel = jax.vmap(
+            lambda kk: jax.random.gumbel(kk, (k1, vocab), jnp.float32)
+        )(b_keys)
+        greedy, p_d_soft, bonus_rows = fused_verify_head(
+            hidden.reshape(s * k1, -1), lm_head,
+            rep(temps), rep(top_ks), rep(top_ps),
+            judge.reshape(-1), q_pad.reshape(s * k1, vocab),
+            gumbel.reshape(s * k1, vocab),
+        )
+        greedy = greedy.reshape(s, k1)
+        # Greedy rows' p is an exact one-hot: p(d) is argmax agreement.
+        p_d_full = jnp.where(
+            (temps > 0.0)[:, None],
+            p_d_soft.reshape(s, k1),
+            (greedy == judge).astype(jnp.float32),
+        )
+        p_d = p_d_full[:, :k]
+        accept = (u * q_d < p_d) & judged
+        n_acc = jnp.sum(
+            jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+        )
+        bonus = jnp.take_along_axis(
+            bonus_rows.reshape(s, k1), n_acc[:, None], axis=1
+        )[:, 0]
+    else:
+        logits, pool = paged_verify_step(
+            params, tokens, positions, rooms, pool, tables, config,
+            lm_head=lm_head, active=active, block_size=block_size,
+        )
+
+        # Target distribution per row under the slot's runtime knobs;
+        # greedy rows are EXACT one-hots (argmax of the raw logits), so
+        # greedy acceptance is an integer comparison, not a float
+        # threshold.
+        flat = logits.reshape(s * k1, vocab)
+        rep = lambda a: jnp.repeat(a, k1, axis=0)  # noqa: E731
+        filt = filter_logits(flat, rep(temps), rep(top_ks), rep(top_ps))
+        p_soft = jax.nn.softmax(filt, axis=-1).reshape(s, k1, vocab)
+        greedy_tok = jnp.argmax(logits, axis=-1)  # (S, K+1)
+        p_greedy = jax.nn.one_hot(greedy_tok, vocab, dtype=p_soft.dtype)
+        p = jnp.where((temps > 0.0)[:, None, None], p_soft, p_greedy)
+
+        p_d = jnp.take_along_axis(
+            p[:, :k], draft_tokens[..., None], axis=-1
+        )[..., 0]
+        # Leviathan: accept d iff u*q(d) < p(d).  Greedy: q_d == 1 and
+        # p_d is 0/1, so this is exactly "target argmax == draft token".
+        accept = (u * q_d.astype(p.dtype) < p_d) & judged
+        n_acc = jnp.sum(
+            jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+        )
+
+        # Bonus row: the residual max(p - q, 0) at the first rejection, p
+        # itself when every judged row accepted (row n_acc is then the
+        # first unjudged position — q_pad is zeroed there, so one formula
+        # covers both; a free extra token per fully-accepted window).
+        row = n_acc[:, None, None]
+        p_row = jnp.take_along_axis(p, row, axis=1)[:, 0]
+        q_row = jnp.take_along_axis(
+            q_pad.astype(p.dtype), row, axis=1
+        )[:, 0]
+        residual = jnp.maximum(p_row - q_row, 0.0)
+        # p == q exactly would accept with probability 1, so a rejection
+        # implies positive residual mass; the fallback guards rounding.
+        has_mass = jnp.sum(residual, axis=-1, keepdims=True) > 0
+        residual = jnp.where(has_mass, residual, p_row)
+        res_logits = jnp.where(residual > 0, jnp.log(residual), -jnp.inf)
+        bonus_sampled = jax.vmap(jax.random.categorical)(b_keys, res_logits)
+        bonus = jnp.where(
+            temps > 0.0, bonus_sampled, jnp.argmax(residual, axis=-1)
+        )
 
     iota = jnp.arange(k1)[None, :]
     d_pad = jnp.concatenate([draft_tokens, draft_tokens[:, -1:]], axis=1)
@@ -221,7 +286,7 @@ class SpecEngine(PagedEngine):
         self._verify_jit = jax.jit(
             functools.partial(
                 _spec_verify_program, config=config,
-                block_size=self.block_size,
+                block_size=self.block_size, fused=self.fused_sampling,
             )
         )
 

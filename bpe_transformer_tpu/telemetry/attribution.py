@@ -54,6 +54,7 @@ from bpe_transformer_tpu.utils.flops import (
 
 __all__ = [
     "StepProbe",
+    "decode_tick_roofline",
     "program_cost",
     "roofline",
     "serving_program_costs",
@@ -147,6 +148,51 @@ def roofline(
         "ridge_flops_per_byte": round(ridge, 3) if ridge is not None else None,
         "bound": bound,
     }
+
+
+def decode_tick_roofline(
+    *,
+    flops: float,
+    weight_bytes: float,
+    kv_bytes: float,
+    act_bytes: float,
+    device_kind: str,
+) -> dict:
+    """The serving decode tick's analytic roofline: its HBM byte stream
+    decomposed into **weights** (the per-tick sweep of the matmul
+    weights — what int8 quantization halves vs bf16), **KV** (the live
+    attention read stream — what int8 KV blocks halve), and
+    **activations** (transient tensors, estimated), against the chip
+    ridge point.
+
+    Unlike :func:`roofline` (which reads XLA's ``cost_analysis`` of a
+    compiled program), this is a *first-principles* model from engine
+    facts — resident weight bytes, live cache positions, tick FLOPs
+    (`utils.flops.decode_tick_flops`) — so the weight/KV split is
+    attributable: the compare gate can pin "serving weight bytes per
+    tick" directly, and ``projected_tick_s`` (total bytes / peak HBM
+    bandwidth) is the memory-bound latency floor the measured tick is
+    judged against.  Returns a JSON-ready dict extending the
+    :func:`roofline` row with the byte decomposition.
+    """
+    total = float(weight_bytes) + float(kv_bytes) + float(act_bytes)
+    row = roofline(
+        flops if flops else None, total if total else None, device_kind,
+        name="decode_tick",
+    )
+    peak_bw = peak_hbm_bytes_per_sec(device_kind)
+    row.update(
+        {
+            "weight_bytes": int(weight_bytes),
+            "kv_bytes": int(kv_bytes),
+            "act_bytes": int(act_bytes),
+            "weight_frac": round(weight_bytes / total, 4) if total else None,
+            "projected_tick_s": (
+                round(total / peak_bw, 9) if peak_bw and total else None
+            ),
+        }
+    )
+    return row
 
 
 # ------------------------------------------------------------ step probe

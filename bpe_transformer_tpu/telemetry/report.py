@@ -306,6 +306,33 @@ def summarize(records: list[dict]) -> dict:
             "kv_bytes_per_token": last.get("kv_bytes_per_token"),
         }
 
+    # Decode-tick roofline trajectory (kind="roofline", ISSUE 11): the
+    # weight sweep is static per run (last sample wins — the compare
+    # gate's serve_weight_bytes), the KV/activation terms track occupancy.
+    roofline_records = [r for r in records if r.get("kind") == "roofline"]
+    roofline_summary = None
+    if roofline_records:
+        last = roofline_records[-1]
+        roofline_summary = {
+            "n": len(roofline_records),
+            "weight_bytes": last.get("weight_bytes"),
+            "weight_dtype": last.get("weight_dtype"),
+            "fused_sampling": last.get("fused_sampling"),
+            "kv_bytes": _stats(
+                [r.get("kv_bytes") for r in roofline_records]
+            ),
+            "act_bytes": _stats(
+                [r.get("act_bytes") for r in roofline_records]
+            ),
+            "arithmetic_intensity": _stats(
+                [r.get("arithmetic_intensity") for r in roofline_records]
+            ),
+            "ridge_flops_per_byte": last.get("ridge_flops_per_byte"),
+            "bound": last.get("bound"),
+            "weight_frac": last.get("weight_frac"),
+            "projected_tick_s": last.get("projected_tick_s"),
+        }
+
     # Speculative-decoding trajectory (kind="spec", serving/spec/): every
     # counter is cumulative, so the LAST sample is the run's verdict —
     # accept_rate tells whether the draft earns its keep,
@@ -584,6 +611,7 @@ def summarize(records: list[dict]) -> dict:
         "serving": serving,
         "kvpool": kvpool_summary,
         "spec": spec_summary,
+        "roofline": roofline_summary,
         "resources": resource_summary,
         "attribution": attribution_summary,
         "dynamics": dynamics_summary,
@@ -752,6 +780,38 @@ def render_report(records: list[dict]) -> str:
                     else ""
                 )
             )
+
+    rf = s.get("roofline")
+    if rf:
+        lines.append(f"== decode roofline ({rf['n']} samples) ==")
+        kvb = rf.get("kv_bytes") or {}
+        lines.append(
+            f"  tick weights {_fmt(rf['weight_bytes'])} B"
+            + (
+                f" ({rf['weight_dtype']})"
+                if rf.get("weight_dtype")
+                else ""
+            )
+            + f"  kv last {_fmt(kvb.get('last'))} B (max {_fmt(kvb.get('max'))})"
+            + (
+                f"  weight frac {rf['weight_frac']:.0%}"
+                if isinstance(rf.get("weight_frac"), float)
+                else ""
+            )
+        )
+        ai = rf.get("arithmetic_intensity") or {}
+        ridge = rf.get("ridge_flops_per_byte")
+        lines.append(
+            f"  intensity last {_fmt(ai.get('last'))} flops/B"
+            + (f"  ridge {_fmt(ridge)}" if ridge is not None else "")
+            + f"  verdict {rf.get('bound')}"
+            + (
+                f"  floor {rf['projected_tick_s'] * 1e3:.3f} ms/tick"
+                if isinstance(rf.get("projected_tick_s"), (int, float))
+                else ""
+            )
+            + ("  (fused sampling)" if rf.get("fused_sampling") else "")
+        )
 
     sp = s.get("spec")
     if sp:
@@ -1023,6 +1083,12 @@ COMPARE_METRICS: dict = {
         "lower"),
     "kv_pool_bytes": (
         lambda s: (s.get("kvpool") or {}).get("kv_pool_bytes"), "lower"),
+    # Serving weight bytes per tick (ISSUE 11): a run whose decode tick
+    # streams more weight bytes than its int8 baseline lost the weight-
+    # quantization win — the memory-bound tick's latency floor moves with
+    # this number, so it gates like a throughput regression.
+    "serve_weight_bytes": (
+        lambda s: (s.get("roofline") or {}).get("weight_bytes"), "lower"),
     # Speculative-decoding effectiveness (kind="spec"): a workload whose
     # draft acceptance falls — or whose emitted-tokens-per-verify-pass
     # sinks toward 1.0 — lost the tick-count win speculation pays for

@@ -109,6 +109,22 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
     "spec": {
         "kind", "t", "k", "proposed", "accepted", "emitted", "target_steps",
     },
+    # Decode-tick roofline sample (serving/server.py, every engine kind),
+    # emitted on the engine-record cadence: the analytic HBM byte split of
+    # ONE decode tick at current occupancy — ``weight_bytes`` (the matmul
+    # weight sweep int8 weight quantization halves vs bf16), ``kv_bytes``
+    # (the live attention stream int8 KV blocks halve), optional
+    # ``act_bytes`` (transient estimate; fused sampling shrinks the
+    # vocab-sized tail to one gumbel round trip) — plus the tick ``flops``
+    # (utils/flops.decode_tick_flops) and the derived
+    # ``arithmetic_intensity`` / ``ridge_flops_per_byte`` / ``bound``
+    # verdict / ``projected_tick_s`` memory-bound floor (null off-TPU),
+    # ``weight_frac``, occupancy (``active_slots``) and the
+    # ``weight_dtype`` / ``fused_sampling`` knobs that produced it.
+    # ``weight_bytes`` feeds the report compare gate (serve_weight_bytes).
+    "roofline": {
+        "kind", "t", "weight_bytes", "kv_bytes", "flops",
+    },
     # Run trailer: record counts + clean verdict (spans.py Telemetry.footer).
     "footer": {"kind", "t", "record_counts"},
     # Step/val metrics (NO kind key): at least a step number plus one

@@ -366,6 +366,14 @@ def cmd_serve(args) -> int:
         model_config = dataclasses.replace(
             model_config, decode_attention_impl=args.decode_attention
         )
+    if args.weight_dtype == "int8" and model_config.ffn_type == "moe":
+        # The per-channel quantizer covers dense matmul weights; MoE
+        # expert stacks route through the gather dispatch it does not.
+        # A config error, not a degraded mode — refuse at startup.
+        print("serve: --weight-dtype int8 does not cover MoE expert "
+              "stacks; serve this config at the activation width",
+              file=sys.stderr)
+        return 2
     if draft_spec is not None:
         # Vocab/geometry compatibility against the RESOLVED target config:
         # rejection sampling compares distributions over one shared
@@ -406,6 +414,10 @@ def cmd_serve(args) -> int:
         prefill_token_budget=args.prefill_budget,
         prefix_cache=not args.no_prefix_cache,
         kv_dtype=None if args.kv_dtype == "act" else args.kv_dtype,
+        weight_dtype=(
+            None if args.weight_dtype == "act" else args.weight_dtype
+        ),
+        fused_sampling=args.fused_sampling,
         speculate_k=args.speculate,
         draft_spec=draft_spec,
     )
@@ -700,12 +712,25 @@ def cmd_warmup(args) -> int:
         model_config = dataclasses.replace(
             model_config, decode_attention_impl=args.decode_attention
         )
+    if args.weight_dtype in ("int8", "both") and model_config.ffn_type == "moe":
+        print("warmup: --weight-dtype int8 does not cover MoE expert "
+              "stacks", file=sys.stderr)
+        return 2
     if draft_spec is not None:
         try:
             draft_spec.validate_against(model_config)
         except ValueError as exc:
             print(f"warmup: {exc}", file=sys.stderr)
             return 2
+
+    # Weight widths to warm: int8-quantized weights lower to DIFFERENT
+    # programs (dequant-in-register matmuls), so a --weight-dtype int8
+    # replica restarting against a cache warmed only at the activation
+    # width would cold-compile its whole ladder; "both" lands every
+    # program (PR 9's kv-dtype pattern).
+    weight_dtypes: list[str | None] = {
+        "act": [None], "int8": ["int8"], "both": [None, "int8"],
+    }[args.weight_dtype]
 
     factories = []
     kv_dtypes: list[str | None] = [None]
@@ -729,22 +754,33 @@ def cmd_warmup(args) -> int:
         else:
             cls, extra = PagedEngine, {}
         for kv_dtype in kv_dtypes:
-            # prefix_cache OFF: warmup's point is compiling every ladder
-            # rung, and its repeated dummy prompts would otherwise share a
-            # prefix and shrink later rungs' chunks into already-compiled
-            # programs.
-            factories.append(lambda kv_dtype=kv_dtype: cls(
-                params, model_config, slots=args.slots,
-                block_size=args.block_size, num_blocks=args.num_kv_blocks,
-                prefill_chunk=args.prefill_chunk, prefix_cache=False,
-                kv_dtype=kv_dtype, **extra,
-            ))
+            for weight_dtype in weight_dtypes:
+                # prefix_cache OFF: warmup's point is compiling every
+                # ladder rung, and its repeated dummy prompts would
+                # otherwise share a prefix and shrink later rungs' chunks
+                # into already-compiled programs.
+                factories.append(
+                    lambda kv_dtype=kv_dtype, weight_dtype=weight_dtype: cls(
+                        params, model_config, slots=args.slots,
+                        block_size=args.block_size,
+                        num_blocks=args.num_kv_blocks,
+                        prefill_chunk=args.prefill_chunk,
+                        prefix_cache=False, kv_dtype=kv_dtype,
+                        weight_dtype=weight_dtype,
+                        fused_sampling=args.fused_sampling, **extra,
+                    )
+                )
     else:
         from bpe_transformer_tpu.serving import SlotPoolEngine
 
-        factories.append(
-            lambda: SlotPoolEngine(params, model_config, slots=args.slots)
-        )
+        for weight_dtype in weight_dtypes:
+            factories.append(
+                lambda weight_dtype=weight_dtype: SlotPoolEngine(
+                    params, model_config, slots=args.slots,
+                    weight_dtype=weight_dtype,
+                    fused_sampling=args.fused_sampling,
+                )
+            )
 
     ctx = model_config.context_length
     programs = 0
@@ -784,6 +820,8 @@ def cmd_warmup(args) -> int:
         "speculate": args.speculate or None,
         "decode_attention": model_config.decode_attention_impl,
         "kv_dtypes": [d or "act" for d in kv_dtypes] if args.paged else None,
+        "weight_dtypes": [d or "act" for d in weight_dtypes],
+        "fused_sampling": args.fused_sampling,
         "cache_dir": str(args.compile_cache),
         "cache_hits": compile_cache_hits(),
     }
@@ -1331,6 +1369,20 @@ def build_parser() -> argparse.ArgumentParser:
                    "the per-tick contiguous KV gather; 'pallas' is flash "
                    "decode over the gathered cache; default: checkpoint "
                    "config (xla)")
+    p.add_argument("--weight-dtype", choices=("act", "int8"), default="act",
+                   help="serving weight storage width: 'int8' quantizes "
+                   "the matmul weights per output channel at engine build "
+                   "(scales captured once) and every program dequantizes "
+                   "in registers — ~2x less weight HBM traffic per decode "
+                   "tick vs bf16, bounded logit error; embeddings/norms "
+                   "stay at the activation width (MoE configs rejected)")
+    p.add_argument("--fused-sampling", action="store_true",
+                   help="fuse the decode tick's tail — head projection + "
+                   "top-k/top-p filtering + sampling (and the spec-decode "
+                   "accept/residual distributions) — into one Pallas "
+                   "kernel: logits never reach HBM and the per-tick sort "
+                   "chain is gone; greedy output is token-identical to "
+                   "the unfused path")
     p.add_argument("--speculate", type=int, default=0, metavar="K",
                    help="speculative decoding (with --paged + "
                    "--draft-config): a small draft model proposes K "
@@ -1405,6 +1457,16 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("xla", "pallas", "paged"), default=None,
                    help="warm this decode-attention ladder (use 'paged' "
                    "for --decode-attention paged replicas)")
+    p.add_argument("--weight-dtype", choices=("act", "int8", "both"),
+                   default="act",
+                   help="which weight storage widths to warm: int8 "
+                   "weights lower to different (dequant-in-register) "
+                   "programs; 'both' lands every program in the cache so "
+                   "a replica restarting with either --weight-dtype hits "
+                   "(one engine resident at a time)")
+    p.add_argument("--fused-sampling", action="store_true",
+                   help="warm the fused sample-in-kernel tick programs "
+                   "(serve --fused-sampling replicas)")
     p.add_argument("--speculate", type=int, default=0, metavar="K",
                    help="warm the speculative-decoding programs (with "
                    "--paged + --draft-config): target chunk ladder + "

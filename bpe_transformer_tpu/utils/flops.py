@@ -113,6 +113,21 @@ def train_step_flops(config: ModelConfig, batch: int, seq: int | None = None) ->
     return 3.0 * (matmul + attention)
 
 
+def decode_tick_flops(
+    config: ModelConfig, n_tokens: int, kv_positions: int
+) -> float:
+    """Model FLOPs of ONE serving decode tick: ``n_tokens`` single-token
+    forwards (each sweeps the matmul weights once) plus attention against
+    ``kv_positions`` total visible cache positions (summed over the active
+    slots — per token the QK^T and AV contractions cost ``4 * d_model``
+    FLOPs per visible key per layer, the decode slice of the training
+    estimate above).  The numerator of the decode-tick roofline
+    (`telemetry.attribution.decode_tick_roofline`)."""
+    matmul = 2.0 * matmul_param_count(config) * n_tokens
+    attention = 4.0 * config.num_layers * config.d_model * kv_positions
+    return matmul + attention
+
+
 def peak_flops_per_chip(device_kind: str) -> float | None:
     """Peak bf16 FLOPs/sec for a TPU device_kind string, or None if unknown
     (warned once per kind — a silent None quietly disables MFU)."""

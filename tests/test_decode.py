@@ -230,6 +230,7 @@ def test_top_p_sampling_masks_tail(setup):
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < CFG.vocab_size))
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_moe_decode_default_capacity_no_drops():
     """At the DEFAULT capacity_factor the cached path must not drop tokens
     its full forward keeps: decode derives capacity from context_length
@@ -268,6 +269,7 @@ def test_moe_decode_step_dropfree_with_degenerate_capacity():
     _stepwise_decode_parity(params, ids, cfg, forward(params, ids, nodrop), 2)
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_bf16_cached_decode_close_to_bf16_forward():
     """The cached path honors activation_dtype: under bf16 the whole chain
     (params cast once, bf16 KV cache, bf16 einsums, f32 softmax/logits)
@@ -312,6 +314,7 @@ def test_generate_ids_bf16_uses_cached_fast_path(monkeypatch):
     assert len(out) == 6 and all(0 <= t < cfg.vocab_size for t in out)
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_pallas_decode_attention_impl_matches_xla(setup):
     """decode_attention_impl="pallas" (flash-decoding kernel) reproduces the
     grouped-einsum decode path: same greedy tokens end-to-end and matching
@@ -421,6 +424,7 @@ def test_sample_from_logits_edge_cases():
     assert support(2, 0.99) == {0, 1}
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_generate_cached_stop_id_pins_and_truncates(setup):
     """Satellite: the KV-cached fast path honors stop_id — post-stop tokens
     are pinned to stop_id inside the scan, and generate_ids' host-side

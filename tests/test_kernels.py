@@ -249,6 +249,7 @@ def test_model_fused_flash_attention_matches_xla_impl():
     )
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_flash_fused_crossover_dispatch(monkeypatch):
     """Below flash_fused_min_seq the model must run the PLAIN flash kernel
     (RoPE outside) — the fused kernel loses at short seq on-chip (r2 bench:

@@ -154,6 +154,7 @@ def test_prefill_budget_policy():
 # ------------------------------------------------------ engine parity
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_paged_parity_with_dense_engine(setup, dense_engine, paged_engine):
     """ACCEPTANCE: the paged engine's outputs are token-identical to the
     dense slot-pool engine for the same requests/seeds — across greedy
@@ -284,6 +285,7 @@ def test_block_starved_pool_raises_then_recovers(setup):
 # ---------------------------------------------------- serving integration
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_block_starved_backlog_parks_expires_and_drains(setup):
     """ServingEngine over a block-starved paged pool, driven by hand: a
     second request parks in the admission backlog; a parked request whose
@@ -501,8 +503,9 @@ def test_warmup_cli_two_process_cache_hits(tmp_path):
                 "warmup", "--compile-cache", str(cache_dir),
                 "--preset", "ts-test", "--paged", "--block-size", "8",
                 "--slots", "2", "--decode-attention", "paged",
+                "--weight-dtype", "both", "--fused-sampling",
             ],
-            capture_output=True, text=True, timeout=600,
+            capture_output=True, text=True, timeout=900,
             env={**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
                  "PYTHONPATH": str(REPO)},
             cwd=str(REPO),
@@ -512,12 +515,15 @@ def test_warmup_cli_two_process_cache_hits(tmp_path):
 
     cold = run()
     assert cold["cache_hits"] == 0
-    # Default --kv-dtype both: the activation-width AND int8 paged-native
-    # ladders are warmed (ISSUE 9 small fix), each within the per-engine
-    # bounded-compile contract.
+    # Default --kv-dtype both x --weight-dtype both: all four pool-width x
+    # weight-width ladders are warmed (ISSUE 9 + ISSUE 11), ONE engine
+    # resident at a time, each within the per-engine bounded-compile
+    # contract — a replica restarting with any knob combination hits.
     assert cold["kv_dtypes"] == ["act", "int8"]
+    assert cold["weight_dtypes"] == ["act", "int8"]
+    assert cold["fused_sampling"] is True
     assert cold["decode_attention"] == "paged"
-    assert cold["programs_compiled"] <= 2 * (len(cold["buckets"]) + 1)
+    assert cold["programs_compiled"] <= 4 * (len(cold["buckets"]) + 1)
     assert any(cache_dir.rglob("*")), "warmup wrote no cache entries"
     warm = run()
     assert warm["cache_hits"] > 0
@@ -754,6 +760,7 @@ def test_int8_logit_error_bound(setup):
     assert err < 0.05, f"int8 KV logit error {err} exceeds the 0.05 bound"
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_int8_long_decode_quality_smoke(setup, dense_engine, int8_engine):
     """Long-decode smoke vs the full-width pool: a 16-token greedy decode
     through the int8 engine (paged-native kernel) overwhelmingly agrees
@@ -975,6 +982,7 @@ def test_rewind_into_radix_shared_block_copies_on_write(setup):
     assert _run(engine, prompt, max_new_tokens=2, temperature=0.0) == ref
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_rewind_then_regrow_int8_scales_coherent(setup):
     """ACCEPTANCE (satellite): int8 block scales stay sound across rewind
     -> regrow.  Within one occupancy the scale is monotone (rewound rows'
@@ -1034,6 +1042,7 @@ def test_rewind_then_regrow_int8_scales_coherent(setup):
     engine.release(slot)
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_allocator_no_leak_under_rewind_churn(setup):
     """ACCEPTANCE (satellite): randomized admit / extend / rewind /
     release churn returns every block — the allocator's free count ends

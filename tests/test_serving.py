@@ -108,6 +108,7 @@ def test_bounded_compilation_over_mixed_lengths(setup):
     assert engine.compiled_programs() <= len(engine.buckets) + 1
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_slot_reuse_and_interleaved_admission(setup):
     """More requests than slots: retired slots are re-admitted mid-flight
     and each request still matches its solo greedy generation."""
@@ -357,6 +358,7 @@ def test_deadline_and_cancel_results(setup):
     assert expired.result().token_ids == ()
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_drain_finishes_inflight_and_rejects_new(setup):
     """Graceful shutdown (the serve SIGTERM path): drain() stops admission
     but every already-submitted request runs to completion — preemption
@@ -912,6 +914,7 @@ def test_per_bucket_prefill_and_decode_throughput_metrics(setup):
     assert prom["bpe_tpu_compile_time_seconds_total"] > 0
 
 
+@pytest.mark.slow  # 870s tier-1 budget (PR 11 sweep; ISSUE 11 tooling guard) — runs in the full matrix
 def test_statusz_recent_requests_ring_traces_phases(setup):
     """/statusz exposes a per-request trace ring: each finished request's
     queue_wait/prefill/decode timeline with its request_id, bucket, and

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Guard: the tier-1 (``-m 'not slow'``) suite must stay inside its wall
+budget.
+
+The driver gives tier-1 a hard 870 s timeout (ROADMAP "Tier-1 verify");
+PR 9 already had to sweep 27 heavy tests behind ``slow`` to fit it, and
+every PR since has grown the suite.  A suite that silently creeps past
+the budget doesn't fail gracefully — it gets KILLED mid-run and reports
+whatever happened to finish.  This tool makes the creep loud *before*
+that happens, two jax-free ways:
+
+* **Log mode** (default, given a pytest log file): parse the summary
+  trailer (``... passed ... in 612.34s``) of a finished tier-1 run —
+  e.g. the ``/tmp/_t1.log`` the ROADMAP verify command tees — and fail
+  when the measured wall exceeds ``--budget`` (default 800 s, a ~8%
+  margin under the 870 s kill).
+* **Count mode** (``--collect``): run ``pytest --collect-only -q -m 'not
+  slow'`` and fail when the tier-1 test COUNT exceeds ``--max-tests``
+  (default 520).  A proxy, not a measurement — but it runs in seconds,
+  so it can gate a commit that adds a pile of unmarked tests without
+  re-running the suite.  When the ceiling is hit legitimately (cheap
+  tests), raise it here *in the same commit* that adds them — the point
+  is that growth is a decision, not an accident.
+
+Exit 0 within budget; 1 over budget (or unparseable log); 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: Wall budget for a finished tier-1 run (seconds) — under the driver's
+#: 870 s timeout with margin for runner variance.
+DEFAULT_BUDGET_S = 800.0
+
+#: Tier-1 test-count ceiling for --collect mode.  ~430 tests ran in
+#: ~640 s at PR 10 on a 2-cpu runner (~1.5 s/test amortized); 520 keeps
+#: headroom while catching a silent 20%+ jump.
+DEFAULT_MAX_TESTS = 520
+
+#: Pytest summary trailer: "== 398 passed, 27 deselected in 612.34s =="
+#: (also plain "in 612.34s (0:10:12)" forms).
+_TRAILER = re.compile(r"\bin\s+(\d+(?:\.\d+)?)s\b")
+_COUNTS = re.compile(r"(\d+)\s+(passed|failed|errors?|skipped)")
+
+
+def check_log(path: Path, budget_s: float) -> int:
+    try:
+        text = path.read_text(encoding="utf-8", errors="replace")
+    except OSError as exc:
+        print(f"tier1-budget: cannot read {path}: {exc}", file=sys.stderr)
+        return 1
+    wall = None
+    counts: dict[str, int] = {}
+    for line in text.splitlines():
+        m = _TRAILER.search(line)
+        if m and _COUNTS.search(line):
+            wall = float(m.group(1))
+            counts = {k: int(n) for n, k in _COUNTS.findall(line)}
+    if wall is None:
+        print(
+            f"tier1-budget: no pytest summary trailer in {path} "
+            "(run interrupted or not a pytest log?)",
+            file=sys.stderr,
+        )
+        return 1
+    verdict = "within" if wall <= budget_s else "OVER"
+    print(
+        f"tier1 wall {wall:.1f}s — {verdict} budget {budget_s:.0f}s "
+        f"({', '.join(f'{v} {k}' for k, v in counts.items()) or 'no counts'})"
+    )
+    if wall > budget_s:
+        print(
+            "tier1-budget: the 'not slow' suite is over budget — move "
+            "heavy tests behind the slow marker (PR 9 precedent) before "
+            "the driver's 870s timeout starts killing runs",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def check_collect(max_tests: int) -> int:
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "tests/", "-q",
+            "--collect-only", "-m", "not slow",
+            "--continue-on-collection-errors",
+            "-p", "no:cacheprovider",
+        ],
+        cwd=REPO, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=600,
+    )
+    m = re.search(
+        r"(\d+)(?:/\d+)? tests? (?:collected|selected)",
+        proc.stdout + proc.stderr,
+    )
+    if m is None:
+        # "N deselected, M selected" / "N tests collected" variants.
+        m = re.search(r"(\d+) selected", proc.stdout + proc.stderr)
+    if m is None:
+        print(
+            "tier1-budget: could not parse collected-test count from "
+            "pytest --collect-only output",
+            file=sys.stderr,
+        )
+        print(proc.stdout[-2000:], file=sys.stderr)
+        return 1
+    n = int(m.group(1))
+    verdict = "within" if n <= max_tests else "OVER"
+    print(f"tier1 collects {n} tests — {verdict} ceiling {max_tests}")
+    if n > max_tests:
+        print(
+            "tier1-budget: tier-1 test count jumped past the ceiling — "
+            "either mark the new heavy tests slow, or raise "
+            "DEFAULT_MAX_TESTS in this tool in the same commit (growth "
+            "should be a decision, not an accident)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument(
+        "log", nargs="?", default=None,
+        help="pytest log of a finished tier-1 run (e.g. /tmp/_t1.log)",
+    )
+    parser.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S,
+                        help="wall budget in seconds for log mode")
+    parser.add_argument("--collect", action="store_true",
+                        help="count tier-1 tests via pytest --collect-only "
+                        "instead of parsing a log")
+    parser.add_argument("--max-tests", type=int, default=DEFAULT_MAX_TESTS,
+                        help="test-count ceiling for --collect mode")
+    args = parser.parse_args(argv)
+    if args.collect:
+        return check_collect(args.max_tests)
+    if not args.log:
+        parser.print_usage(sys.stderr)
+        print(
+            "tier1-budget: give a pytest log path, or --collect",
+            file=sys.stderr,
+        )
+        return 2
+    return check_log(Path(args.log), args.budget)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
