@@ -250,6 +250,10 @@ class SlotInfo:
     max_new_tokens: int  # effective: clamped to the context window
     stop_id: int | None
     generated: int = 0  # includes the prefill-sampled first token
+    #: The serving request (= fleet trace id) occupying this slot, so a
+    #: /statusz slot table answers "whose request is pinning slot 3" and a
+    #: cross-replica trace can name the slot a hop landed on.
+    request_id: str | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -379,6 +383,7 @@ class SlotPoolEngine:
                     "bucket": info.bucket,
                     "generated": info.generated,
                     "max_new_tokens": info.max_new_tokens,
+                    "request_id": info.request_id,
                 }
             )
         return states
@@ -406,12 +411,14 @@ class SlotPoolEngine:
         top_p: float | None = None,
         seed: int = 0,
         stop_id: int | None = None,
+        request_id: str | None = None,
     ) -> TickEvent:
         """Prefill a free slot with ``prompt_ids`` and sample the first
         token.  Returns the admission :class:`TickEvent` (slot, first token,
         and a finish reason when one token already completes the request).
         Raises ``RuntimeError`` when no slot is free and ``ValueError`` for
-        prompts the context window cannot serve."""
+        prompts the context window cannot serve.  ``request_id`` is carried
+        as slot metadata only (the /statusz slot table + fleet tracing)."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         plen = prompt.shape[0]
         ctx = self.config.context_length
@@ -454,6 +461,7 @@ class SlotPoolEngine:
             max_new_tokens=min(max_new_tokens, ctx - plen),
             stop_id=stop_id,
             generated=1,
+            request_id=request_id,
         )
         self._slots[slot] = info
         self._active[slot] = True

@@ -153,6 +153,10 @@ class PagedSlotInfo:
     shared_len: int  # tokens reused from the prefix cache (block-aligned)
     next_pos: int  # prefill cursor: first position not yet computed
     generated: int = 0
+    #: The serving request (= fleet trace id) occupying this slot — slot
+    #: metadata for /statusz and cross-replica tracing, like the dense
+    #: engine's SlotInfo.request_id.
+    request_id: str | None = None
 
 
 class PagedEngine:
@@ -400,6 +404,7 @@ class PagedEngine:
                     "blocks": len(info.block_ids),
                     "shared_prefix_tokens": info.shared_len,
                     "prefill_pos": info.next_pos,
+                    "request_id": info.request_id,
                 }
             )
         return states
@@ -542,6 +547,7 @@ class PagedEngine:
         top_p: float | None = None,
         seed: int = 0,
         stop_id: int | None = None,
+        request_id: str | None = None,
     ) -> int:
         """Reserve a slot + its worst-case block chain (prefix-cache blocks
         reused by reference) and queue the prompt for chunked prefill.
@@ -596,6 +602,7 @@ class PagedEngine:
             block_ids=block_ids,
             shared_len=shared_len,
             next_pos=shared_len,
+            request_id=request_id,
         )
         self._slots[slot] = info
         self._prefilling.append(slot)
@@ -665,6 +672,7 @@ class PagedEngine:
         top_p: float | None = None,
         seed: int = 0,
         stop_id: int | None = None,
+        request_id: str | None = None,
     ) -> TickEvent:
         """Dense-engine-compatible admission: begin + run every prefill
         chunk back to back (no decode interleaving).  The serving worker
@@ -678,6 +686,7 @@ class PagedEngine:
             top_p=top_p,
             seed=seed,
             stop_id=stop_id,
+            request_id=request_id,
         )
         while True:
             event = self.prefill_step(slot)

@@ -102,9 +102,15 @@ class ServingMetrics:
         self.requests_submitted = 0
         self.requests_rejected = 0
         self.finished: dict[str, int] = {r: 0 for r in FINISH_REASONS}
+        #: Marginal phase histograms plus two REQUEST-level ones the fleet
+        #: SLO layer (telemetry/slo.py) counts good events from: ``ttfb``
+        #: (queue wait + prefill — time to the first token) and ``total``
+        #: (the whole request).  Request-level latencies live ONLY here,
+        #: never as spans: the report's per-request assembly sums a
+        #: request's phase spans, and a total span would double-count.
         self.phases: dict[str, LatencyHistogram] = {
             phase: LatencyHistogram()
-            for phase in ("queue_wait", "prefill", "decode")
+            for phase in ("queue_wait", "prefill", "decode", "ttfb", "total")
         }
         #: Per-prefill-bucket work accounting: bucket length ->
         #: [requests, prompt tokens, seconds, compiles] — the /metrics
@@ -314,7 +320,9 @@ def render_prometheus(
         samples.append(("count", {"phase": phase}, count))
     lines.append(
         f"# HELP {prefix}_request_phase_seconds "
-        "Per-request phase latency (queue_wait | prefill | decode)."
+        "Per-request phase latency (queue_wait | prefill | decode | "
+        "ttfb | total; ttfb/total are request-level: wait+prefill and "
+        "the whole request — the fleet SLO layer's good-event evidence)."
     )
     lines.append(f"# TYPE {prefix}_request_phase_seconds histogram")
     for suffix, labels, value in samples:
@@ -372,6 +380,10 @@ def render_prometheus(
         emit("engine_compiled_programs", "gauge",
              "XLA programs compiled by this engine (bounded: buckets + 1).",
              [({}, engine_stats.get("compiled_programs"))])
+        emit("alerts_firing", "gauge",
+             "Serving anomaly-watchdog rules currently firing "
+             "(telemetry/alerts.py; details in /statusz 'alerts').",
+             [({}, engine_stats.get("alerts_firing"))])
         # Quantized-decode + tick-roofline gauges (ISSUE 11): resident
         # weight bytes (labeled by storage width), the per-tick weight
         # sweep int8 halves, and the analytic tick roofline's headline

@@ -30,7 +30,20 @@ operational surface — no new protocol:
 * ``GET /statusz`` (the fleet table: per-replica health + routing
   counters) and ``GET /metrics`` (Prometheus: routed/retried/failed
   counters per replica, per-replica health gauges) make the router
-  itself monitorable by the same tools (`bpe-tpu monitor --url`).
+  itself monitorable by the same tools (`bpe-tpu monitor --url`);
+* **distributed request tracing** (ISSUE 12): every request gets a
+  ``trace_id`` — an inbound ``X-Request-Id`` header honored, one minted
+  otherwise — forwarded to the replica (whose serve layer adopts it as
+  the ``request_id`` on its spans and slot state) and echoed back on
+  EVERY response, 503/504 failures included.  With ``--metrics-jsonl``
+  the router narrates its side of each request into its own telemetry
+  stream: a ``router/pick`` span (replica selection), one ``router/hop``
+  span per ATTEMPTED replica (connect time, time-to-first-byte, outcome
+  — a failover request shows every hop it burned), and a
+  ``router/request`` envelope span, all tagged ``request_id=trace_id``
+  and stamped with absolute ``time_unix`` so
+  ``telemetry.trace.request_timeline`` can stitch the router stream and
+  the replica streams into one end-to-end timeline.
 
 Deliberately stdlib-only and importable without jax — it runs on a
 front-end box with no accelerator runtime, like ``bpe-tpu monitor``.
@@ -43,6 +56,7 @@ import json
 import threading
 import time
 import urllib.request
+import uuid
 import zlib
 from urllib.parse import urlsplit
 
@@ -123,6 +137,7 @@ class Router:
         request_timeout_s: float = 600.0,
         connect_timeout_s: float = 5.0,
         clock=time.monotonic,
+        telemetry=None,
     ):
         if not replica_urls:
             raise ValueError("router needs at least one replica URL")
@@ -144,14 +159,44 @@ class Router:
         self.requests_routed = 0
         self.requests_retried = 0
         self.requests_failed = 0
+        #: 4xx pass-throughs: the CALLER's error, served correctly by the
+        #: fleet — counted separately so client mistakes never burn the
+        #: availability SLO's error budget (requests_failed stays what its
+        #: help text says: requests no replica could serve).
+        self.requests_client_errors = 0
         #: Session-affinity accounting: requests that carried a session
         #: key, and how many were SERVED by their sticky replica (a miss
         #: means the sticky home was draining/dead and the weighted
         #: fallback answered — its prefix blocks start cold there).
         self.session_requests = 0
         self.affinity_hits = 0
+        #: Optional Telemetry: the router's OWN trace stream — pick/hop/
+        #: request spans per proxied request (`bpe-tpu route
+        #: --metrics-jsonl`).  Emission is direct (no nesting stack):
+        #: handler threads interleave, like serving/server._span.
+        self._telemetry = telemetry
         self._thread: threading.Thread | None = None
         self._running = False
+
+    def _span(self, name: str, dur: float, trace_id: str, **attrs) -> None:
+        """Emit one router-phase span tagged with the request's trace id.
+        Spans carry absolute ``time_unix`` start stamps so cross-stream
+        assembly (router + replica JSONLs) can order hops on one axis."""
+        if self._telemetry is None:
+            return
+        dur = max(float(dur), 0.0)
+        self._telemetry.emit(
+            {
+                "kind": "span",
+                "name": name,
+                "path": f"router/{name}",
+                "t": round(max(self._telemetry.now() - dur, 0.0), 6),
+                "dur_s": round(dur, 6),
+                "request_id": trace_id,
+                "time_unix": round(time.time() - dur, 6),
+                **{k: v for k, v in attrs.items() if v is not None},
+            }
+        )
 
     @staticmethod
     def _canonical(url: str) -> str:
@@ -277,52 +322,90 @@ class Router:
         digest = zlib.crc32(str(session).encode("utf-8"))
         return self.replicas[digest % len(self.replicas)]
 
-    def _post_generate(self, replica: ReplicaState, body: bytes):
+    def _post_generate(
+        self, replica: ReplicaState, body: bytes, trace_id: str | None = None
+    ):
         """POST /generate with a short CONNECT timeout and the full
-        request timeout only on the response.  Returns ``(phase, value)``:
-        ``("response", (status, payload))`` on an HTTP answer,
-        ``("connect", exc)`` when the replica was unreachable (safe to
-        fail over), ``("slow", exc)`` when an ESTABLISHED request timed
-        out (the generation is still running — replaying would duplicate
-        it), ``("read", exc)`` when the connection died mid-request
-        (replica killed — replay is safe, the work died with it)."""
+        request timeout only on the response.  Returns ``(phase, value,
+        timing)`` — ``phase``/``value`` as before: ``("response",
+        (status, payload))`` on an HTTP answer, ``("connect", exc)`` when
+        the replica was unreachable (safe to fail over), ``("slow",
+        exc)`` when an ESTABLISHED request timed out (the generation is
+        still running — replaying would duplicate it), ``("read", exc)``
+        when the connection died mid-request (replica killed — replay is
+        safe, the work died with it).  ``timing`` carries ``connect_s``
+        and ``ttfb_s`` (send -> response headers; for this blocking
+        endpoint the first byte arrives when the replica finishes, so hop
+        ttfb ~= the replica's whole request) for the hop span.  The
+        trace id is forwarded as ``X-Request-Id`` so the replica adopts
+        it."""
         parts = urlsplit(replica.url)
+        timing: dict = {"connect_s": None, "ttfb_s": None}
         conn = http.client.HTTPConnection(
             parts.hostname, parts.port, timeout=self.connect_timeout_s
         )
         try:
+            t0 = self._clock()
             try:
                 conn.connect()
             except OSError as exc:
-                return "connect", exc
+                return "connect", exc, timing
+            timing["connect_s"] = round(self._clock() - t0, 6)
             conn.sock.settimeout(self.request_timeout_s)
+            headers = {"Content-Type": "application/json"}
+            if trace_id is not None:
+                headers["X-Request-Id"] = trace_id
             try:
+                t_send = self._clock()
                 conn.request(
-                    "POST", "/generate", body=body,
-                    headers={"Content-Type": "application/json"},
+                    "POST", "/generate", body=body, headers=headers,
                 )
                 resp = conn.getresponse()
+                timing["ttfb_s"] = round(self._clock() - t_send, 6)
                 data = resp.read()
             except TimeoutError as exc:  # socket.timeout on the read side
-                return "slow", exc
+                return "slow", exc, timing
             except (OSError, http.client.HTTPException) as exc:
-                return "read", exc
+                return "read", exc, timing
             try:
                 payload = json.loads(data)
                 if not isinstance(payload, dict):
                     raise ValueError
             except ValueError:
                 payload = {"error": data.decode("utf-8", "replace")[:200]}
-            return "response", (resp.status, payload)
+            return "response", (resp.status, payload), timing
         finally:
             conn.close()
 
-    def handle_generate(self, body: bytes) -> tuple[int, dict]:
+    def handle_generate(
+        self, body: bytes, trace_id: str | None = None
+    ) -> tuple[int, dict]:
         """Proxy one generate request with failover: try replicas in
         weight order (the request's sticky session replica first, when it
         has one and it is available); connection failures, mid-request
         deaths, and 503s (draining replica, full queue) re-queue the
-        request on the next-best replica."""
+        request on the next-best replica.
+
+        ``trace_id`` is the request's fleet-wide identity (an inbound
+        ``X-Request-Id``; minted here when absent): forwarded to every
+        attempted replica, stamped on the router's own spans, and
+        guaranteed present in the returned payload's ``request_id`` so
+        even an all-replicas-down 503 is traceable."""
+        if trace_id is None:
+            trace_id = uuid.uuid4().hex
+        t_request = self._clock()
+        route: dict = {"hops": 0, "replica": None}
+        code, payload = self._route_generate(body, trace_id, route)
+        payload.setdefault("request_id", trace_id)
+        self._span(
+            "request", self._clock() - t_request, trace_id,
+            status=code, hops=route["hops"], replica=route["replica"],
+        )
+        return code, payload
+
+    def _route_generate(
+        self, body: bytes, trace_id: str, route: dict
+    ) -> tuple[int, dict]:
         session = None
         # The router treats the body as opaque bytes; only a request that
         # can actually carry a session key pays the JSON parse (long
@@ -340,7 +423,12 @@ class Router:
         if session is not None:
             with self._lock:
                 self.session_requests += 1
+        t_pick = self._clock()
         order = self.pick_order(session, sticky=sticky)
+        self._span(
+            "pick", self._clock() - t_pick, trace_id,
+            n_available=len(order), sticky=bool(sticky is not None),
+        )
         if not order:
             with self._lock:
                 self.requests_failed += 1
@@ -351,10 +439,27 @@ class Router:
                 with self._lock:
                     self.requests_retried += 1
                     order[i - 1].retried_away += 1
-            phase, value = self._post_generate(replica, body)
+            route["hops"] = i + 1
+            t_hop = self._clock()
+            phase, value, timing = self._post_generate(
+                replica, body, trace_id
+            )
+            hop_dur = self._clock() - t_hop
+
+            def hop_span(outcome, status=None):
+                # One span per ATTEMPTED replica — a failover request's
+                # trace shows every hop it burned, not just the winner.
+                self._span(
+                    "hop", hop_dur, trace_id, replica=replica.url,
+                    hop=i, outcome=outcome, status=status,
+                    connect_s=timing["connect_s"], ttfb_s=timing["ttfb_s"],
+                )
+
             if phase == "response":
                 status, payload = value
                 if status == 200:
+                    hop_span("ok", status=200)
+                    route["replica"] = replica.url
                     with self._lock:
                         replica.routed += 1
                         self.requests_routed += 1
@@ -363,6 +468,8 @@ class Router:
                     payload["replica"] = replica.url
                     return 200, payload
                 detail = str(payload.get("error", ""))
+                hop_span("backpressure" if status == 503 else "client_error",
+                         status=status)
                 if status == 503:
                     # Draining or backpressured: route around it.  A
                     # drain 503 means the replica is going away — flag it
@@ -373,15 +480,19 @@ class Router:
                     last_error = f"{replica.url}: 503 {detail}"
                     continue
                 # 4xx is the CALLER's error: no other replica will judge
-                # it differently, so fail it through without retrying.
+                # it differently, so fail it through without retrying —
+                # and without charging the fleet's failure counter (a
+                # malformed-request storm must not page an availability
+                # SLO the fleet is actually meeting).
                 with self._lock:
-                    self.requests_failed += 1
+                    self.requests_client_errors += 1
                 return status, {"error": detail or f"HTTP {status}"}
             if phase == "slow":
                 # The replica ACCEPTED the request and is still working:
                 # it is not dead, and replaying elsewhere would run the
                 # same generation twice fleet-wide.  Fail THIS request
                 # through as a gateway timeout; routing state untouched.
+                hop_span("slow")
                 with self._lock:
                     self.requests_failed += 1
                 return 504, {
@@ -392,6 +503,7 @@ class Router:
             # "connect" (unreachable) or "read" (died mid-request): the
             # replica is gone and so is any in-flight work — mark it down
             # and replay the request elsewhere.
+            hop_span(f"{phase}_failed")
             self._mark_down(replica, f"{phase} failed: {value}")
             last_error = f"{replica.url}: {value}"
         with self._lock:
@@ -408,6 +520,7 @@ class Router:
                 self.requests_retried,
                 self.requests_failed,
             )
+            client_errors = self.requests_client_errors
             sessions, hits = self.session_requests, self.affinity_hits
         return {
             "uptime_s": round(self._clock() - self._t0, 3),
@@ -416,6 +529,7 @@ class Router:
             "requests_routed": routed,
             "requests_retried": retried,
             "requests_failed": failed,
+            "requests_client_errors": client_errors,
             # Session affinity (sticky routing): how much multi-turn
             # traffic actually landed on its prefix-block home.
             "session_requests": sessions,
@@ -433,6 +547,7 @@ class Router:
                 self.requests_retried,
                 self.requests_failed,
             )
+            client_errors = self.requests_client_errors
             sessions, hits = self.session_requests, self.affinity_hits
         # serving/metrics.py is jax-free at import: the router can share
         # the exposition formatter without touching an accelerator runtime.
@@ -449,7 +564,13 @@ class Router:
              "Requests replayed on another replica after a failure/503.",
              [({}, retried)])
         emit("requests_failed_total", "counter",
-             "Requests no replica could serve.", [({}, failed)])
+             "Requests no replica could serve (4xx pass-throughs "
+             "excluded — see requests_client_errors_total).",
+             [({}, failed)])
+        emit("requests_client_errors_total", "counter",
+             "4xx responses passed through (caller's error; not an "
+             "availability failure).",
+             [({}, client_errors)])
         emit("session_requests_total", "counter",
              "Requests that carried a session key (sticky routing).",
              [({}, sessions)])
@@ -480,10 +601,18 @@ def make_router_http_server(
         def log_message(self, *args):  # noqa: D102
             pass
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(
+            self, code: int, payload: dict, request_id: str | None = None
+        ) -> None:
             body = json.dumps(payload).encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            if request_id is not None:
+                # Echoed on EVERY proxied response — the all-replicas-down
+                # 503 and the not-replayed 504 read-timeout included — so
+                # a client-side failure report carries the id that finds
+                # the request in the router/replica trace streams.
+                self.send_header("X-Request-Id", request_id)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -512,10 +641,12 @@ def make_router_http_server(
         def do_POST(self):  # noqa: N802 (stdlib API)
             if self.path != "/generate":
                 return self._reply(404, {"error": "unknown path"})
+            trace_id = (self.headers.get("X-Request-Id") or "").strip()
+            trace_id = trace_id[:128] or uuid.uuid4().hex
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length) or b"{}"
-            code, payload = router.handle_generate(body)
-            return self._reply(code, payload)
+            code, payload = router.handle_generate(body, trace_id=trace_id)
+            return self._reply(code, payload, request_id=trace_id)
 
     return ThreadingHTTPServer((host, port), Handler)
 
@@ -545,31 +676,56 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--connect-timeout", type=float, default=5.0,
                         help="seconds to wait for a replica's TCP connect "
                         "(failover to the next replica after)")
+    parser.add_argument("--metrics-jsonl", default=None,
+                        help="write the router's trace stream (pick/hop/"
+                        "request spans per proxied request, manifest + "
+                        "footer) to this JSONL; one trace_id joins it to "
+                        "the replicas' streams")
     args = parser.parse_args(argv if argv is not None else sys.argv[1:])
+
+    from bpe_transformer_tpu.telemetry.manifest import host_manifest
+    from bpe_transformer_tpu.telemetry.sinks import MetricsLogger
+    from bpe_transformer_tpu.telemetry.spans import Telemetry
+
+    logger = MetricsLogger(jsonl_path=args.metrics_jsonl)
+    telemetry = Telemetry(sink=logger.log) if args.metrics_jsonl else None
+    if telemetry is not None:
+        # host_manifest, not run_manifest: the router must never touch a
+        # jax backend as a side effect of writing its stream header.
+        telemetry.emit(host_manifest("route"))
 
     router = Router(
         args.replica,
         poll_interval_s=args.poll_interval,
         request_timeout_s=args.request_timeout,
         connect_timeout_s=args.connect_timeout,
+        telemetry=telemetry,
     )
     server = make_router_http_server(router, host=args.host, port=args.port)
     host, port = server.server_address[:2]
-    with router:
-        available = sum(1 for r in router.replicas if r.available)
-        print(
-            f"routing on http://{host}:{port} over {len(router.replicas)} "
-            f"replicas ({available} available; POST /generate, GET /healthz "
-            "/metrics /statusz; Ctrl-C stops)",
-            flush=True,
-        )
-        try:
-            server.serve_forever()
-        except KeyboardInterrupt:
-            pass
-        finally:
-            server.shutdown()
-            server.server_close()
+    try:
+        with router:
+            available = sum(1 for r in router.replicas if r.available)
+            print(
+                f"routing on http://{host}:{port} over {len(router.replicas)} "
+                f"replicas ({available} available; POST /generate, GET /healthz "
+                "/metrics /statusz; Ctrl-C stops)",
+                flush=True,
+            )
+            try:
+                server.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                server.shutdown()
+                server.server_close()
+    finally:
+        if telemetry is not None:
+            telemetry.footer(
+                clean=True, requests=router.requests_routed,
+                failed=router.requests_failed,
+            )
+        logger.close()
     return 0
 
 

@@ -42,6 +42,10 @@ from bpe_transformer_tpu.serving.scheduler import (
     PrefillBudget,
     QueueFullError,
 )
+from bpe_transformer_tpu.telemetry.alerts import (
+    AlertEngine,
+    default_serving_rules,
+)
 from bpe_transformer_tpu.telemetry.resources import (
     install_compile_counter,
     sample_resources,
@@ -53,10 +57,21 @@ __all__ = [
     "RequestHandle",
     "ServingEngine",
     "QueueFullError",
+    "DuplicateRequestError",
     "make_http_server",
 ]
 
 _STREAM_END = object()
+
+
+class DuplicateRequestError(ValueError):
+    """A request id already in flight on this replica.  Subclasses
+    ValueError for direct ``submit()`` callers, but the HTTP layer maps
+    it to a retryable 503, NOT a 400: the canonical producer is a client
+    retrying a router 504 with the same echoed X-Request-Id (the id it
+    was told to keep for correlation) — that retry must fail over to a
+    replica that ISN'T still running the original generation, not be
+    judged a client error fleet-wide."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -199,6 +214,7 @@ class ServingEngine:
         fused_sampling: bool = False,
         speculate_k: int = 0,
         draft_spec=None,
+        alert_rules=None,
     ):
         # Count XLA compiles (the engine's bucketed prefills included) into
         # the process-wide telemetry.resources counter before the first
@@ -288,6 +304,16 @@ class ServingEngine:
         #: numbers the serve/* spans carry, queryable from a live server
         #: without tailing the JSONL.
         self._recent: collections.deque = collections.deque(maxlen=32)
+        #: Serving anomaly watchdog (telemetry/alerts.py): fed a gauge
+        #: sample on the engine-record cadence INDEPENDENT of whether a
+        #: telemetry sink exists — /statusz must show active alerts on a
+        #: server run without --metrics-jsonl.  Transitions (fire/clear)
+        #: are emitted as kind="alert" records when a sink is attached.
+        self._alerts = AlertEngine(
+            alert_rules
+            if alert_rules is not None
+            else default_serving_rules()
+        )
         self._requests_finished = 0
         self._thread: threading.Thread | None = None
         self._running = False
@@ -421,6 +447,14 @@ class ServingEngine:
                 )
         entry = _Entry(request, self._clock())
         with self._entries_lock:
+            if request.request_id in self._entries:
+                # Client-supplied ids (X-Request-Id) key the entries
+                # registry and the trace streams: a duplicate in flight
+                # would orphan the first caller's completion event.
+                raise DuplicateRequestError(
+                    f"request id {request.request_id!r} is already in "
+                    "flight on this replica"
+                )
             self._entries[request.request_id] = entry
         try:
             self.scheduler.submit(
@@ -452,9 +486,13 @@ class ServingEngine:
         stop_id: int | None = None,
         deadline_s: float | None = None,
         session: str | None = None,
+        request_id: str | None = None,
         timeout: float | None = None,
     ) -> Result:
-        """Blocking one-call generation."""
+        """Blocking one-call generation.  ``request_id`` adopts a
+        caller-supplied trace id (the router's ``X-Request-Id``) so one id
+        stitches router hops, serve spans, and engine slot state."""
+        kwargs = {} if request_id is None else {"request_id": request_id}
         handle = self.submit(
             Request(
                 prompt_ids=tuple(int(t) for t in prompt_ids),
@@ -470,6 +508,7 @@ class ServingEngine:
                 stop_id=self.default_stop_id if stop_id is None else stop_id,
                 deadline_s=deadline_s,
                 session=session,
+                **kwargs,
             )
         )
         return handle.result(timeout)
@@ -567,6 +606,7 @@ class ServingEngine:
             "tick_weight_bytes": self.engine.tick_weight_bytes,
             "fused_sampling": self.engine.fused_sampling,
             "decode_roofline": self.decode_roofline(),
+            "alerts_firing": len(self._alerts.active()),
             **self.metrics.snapshot(),
         }
         if self.paged:
@@ -614,6 +654,10 @@ class ServingEngine:
             # queue_wait/prefill/decode + bucket): the per-request trace
             # view, live, without tailing the telemetry JSONL.
             "recent_requests": list(self._recent),
+            # Anomaly-watchdog verdicts (telemetry/alerts.py): the
+            # currently-firing rules with their evidence — what the fleet
+            # aggregator folds and an operator's first question answered.
+            "alerts": self._alerts.active(),
             "resources": resources,
             "last_errors": self.metrics.last_errors(),
         }
@@ -833,6 +877,7 @@ class ServingEngine:
                     top_p=request.top_p,
                     seed=request.seed,
                     stop_id=request.stop_id,
+                    request_id=request.request_id,
                 )
             except NoFreeBlocksError:
                 return False
@@ -859,6 +904,7 @@ class ServingEngine:
             top_p=request.top_p,
             seed=request.seed,
             stop_id=request.stop_id,
+            request_id=request.request_id,
         )
         now = self._clock()
         entry.prefill_s = now - t0
@@ -874,6 +920,11 @@ class ServingEngine:
         )
         self._span("queue_wait", entry.t_submit, entry.queue_wait_s, request)
         self._span("prefill", t0, entry.prefill_s, request)
+        # Time to first token: wait + prefill, observed request-level for
+        # the ttfb SLO histogram (never as a span — see metrics.phases).
+        self.metrics.observe_phase(
+            "ttfb", entry.queue_wait_s + entry.prefill_s
+        )
         entry.tokens.append(event.token)
         entry.stream.put(event.token)
         if event.finished:
@@ -924,6 +975,9 @@ class ServingEngine:
         self._span(
             "prefill", entry.t_prefill_start, entry.prefill_s, request
         )
+        self.metrics.observe_phase(
+            "ttfb", entry.queue_wait_s + entry.prefill_s
+        )
         entry.t_decode_start = self._clock()
         entry.tokens.append(event.token)
         entry.stream.put(event.token)
@@ -968,6 +1022,12 @@ class ServingEngine:
         )
         self._requests_finished += 1
         self.metrics.on_finish(reason)
+        # Whole-request latency for the total SLO histogram (request-level
+        # only — a total SPAN would double-count in the report's
+        # per-request phase assembly).
+        self.metrics.observe_phase(
+            "total", entry.queue_wait_s + entry.prefill_s + decode_s
+        )
         # Per-request trace: the finished timeline joins the /statusz ring.
         # Same numbers as the serve/* spans and Result.timings() — one
         # measurement, three surfaces.
@@ -1008,15 +1068,50 @@ class ServingEngine:
                 "t": round(start - self._t0, 6),
                 "dur_s": round(dur, 6),
                 "request_id": request.request_id,
+                # Absolute span START time: every stream has its own t
+                # epoch, so cross-stream request assembly (router lanes
+                # joining these lanes in telemetry/trace.request_timeline)
+                # orders hops by wall clock.  Spans are emitted at phase
+                # end, so start = now - dur.
+                "time_unix": round(time.time() - dur, 6),
             }
         )
 
+    def _feed_alerts(self, t: float, resources: dict | None) -> None:
+        """One watchdog sample on the engine-record cadence; transitions
+        go to the telemetry stream when one is attached (the active set
+        is always queryable via /statusz regardless)."""
+        sample: dict = {
+            "queue_depth": self.scheduler.depth + len(self._admit_backlog),
+            "active_slots": self.engine.active_count,
+        }
+        if resources is not None:
+            sample["compile_events"] = resources.get("compile_events")
+        if self.paged:
+            gauges = self.engine.gauges()
+            sample["kv_blocks_free"] = gauges.get("kv_blocks_free")
+            sample["kv_blocks_total"] = gauges.get("kv_blocks_total")
+            if self.spec:
+                sample["spec_accept_rate"] = gauges.get("spec_accept_rate")
+                sample["spec_proposed"] = gauges.get("spec_proposed_tokens")
+        for transition in self._alerts.feed(sample, round(t, 6)):
+            if self._telemetry is not None:
+                self._telemetry.emit(transition)
+
     def _maybe_emit_engine_record(self) -> None:
-        if self._telemetry is None:
-            return
         now = self._clock()
         elapsed = now - self._last_record_t
         if elapsed < self._record_every_s:
+            return
+        # Sampled UNCONDITIONALLY (sync-free, jax-optional — see
+        # telemetry/resources.py): the compile-storm rule must see the
+        # compile counter even on a server run without --metrics-jsonl.
+        resources = sample_resources(t=round(now - self._t0, 6))
+        # The watchdog samples BEFORE the idle short-circuit: an idle
+        # engine is exactly when a queue-growth alert must clear.
+        self._feed_alerts(now - self._t0, resources)
+        if self._telemetry is None:
+            self._last_record_t = now
             return
         tokens = self.engine.tokens_emitted
         # A fully idle engine stays silent (no tokens since the last record
@@ -1045,9 +1140,9 @@ class ServingEngine:
         )
         # Resource accounting rides the same cadence: HBM/RSS/compile
         # trends of a serving process are as load-bearing as tokens/sec.
-        self._telemetry.emit(
-            sample_resources(t=round(now - self._t0, 6))
-        )
+        # (The sample was taken once above — the watchdog's compile-storm
+        # rule and this record must read the same numbers.)
+        self._telemetry.emit(resources)
         # Decode-tick roofline on the same cadence (every engine kind):
         # the weight/KV/activation byte split of one tick at current
         # occupancy vs the chip ridge point — the record the report's
@@ -1136,7 +1231,10 @@ def make_http_server(
       "max_new_tokens"?, "temperature"?, "top_k"?, "top_p"?, "seed"?,
       "stop_id"?, "deadline_s"?}`` -> ``{"completion"?, "token_ids",
       "finish_reason", "timings", "request_id"}``; 400 on bad input, 503
-      when the admission queue is full (backpressure).
+      when the admission queue is full (backpressure).  An inbound
+      ``X-Request-Id`` header is adopted as the request's trace id and
+      echoed back on EVERY response (errors included) — the fleet
+      tracing contract (ISSUE 12).
     * ``GET /healthz`` — engine/queue stats (JSON).
     * ``GET /metrics`` — Prometheus text exposition: request/token
       counters, queue depth, slot occupancy, per-phase latency
@@ -1156,13 +1254,30 @@ def make_http_server(
         def log_message(self, *args):  # noqa: D102
             pass
 
-        def _reply(self, code: int, payload: dict) -> None:
-            self._reply_text(code, json.dumps(payload), "application/json")
+        def _reply(
+            self, code: int, payload: dict, request_id: str | None = None
+        ) -> None:
+            self._reply_text(
+                code, json.dumps(payload), "application/json",
+                request_id=request_id,
+            )
 
-        def _reply_text(self, code: int, text: str, content_type: str) -> None:
+        def _reply_text(
+            self,
+            code: int,
+            text: str,
+            content_type: str,
+            request_id: str | None = None,
+        ) -> None:
             body = text.encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", content_type)
+            if request_id is not None:
+                # Echoed on EVERY /generate response — 503 backpressure
+                # and 400s included — so a client can hand the id to an
+                # operator and the operator can find the request in the
+                # trace streams (or prove it never reached the engine).
+                self.send_header("X-Request-Id", request_id)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -1184,6 +1299,13 @@ def make_http_server(
         def do_POST(self):  # noqa: N802 (stdlib API)
             if self.path != "/generate":
                 return self._reply(404, {"error": "unknown path"})
+            # Trace-id adoption: an inbound X-Request-Id (minted by the
+            # fleet router, or sent by a client directly) becomes THE
+            # request_id tagging this request's serve/* spans and engine
+            # slot state — one id stitches router -> replica -> engine.
+            # Absent, one is minted here so the echo below always holds.
+            trace_id = (self.headers.get("X-Request-Id") or "").strip()
+            trace_id = trace_id[:128] or uuid.uuid4().hex
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
@@ -1209,15 +1331,29 @@ def make_http_server(
                     stop_id=body.get("stop_id"),
                     deadline_s=body.get("deadline_s"),
                     session=body.get("session"),
+                    request_id=trace_id,
                 )
-            except QueueFullError as exc:
-                return self._reply(503, {"error": str(exc)})
+            except (QueueFullError, DuplicateRequestError) as exc:
+                # Both are "this replica can't take THIS request right
+                # now": 503 so the router fails over instead of judging
+                # the caller (a duplicate id means OUR copy is still
+                # running — a peer can serve the retry).
+                return self._reply(
+                    503, {"error": str(exc), "request_id": trace_id},
+                    request_id=trace_id,
+                )
             except (ValueError, TypeError, json.JSONDecodeError) as exc:
-                return self._reply(400, {"error": str(exc)})
+                return self._reply(
+                    400, {"error": str(exc), "request_id": trace_id},
+                    request_id=trace_id,
+                )
             except RuntimeError as exc:
-                # Engine not running / worker died: a JSON 503 beats the
-                # stdlib handler's closed socket.
-                return self._reply(503, {"error": str(exc)})
+                # Engine not running / worker died / draining: a JSON 503
+                # beats the stdlib handler's closed socket.
+                return self._reply(
+                    503, {"error": str(exc), "request_id": trace_id},
+                    request_id=trace_id,
+                )
             payload = {
                 "request_id": result.request_id,
                 "token_ids": list(result.token_ids),
@@ -1229,6 +1365,6 @@ def make_http_server(
                 if result.finish_reason == "stop":
                     ids = ids[:-1]  # the stop token itself isn't prose
                 payload["completion"] = serving.tokenizer.decode(ids)
-            self._reply(200, payload)
+            self._reply(200, payload, request_id=result.request_id)
 
     return ThreadingHTTPServer((host, port), Handler)

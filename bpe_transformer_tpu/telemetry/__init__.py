@@ -21,7 +21,16 @@ Subsumes and extends the old ``utils.metrics`` / ``utils.profiling`` pair
   host-gap split of step time, emitted as ``kind="attribution"`` records
   (``--attribution-every`` / ``bpe-tpu profile``);
 - `trace` — Chrome trace-event export of the span stream
-  (``bpe-tpu report --trace``, jax-free);
+  (``bpe-tpu report --trace``, jax-free) + cross-stream per-request
+  timeline assembly (``request_timeline``);
+- `fleet` — the fleet aggregator (``bpe-tpu fleet``, jax-free): polls N
+  replicas + the router into ``kind="fleet"`` records and serves
+  fleet-level ``/statusz`` + ``/metrics``;
+- `slo` — declarative service-level objectives over the fleet stream:
+  rolling-window SLIs and error-budget burn rates (``kind="slo"``);
+- `alerts` — the serving anomaly watchdog: edge-triggered rule-based
+  detectors over engine/fleet gauges (``kind="alert"``), run inside
+  every serving engine and the fleet aggregator;
 - `watchdog` — hung-step detection against the trailing median step time
   plus the "dump state + raise or skip" non-finite policy;
 - `timing` — ``StepTimer`` throughput/MFU windows, ``profile_trace``,

@@ -60,6 +60,24 @@ def _config_dict(config) -> dict | None:
     return {"repr": repr(config)}
 
 
+def host_manifest(kind: str) -> dict:
+    """The header record WITHOUT the jax/device probe: for jax-free
+    emitters (the fleet router and aggregator) that must never initialize
+    an accelerator backend as a side effect of describing themselves —
+    ``run_manifest`` would touch ``jax.devices()`` whenever jax happens to
+    be installed, and a front-end box colocated with a chip must not grab
+    it just to write a stream header."""
+    return {
+        "kind": "manifest",
+        "run_kind": kind,
+        "time_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00", time.gmtime()),
+        "host": socket.gethostname(),
+        "python": platform.python_version(),
+        "argv": list(sys.argv),
+        "git_sha": git_sha(),
+    }
+
+
 def run_manifest(
     kind: str = "train",
     model_config=None,
@@ -72,15 +90,7 @@ def run_manifest(
     axis-name -> size layout is recorded); configs may be dataclasses or
     dicts.  Device/jax fields are best-effort — absent when no backend is
     reachable (e.g. the report tool or a replay path)."""
-    record: dict = {
-        "kind": "manifest",
-        "run_kind": kind,
-        "time_utc": time.strftime("%Y-%m-%dT%H:%M:%S+00:00", time.gmtime()),
-        "host": socket.gethostname(),
-        "python": platform.python_version(),
-        "argv": list(sys.argv),
-        "git_sha": git_sha(),
-    }
+    record: dict = host_manifest(kind)
     try:
         from bpe_transformer_tpu import __version__
 

@@ -12,7 +12,12 @@ Two sources, one panel:
   collective/host-gap split;
 - **a live server** (``bpe-tpu monitor --url host:port``): poll
   ``GET /metrics`` on a ``bpe-tpu serve`` process and parse the Prometheus
-  exposition back into the same state.
+  exposition back into the same state;
+- **a fleet aggregator** (``bpe-tpu monitor --fleet host:port``): poll a
+  ``bpe-tpu fleet`` process's ``/statusz`` and render the fleet line —
+  replicas online/draining, fleet tok/s, worst-replica KV headroom,
+  firing alerts, worst SLO burn (the ``fleet``/``slo``/``alert`` record
+  kinds fold from a JSONL stream too).
 
 Pure host-side and jax-free (like `report`): it runs on a laptop watching a
 stream rsynced off a pod, or next to the serving process itself.  Renders
@@ -87,6 +92,45 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
                         "rewound", "draft_frac", "proposed", "accepted"):
                 if key in record:
                     state[f"spec_{key}"] = record[key]
+        elif kind == "fleet":
+            # Fleet sweep (telemetry/fleet.py): the whole fleet's state in
+            # one line — online counts, summed rates, worst-replica KV
+            # headroom, merged p99s, availability.
+            for key in ("replicas_total", "replicas_online",
+                        "replicas_draining", "queue_depth", "active_slots",
+                        "slots", "tokens_per_sec", "kv_headroom_frac",
+                        "request_p99_s", "ttfb_p99_s", "availability",
+                        "accept_rate"):
+                if key in record:
+                    state[f"fleet_{key}"] = record[key]
+        elif kind == "slo":
+            # SLO burn rates (telemetry/slo.py), latest per (objective,
+            # window); the panel shows the worst.
+            burns = dict(state.get("slo_burns") or {})
+            label = (
+                f"{record.get('objective')}/{record.get('window_s'):g}s"
+                if isinstance(record.get("window_s"), (int, float))
+                else str(record.get("objective"))
+            )
+            if record.get("burn_rate") is not None:
+                burns[label] = record["burn_rate"]
+            state["slo_burns"] = burns
+            finite = [v for v in burns.values() if isinstance(v, (int, float))]
+            if finite:
+                state["slo_max_burn"] = max(finite)
+        elif kind == "alert":
+            # Watchdog transitions (telemetry/alerts.py): track the
+            # currently-firing set; every new firing is an anomaly.
+            firing = list(state.get("alerts_firing") or [])
+            rule = record.get("rule")
+            if record.get("state") == "firing":
+                if rule not in firing:
+                    firing.append(rule)
+                state["anomalies"] += 1
+                state["last_anomaly"] = f"alert {rule}"
+            elif record.get("state") == "cleared" and rule in firing:
+                firing.remove(rule)
+            state["alerts_firing"] = firing
         elif kind == "resources":
             for key in ("host_rss_bytes", "live_buffer_bytes",
                         "hbm_bytes_in_use", "hbm_peak_bytes_in_use",
@@ -365,6 +409,34 @@ def render_frame(state: dict, source: str) -> str:
             parts.append(f"rewound {_num(state['spec_rewound'])}")
         lines.append("  spec   " + "  ".join(parts))
 
+    if state.get("fleet_replicas_total") is not None:
+        parts = [
+            f"replicas {_num(state.get('fleet_replicas_online'))}"
+            f"/{_num(state['fleet_replicas_total'])}"
+        ]
+        if state.get("fleet_replicas_draining"):
+            parts.append(f"{_num(state['fleet_replicas_draining'])} draining")
+        if state.get("fleet_tokens_per_sec") is not None:
+            parts.append(f"tok/s {_num(state['fleet_tokens_per_sec'], 6)}")
+        if state.get("fleet_queue_depth") is not None:
+            parts.append(f"queue {_num(state['fleet_queue_depth'])}")
+        if state.get("fleet_kv_headroom_frac") is not None:
+            parts.append(
+                f"kv headroom {state['fleet_kv_headroom_frac']:.0%}"
+            )
+        if state.get("fleet_request_p99_s") is not None:
+            parts.append(f"p99 {_num(state['fleet_request_p99_s'])}s")
+        if state.get("fleet_availability") is not None:
+            parts.append(f"avail {state['fleet_availability']:.3%}")
+        if state.get("slo_max_burn") is not None:
+            parts.append(f"burn {_num(state['slo_max_burn'], 3)}")
+        lines.append("  fleet  " + "  ".join(parts))
+
+    if state.get("alerts_firing"):
+        lines.append(
+            "  alert  FIRING: " + ", ".join(state["alerts_firing"])
+        )
+
     mem_parts = []
     if state.get("hbm_bytes_in_use") is not None:
         hbm = f"hbm {_mib(state['hbm_bytes_in_use'])}"
@@ -497,6 +569,64 @@ class FileSource:
         return self.state
 
 
+class FleetSource:
+    """Poll a fleet aggregator's ``GET /statusz`` (``bpe-tpu monitor
+    --fleet HOST:PORT``) and map its fleet/alerts/SLO payload onto the
+    same state keys the JSONL fold produces — one renderer, three
+    sources."""
+
+    def __init__(self, url: str, timeout: float = 5.0):
+        import urllib.request  # noqa: F401 — fail fast if unavailable
+
+        if "://" not in url:
+            url = f"http://{url}"
+        self.url = url.rstrip("/") + "/statusz"
+        self.label = self.url
+        self.timeout = timeout
+        self.state: dict = {}
+
+    def refresh(self) -> dict:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(self.url, timeout=self.timeout) as resp:
+                page = json.loads(resp.read())
+        except (OSError, ValueError) as exc:
+            self.state = dict(self.state)
+            self.state["last_anomaly"] = f"scrape failed: {exc}"
+            return self.state
+        fl = page.get("fleet") or {}
+        state: dict = {
+            "run_kind": "fleet",
+            "n_records": page.get("polls", 0),
+            "uptime_s": page.get("uptime_s"),
+            "anomalies": len(page.get("alerts") or []),
+        }
+        for key in ("replicas_total", "replicas_online", "replicas_draining",
+                    "queue_depth", "active_slots", "slots", "tokens_per_sec",
+                    "kv_headroom_frac", "request_p99_s", "ttfb_p99_s",
+                    "availability", "accept_rate"):
+            if fl.get(key) is not None:
+                state[f"fleet_{key}"] = fl[key]
+        firing = [
+            a.get("rule") for a in page.get("alerts") or [] if a.get("rule")
+        ]
+        if firing:
+            state["alerts_firing"] = firing
+            state["last_anomaly"] = f"alert {firing[-1]}"
+        burns = {}
+        for row in page.get("slo") or []:
+            if row.get("burn_rate") is not None:
+                burns[
+                    f"{row.get('objective')}/{row.get('window_s'):g}s"
+                ] = row["burn_rate"]
+        if burns:
+            state["slo_burns"] = burns
+            state["slo_max_burn"] = max(burns.values())
+        self.state = state
+        return state
+
+
 class UrlSource:
     """Poll a running server's ``GET /metrics``."""
 
@@ -580,6 +710,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="telemetry metrics.jsonl to tail")
     parser.add_argument("--url", default=None, metavar="HOST:PORT",
                         help="poll http://HOST:PORT/metrics instead")
+    parser.add_argument("--fleet", default=None, metavar="HOST:PORT",
+                        help="poll a fleet aggregator's /statusz instead "
+                        "(bpe-tpu fleet): replicas online/draining, fleet "
+                        "tok/s, worst kv headroom, alerts, SLO burn")
     parser.add_argument("--interval", type=float, default=2.0)
     parser.add_argument("--once", action="store_true",
                         help="render one frame and exit")
@@ -590,8 +724,10 @@ def main(argv: list[str] | None = None) -> int:
     except SystemExit as exc:
         return int(exc.code or 0)
 
-    if bool(args.metrics) == bool(args.url):
-        print("monitor: give a metrics.jsonl path OR --url host:port",
+    sources = sum(bool(s) for s in (args.metrics, args.url, args.fleet))
+    if sources != 1:
+        print("monitor: give a metrics.jsonl path OR --url host:port OR "
+              "--fleet host:port",
               file=sys.stderr)
         return 2
     if args.metrics:
@@ -607,6 +743,8 @@ def main(argv: list[str] | None = None) -> int:
         if args.once and not source.refresh().get("n_records"):
             print(f"monitor: {args.metrics} holds no readable records yet",
                   file=sys.stderr)
+    elif args.fleet:
+        source = FleetSource(args.fleet)
     else:
         source = UrlSource(args.url)
 

@@ -333,6 +333,95 @@ def summarize(records: list[dict]) -> dict:
             "projected_tick_s": last.get("projected_tick_s"),
         }
 
+    # Fleet sweeps (kind="fleet", telemetry/fleet.py): online/draining
+    # trajectory, fleet-summed rates, worst-replica KV headroom, merged
+    # p99s and cumulative availability (last sample wins on cumulative
+    # fields, stats on gauges).
+    fleet_records = [r for r in records if r.get("kind") == "fleet"]
+    fleet_summary = None
+    if fleet_records:
+        last = fleet_records[-1]
+        fleet_summary = {
+            "n": len(fleet_records),
+            "replicas_total": last.get("replicas_total"),
+            "replicas_online": _stats(
+                [r.get("replicas_online") for r in fleet_records]
+            ),
+            "replicas_draining": _stats(
+                [r.get("replicas_draining") for r in fleet_records]
+            ),
+            "queue_depth": _stats(
+                [r.get("queue_depth") for r in fleet_records]
+            ),
+            "tokens_per_sec": _stats(
+                [r.get("tokens_per_sec") for r in fleet_records]
+            ),
+            "kv_headroom_frac": _stats(
+                [r.get("kv_headroom_frac") for r in fleet_records]
+            ),
+            "request_p99_s": last.get("request_p99_s"),
+            "ttfb_p99_s": last.get("ttfb_p99_s"),
+            "availability": last.get("availability"),
+            "accept_rate": last.get("accept_rate"),
+        }
+
+    # SLO burn rates (kind="slo", telemetry/slo.py): the per-objective
+    # digest plus the stream-wide worst burn — the compare gate's
+    # slo_max_burn_rate row reads straight off it.
+    slo_records = [r for r in records if r.get("kind") == "slo"]
+    slo_summary = None
+    if slo_records:
+        from bpe_transformer_tpu.telemetry.slo import burn_summary
+
+        slo_summary = burn_summary(slo_records)
+        slo_summary["n"] = len(slo_records)
+        worst = slo_summary.get("max_burn_rate")
+        if isinstance(worst, (int, float)) and worst > 1.0:
+            anomalies.append(
+                f"error budget burning at {worst:.1f}x sustainable rate "
+                "(slo records; see == slo ==)"
+            )
+
+    # Watchdog transitions (kind="alert", telemetry/alerts.py): every
+    # firing is an anomaly; the summary keeps the fire/clear timeline and
+    # whatever was still firing when the stream ended.
+    alert_records = [r for r in records if r.get("kind") == "alert"]
+    alerts_summary = None
+    if alert_records:
+        still_firing: dict[str, dict] = {}
+        fired = 0
+        for r in alert_records:
+            if r.get("state") == "firing":
+                fired += 1
+                still_firing[str(r.get("rule"))] = r
+                anomalies.append(
+                    f"alert {r.get('rule')} fired"
+                    + (f": {r['message']}" if r.get("message") else "")
+                )
+            elif r.get("state") == "cleared":
+                still_firing.pop(str(r.get("rule")), None)
+        alerts_summary = {
+            "n": len(alert_records),
+            "fired": fired,
+            "firing_at_end": sorted(still_firing),
+            "timeline": [
+                {
+                    "t": r.get("t"),
+                    "rule": r.get("rule"),
+                    "state": r.get("state"),
+                    "severity": r.get("severity"),
+                    "message": r.get("message"),
+                    "active_s": r.get("active_s"),
+                }
+                for r in alert_records
+            ],
+        }
+        if still_firing:
+            anomalies.append(
+                "alerts still firing at stream end: "
+                + ", ".join(sorted(still_firing))
+            )
+
     # Speculative-decoding trajectory (kind="spec", serving/spec/): every
     # counter is cumulative, so the LAST sample is the run's verdict —
     # accept_rate tells whether the draft earns its keep,
@@ -611,6 +700,9 @@ def summarize(records: list[dict]) -> dict:
         "serving": serving,
         "kvpool": kvpool_summary,
         "spec": spec_summary,
+        "fleet": fleet_summary,
+        "slo": slo_summary,
+        "alerts": alerts_summary,
         "roofline": roofline_summary,
         "resources": resource_summary,
         "attribution": attribution_summary,
@@ -630,6 +722,36 @@ def _fmt(value, digits=4) -> str:
     if isinstance(value, float):
         return f"{value:,.{digits}g}"
     return str(value)
+
+
+def _slo_section_lines(slo_summary: dict) -> list[str]:
+    """The ``== slo ==`` section body — shared by the stream render and
+    the ``--slo`` on-demand evaluation path so both always agree."""
+    lines = [f"== slo ({slo_summary.get('n', 0)} evaluations) =="]
+    objectives = slo_summary.get("objectives") or {}
+    for name in sorted(objectives):
+        entry = objectives[name]
+        burn = entry.get("last_burn")
+        lines.append(
+            f"  {name:<18s} target {_fmt(entry.get('target'))}"
+            f"  sli {_fmt(entry.get('last_sli'))}"
+            f"  burn last {_fmt(burn, 3)}  max {_fmt(entry.get('max_burn'), 3)}"
+            + ("  !! over budget" if isinstance(burn, (int, float))
+               and burn > 1.0 else "")
+        )
+    worst = slo_summary.get("max_burn_rate")
+    if worst is None:
+        lines.append("  (no traffic inside any evaluation window)")
+    else:
+        lines.append(
+            f"  worst burn rate {_fmt(worst, 3)} — "
+            + (
+                "inside error budget"
+                if worst <= 1.0
+                else "BURNING ERROR BUDGET"
+            )
+        )
+    return lines
 
 
 def render_report(records: list[dict]) -> str:
@@ -842,6 +964,77 @@ def render_report(records: list[dict]) -> str:
                 else ""
             )
         )
+
+    fl = s.get("fleet")
+    if fl:
+        lines.append(f"== fleet ({fl['n']} sweeps) ==")
+        online = fl.get("replicas_online") or {}
+        draining = fl.get("replicas_draining") or {}
+        lines.append(
+            f"  replicas {_fmt(online.get('last'))}"
+            f"/{_fmt(fl.get('replicas_total'))} online"
+            f" (min {_fmt(online.get('min'))}"
+            + (
+                f", draining max {_fmt(draining.get('max'))}"
+                if draining.get("max")
+                else ""
+            )
+            + ")"
+        )
+        tps = fl.get("tokens_per_sec") or {}
+        queue = fl.get("queue_depth") or {}
+        if tps or queue:
+            lines.append(
+                f"  tokens/sec mean {_fmt(tps.get('mean'), 6)}"
+                f"  (peak {_fmt(tps.get('max'), 6)})"
+                f"  queue max {_fmt(queue.get('max'))}"
+            )
+        head = fl.get("kv_headroom_frac") or {}
+        if head:
+            lines.append(
+                f"  worst-replica kv headroom last "
+                f"{_fmt(head.get('last'), 3)} (min {_fmt(head.get('min'), 3)})"
+            )
+        avail = fl.get("availability")
+        lines.append(
+            f"  request p99 {_fmt(fl.get('request_p99_s'))}s"
+            f"  ttfb p99 {_fmt(fl.get('ttfb_p99_s'))}s"
+            + (
+                f"  availability {avail:.4%}"
+                if isinstance(avail, float)
+                else ""
+            )
+        )
+
+    sl = s.get("slo")
+    if sl:
+        lines.extend(_slo_section_lines(sl))
+
+    al = s.get("alerts")
+    if al:
+        lines.append(
+            f"== alerts ({al['fired']} fired, "
+            f"{len(al['firing_at_end'])} still firing) =="
+        )
+        for row in al["timeline"][-12:]:
+            lines.append(
+                f"  t={_fmt(row.get('t'))} {row.get('state'):<8s}"
+                f"{str(row.get('rule')):<22s}"
+                + (
+                    f"({row.get('severity')}) "
+                    if row.get("state") == "firing" and row.get("severity")
+                    else ""
+                )
+                + (
+                    str(row.get("message"))
+                    if row.get("state") == "firing" and row.get("message")
+                    else (
+                        f"after {_fmt(row.get('active_s'))}s"
+                        if row.get("active_s") is not None
+                        else ""
+                    )
+                )
+            )
 
     rs = s["resources"]
     if rs:
@@ -1098,6 +1291,22 @@ COMPARE_METRICS: dict = {
     "tokens_per_target_step": (
         lambda s: (s.get("spec") or {}).get("tokens_per_target_step"),
         "higher"),
+    # Fleet-level serving health (kind="fleet"/"slo", ISSUE 12): the SLO
+    # burn rate gates a serving regression the same way throughput rows
+    # gate a training one — a stream whose worst burn rises past the
+    # baseline's is failing its latency/availability objectives harder.
+    "slo_max_burn_rate": (
+        lambda s: (s.get("slo") or {}).get("max_burn_rate"), "lower"),
+    "fleet_tokens_per_sec_mean": (
+        lambda s: ((s.get("fleet") or {}).get("tokens_per_sec", {})
+                   or {}).get("mean"), "higher"),
+    "fleet_request_p99_s": (
+        lambda s: (s.get("fleet") or {}).get("request_p99_s"), "lower"),
+    "fleet_availability": (
+        lambda s: (s.get("fleet") or {}).get("availability"), "higher"),
+    "fleet_kv_headroom_min": (
+        lambda s: ((s.get("fleet") or {}).get("kv_headroom_frac", {})
+                   or {}).get("min"), "higher"),
     # Per-chip state bytes (optimizer sharding's memory win): a run whose
     # opt_state_bytes shrinks 1/N against the unsharded baseline shows up
     # as an "improved" row; growing back is a gated regression.
@@ -1152,6 +1361,11 @@ def baseline_capture_metrics(capture: dict) -> dict:
         # acceptance evidence gates against a later stream's spec records.
         ("accept_rate", "accept_rate"),
         ("tokens_per_target_step", "tokens_per_target_step"),
+        # Fleet/SLO capture rows (ISSUE 12): a pinned burn-rate baseline
+        # gates a later fleet stream's serving health.
+        ("slo_max_burn_rate", "slo_max_burn_rate"),
+        ("fleet_request_p99_s", "fleet_request_p99_s"),
+        ("availability", "fleet_availability"),
     ):
         value = capture.get(cap_key)
         if isinstance(value, (int, float)) and math.isfinite(value):
@@ -1287,6 +1501,12 @@ def main(argv: list[str] | None = None) -> int:
         "counter tracks",
     )
     parser.add_argument(
+        "--slo", action="store_true",
+        help="force the SLO section: reuse the stream's slo records, or "
+        "evaluate the default objectives over its fleet records; a stream "
+        "with neither gets a graceful notice, never a stack trace",
+    )
+    parser.add_argument(
         "--threshold-pct", type=float, default=5.0,
         help="default regression threshold in percent (default: 5)",
     )
@@ -1351,6 +1571,44 @@ def main(argv: list[str] | None = None) -> int:
         summary = summarize(records)
         current_metrics = extract_compare_metrics(summary)
         print(render_report(records))
+
+    if args.slo:
+        if capture_current is not None:
+            print("report: --slo needs a telemetry stream, not a bench "
+                  "capture JSON", file=sys.stderr)
+            return 2
+        slo_records = [r for r in records if r.get("kind") == "slo"]
+        fleet_records = [r for r in records if r.get("kind") == "fleet"]
+        if not slo_records and fleet_records:
+            # No pre-evaluated rows: run the default objectives over the
+            # stream's fleet records on the spot (offline twin of the
+            # aggregator's per-sweep evaluation).
+            from bpe_transformer_tpu.telemetry.slo import evaluate
+
+            slo_records = evaluate(fleet_records)
+        if not slo_records:
+            # Pinned graceful-empty contract (PR 3 precedent): a training
+            # or single-replica stream simply has no fleet evidence.
+            print(
+                "== slo ==\n  no fleet/slo records in this stream — "
+                "nothing to evaluate (run bpe-tpu fleet --metrics-jsonl "
+                "against the replicas)"
+            )
+        elif summary.get("slo") is None:
+            # Section not already rendered above: show the on-demand rows
+            # AND feed their worst burn into the compare gate — a stream
+            # whose aggregator died before emitting slo rows must not
+            # slip a printed-as-BURNING regression past --baseline.
+            from bpe_transformer_tpu.telemetry.slo import burn_summary
+
+            on_demand = burn_summary(slo_records)
+            on_demand["n"] = len(slo_records)
+            print("\n".join(_slo_section_lines(on_demand)))
+            worst = on_demand.get("max_burn_rate")
+            if isinstance(worst, (int, float)) and math.isfinite(worst):
+                current_metrics.setdefault(
+                    "slo_max_burn_rate", (float(worst), "lower")
+                )
 
     if args.trace is not None:
         if not records:

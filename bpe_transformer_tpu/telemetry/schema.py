@@ -125,6 +125,34 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
     "roofline": {
         "kind", "t", "weight_bytes", "kv_bytes", "flops",
     },
+    # Fleet sweep (telemetry/fleet.py, `bpe-tpu fleet`): one concurrent
+    # poll of every replica's /statusz+/metrics (plus the router's
+    # counters) merged into fleet-level gauges — online/draining counts,
+    # summed queue depth / active slots / token rate, worst-replica
+    # ``kv_headroom_frac``, fleet spec ``accept_rate``, cumulative
+    # availability counters (``requests_ok``/``requests_failed``, router
+    # present only), merged cumulative latency histograms
+    # (``hist_total``/``hist_ttfb`` as ``[le, count]`` pairs, le null =
+    # +Inf) with the derived ``request_p99_s``/``ttfb_p99_s``, and a
+    # ``per_replica`` snapshot table.  All but the required fields are
+    # optional/nullable — a dense fleet has no kv gauges, a routerless
+    # sweep no availability.
+    "fleet": {"kind", "t", "replicas_total", "replicas_online"},
+    # SLO evaluation (telemetry/slo.py) over a rolling window of the
+    # fleet stream: the objective's ``target`` good-fraction, the
+    # window's ``good``/``total`` event deltas and derived ``sli``, and
+    # the error-budget ``burn_rate`` = (1-sli)/(1-target) — null when the
+    # window saw no traffic.  Latency objectives carry ``threshold_s``.
+    # ``burn_rate`` feeds the report compare gate (slo_max_burn_rate).
+    "slo": {"kind", "t", "objective", "window_s", "burn_rate"},
+    # Serving anomaly watchdog transition (telemetry/alerts.py):
+    # edge-triggered — one ``state="firing"`` record when a rule starts
+    # firing (with its evidence fields and human ``message``), one
+    # ``state="cleared"`` (with ``active_s``) when it stops; persisting
+    # conditions emit nothing.  Rules: queue_growth, block_exhaustion
+    # (with ``projected_dry_s``), accept_rate_collapse, compile_storm,
+    # replica_flap.  ``severity`` is ``page`` | ``warn``.
+    "alert": {"kind", "t", "rule", "state"},
     # Run trailer: record counts + clean verdict (spans.py Telemetry.footer).
     "footer": {"kind", "t", "record_counts"},
     # Step/val metrics (NO kind key): at least a step number plus one

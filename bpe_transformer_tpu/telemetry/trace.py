@@ -39,11 +39,15 @@ TRACE_ASSUMPTIONS: dict[str, set[str]] = {
     "resources": {"kind", "time_unix"},
     "attribution": {"kind", "t"},
     "kvpool": {"kind", "t"},
+    "fleet": {"kind", "t"},
 }
 
 #: Counter series pulled from each periodic record kind.
 _ENGINE_COUNTERS = ("active_slots", "queue_depth", "tokens_per_sec")
 _KVPOOL_COUNTERS = ("blocks_free", "blocks_shared", "prefill_pending_tokens")
+_FLEET_COUNTERS = (
+    "replicas_online", "queue_depth", "tokens_per_sec", "active_slots"
+)
 _ATTRIBUTION_COUNTERS = ("compute_frac", "collective_frac", "host_gap_frac")
 _RESOURCE_COUNTERS = (
     "host_rss_bytes",
@@ -138,8 +142,13 @@ def trace_events(records: list[dict]) -> list[dict]:
             # per request (concurrent requests no longer garble a shared
             # serve/decode lane).  Capped at _MAX_REQUEST_LANES distinct
             # requests; overflow stays in the shared phase lanes.
+            # Router spans (router/pick|hop|request) carry the same
+            # request_id the replica's serve/* spans do — in a merged or
+            # router-only stream they join the request's lane, so a
+            # failover request reads as hop, hop, queue, prefill, decode
+            # on one row.
             rid = record.get("request_id")
-            if rid and path.startswith("serve/"):
+            if rid and path.startswith(("serve/", "router/")):
                 lane = f"request/{rid}"
                 if lane in request_lanes:
                     path = lane
@@ -220,6 +229,25 @@ def trace_events(records: list[dict]) -> list[dict]:
                         "args": series,
                     }
                 )
+        elif kind == "fleet":
+            t = record.get("t")
+            if not isinstance(t, (int, float)):
+                continue
+            series = {
+                k: record[k]
+                for k in _FLEET_COUNTERS
+                if isinstance(record.get(k), (int, float))
+            }
+            if series:
+                events.append(
+                    {
+                        "ph": "C",
+                        "pid": _PID,
+                        "name": "fleet",
+                        "ts": round(t * 1e6, 1),
+                        "args": series,
+                    }
+                )
         elif kind == "resources":
             t_unix = record.get("time_unix")
             if not isinstance(t_unix, (int, float)):
@@ -241,6 +269,65 @@ def trace_events(records: list[dict]) -> list[dict]:
                     }
                 )
     return events
+
+
+def request_timeline(
+    streams: list[list[dict]], trace_id: str
+) -> list[dict]:
+    """One request's end-to-end timeline assembled ACROSS telemetry
+    streams by its trace id (ISSUE 12): the router's pick/hop spans and
+    the replica's queue_wait/prefill/decode spans, ordered on one axis.
+
+    ``streams`` is a list of parsed record lists (e.g. the router's JSONL
+    and each replica's) — every span whose ``request_id`` equals
+    ``trace_id`` joins the timeline.  Each stream has its OWN ``t`` epoch
+    (its Telemetry object's creation), so ordering uses the spans'
+    absolute ``time_unix`` start stamps (both emitters write them);
+    stamp-less spans (older streams) fall back to their stream-relative
+    ``t``, which still orders correctly within one stream.  Rows carry
+    ``stream`` (the index into ``streams``), the span fields, and
+    ``t_rel`` — seconds since the timeline's earliest stamped span — so a
+    failover request renders as::
+
+        t_rel=0.000  [0] router/hop   replica=A outcome=connect_failed
+        t_rel=0.021  [0] router/hop   replica=B outcome=ok
+        t_rel=0.022  [1] serve/queue_wait
+        t_rel=0.024  [1] serve/prefill
+        t_rel=0.061  [1] serve/decode
+    """
+    rows: list[dict] = []
+    for index, records in enumerate(streams):
+        for record in records or []:
+            if (
+                record.get("kind") != "span"
+                or str(record.get("request_id") or "") != str(trace_id)
+            ):
+                continue
+            row = dict(record)
+            row["stream"] = index
+            rows.append(row)
+    stamped = [
+        r["time_unix"]
+        for r in rows
+        if isinstance(r.get("time_unix"), (int, float))
+    ]
+    base = min(stamped) if stamped else None
+
+    def sort_key(row):
+        wall = row.get("time_unix")
+        if isinstance(wall, (int, float)):
+            return (0, wall)
+        return (1, row.get("t") or 0.0)
+
+    rows.sort(key=sort_key)
+    for row in rows:
+        wall = row.get("time_unix")
+        row["t_rel"] = (
+            round(wall - base, 6)
+            if base is not None and isinstance(wall, (int, float))
+            else None
+        )
+    return rows
 
 
 def write_trace(records: list[dict], out_path: str | Path) -> int:

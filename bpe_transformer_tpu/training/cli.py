@@ -504,7 +504,38 @@ def cmd_route(args) -> int:
         "--request-timeout", str(args.request_timeout),
         "--connect-timeout", str(args.connect_timeout),
     ]
+    if args.metrics_jsonl:
+        forwarded += ["--metrics-jsonl", args.metrics_jsonl]
     return route_main(forwarded)
+
+
+def cmd_fleet(args) -> int:
+    # Jax-free fleet aggregator (telemetry/fleet.py): poll N replicas +
+    # the router into kind=fleet/slo/alert records and serve the fleet
+    # /statusz + /metrics — the observability plane every fleet-level
+    # tool (monitor --fleet, report --slo, the compare gate) reads.
+    from bpe_transformer_tpu.telemetry.fleet import main as fleet_main
+
+    forwarded = []
+    for replica in args.replica:
+        forwarded += ["--replica", replica]
+    if args.router:
+        forwarded += ["--router", args.router]
+    forwarded += [
+        "--host", args.host,
+        "--port", str(args.port),
+        "--interval", str(args.interval),
+        "--poll-timeout", str(args.poll_timeout),
+    ]
+    if args.metrics_jsonl:
+        forwarded += ["--metrics-jsonl", args.metrics_jsonl]
+    if args.slo_config:
+        forwarded += ["--slo-config", args.slo_config]
+    for window in args.window or []:
+        forwarded += ["--window", str(window)]
+    if args.once:
+        forwarded.append("--once")
+    return fleet_main(forwarded)
 
 
 def _warmup_train(args) -> int:
@@ -978,6 +1009,8 @@ def cmd_report(args) -> int:
         forwarded += ["--baseline", args.baseline]
     if args.trace:
         forwarded += ["--trace", args.trace]
+    if args.slo:
+        forwarded.append("--slo")
     forwarded += ["--threshold-pct", str(args.threshold_pct)]
     for pair in args.threshold or []:
         forwarded += ["--threshold", pair]
@@ -1005,6 +1038,8 @@ def cmd_monitor(args) -> int:
         forwarded.append(args.metrics)
     if args.url:
         forwarded += ["--url", args.url]
+    if args.fleet:
+        forwarded += ["--fleet", args.fleet]
     forwarded += ["--interval", str(args.interval)]
     if args.once:
         forwarded.append("--once")
@@ -1425,7 +1460,42 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--connect-timeout", type=float, default=5.0,
                    help="seconds to wait for a replica's TCP connect "
                    "before failing over")
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="write the router's trace stream (pick/hop/"
+                   "request spans per proxied request) to this JSONL; "
+                   "one X-Request-Id trace id joins it to the replicas' "
+                   "streams")
     p.set_defaults(fn=cmd_route)
+
+    p = sub.add_parser(
+        "fleet",
+        help="fleet aggregator over N serve replicas + the router: "
+        "kind=fleet/slo/alert telemetry, SLO burn rates, anomaly "
+        "watchdog, fleet /statusz + /metrics; jax-free",
+    )
+    p.add_argument("--replica", action="append", required=True,
+                   metavar="HOST:PORT",
+                   help="replica base URL (repeatable)")
+    p.add_argument("--router", default=None, metavar="HOST:PORT",
+                   help="router base URL (availability counters)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8200,
+                   help="fleet HTTP port (0: ephemeral)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between fleet sweeps")
+    p.add_argument("--poll-timeout", type=float, default=5.0,
+                   help="per-host poll timeout in seconds")
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="write fleet/slo/alert records to this JSONL "
+                   "(bpe-tpu report summarizes and gates it)")
+    p.add_argument("--slo-config", default=None, metavar="JSON",
+                   help="objectives as inline JSON or a JSON file path")
+    p.add_argument("--window", action="append", type=float, default=None,
+                   metavar="SECONDS",
+                   help="SLO evaluation window (repeatable)")
+    p.add_argument("--once", action="store_true",
+                   help="one sweep, print the fleet record, exit")
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser(
         "warmup",
@@ -1551,6 +1621,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="export the span stream as Chrome trace-event "
                    "JSON (Perfetto / chrome://tracing); engine/resources "
                    "records become counter tracks")
+    p.add_argument("--slo", action="store_true",
+                   help="force the SLO section (evaluates default "
+                   "objectives over fleet records when no slo records "
+                   "exist; graceful notice when the stream has neither)")
     p.add_argument("--threshold-pct", type=float, default=5.0,
                    help="default regression threshold in percent")
     p.add_argument("--threshold", action="append", default=[],
@@ -1578,6 +1652,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="telemetry metrics.jsonl to tail")
     p.add_argument("--url", default=None, metavar="HOST:PORT",
                    help="poll http://HOST:PORT/metrics instead of a file")
+    p.add_argument("--fleet", default=None, metavar="HOST:PORT",
+                   help="poll a bpe-tpu fleet aggregator's /statusz "
+                   "instead: replicas online/draining, fleet tok/s, "
+                   "worst kv headroom, firing alerts, SLO burn")
     p.add_argument("--interval", type=float, default=2.0,
                    help="refresh interval in seconds (default: 2)")
     p.add_argument("--once", action="store_true",
@@ -1601,9 +1679,10 @@ def main(argv: list[str] | None = None) -> int:
         # Host-side tools that must never initialize a backend — and the
         # supervisor parent, which must not grab the accelerator its child
         # needs; the child re-enters main() without --supervise and applies
-        # the config itself.  The fleet router is jax-free too: it fronts
-        # replicas from a box with no accelerator runtime.
-        command in ("report", "monitor", "verify-checkpoint", "route")
+        # the config itself.  The fleet router and aggregator are jax-free
+        # too: they front replicas from a box with no accelerator runtime.
+        command in ("report", "monitor", "verify-checkpoint", "route",
+                    "fleet")
         or "--supervise" in raw_argv
     )
     if platforms and not jax_free:

@@ -1,0 +1,1122 @@
+"""Fleet observability plane (ISSUE 12): anomaly-watchdog rules, SLO
+burn-rate arithmetic, the fleet aggregator (canned replicas + router),
+cross-stream request tracing, and the report/monitor surfaces.
+
+Everything up to the E2E section is jax-free by construction — the
+aggregator, SLO evaluator, alert rules, and monitor/report paths run on
+front-end boxes with no accelerator runtime, and the tests pin that.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from bpe_transformer_tpu.telemetry.alerts import (
+    AcceptRateCollapseRule,
+    AlertEngine,
+    BlockExhaustionRule,
+    CompileStormRule,
+    QueueGrowthRule,
+    ReplicaFlapRule,
+    default_fleet_rules,
+    default_serving_rules,
+)
+from bpe_transformer_tpu.telemetry.fleet import (
+    FleetAggregator,
+    make_fleet_http_server,
+    merge_histograms,
+    parse_phase_histograms,
+)
+from bpe_transformer_tpu.telemetry.schema import validate_record
+from bpe_transformer_tpu.telemetry.slo import (
+    DEFAULT_OBJECTIVES,
+    SLObjective,
+    burn_summary,
+    evaluate,
+    hist_quantile,
+    objectives_from_json,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURE = REPO / "tests" / "fixtures" / "fleet_tiny.jsonl"
+BASELINE = REPO / "tests" / "fixtures" / "slo_base_capture.json"
+
+
+# ------------------------------------------------------------ alert rules
+
+
+def test_queue_growth_rule_fires_and_clears():
+    engine = AlertEngine([QueueGrowthRule(window=3, min_depth=3)])
+    transitions = []
+    for t, depth in enumerate([0, 1, 3, 6, 9, 2, 0]):
+        transitions += engine.feed({"queue_depth": depth}, float(t))
+    assert [r["state"] for r in transitions] == ["firing", "cleared"]
+    firing, cleared = transitions
+    assert firing["rule"] == "queue_growth" and firing["t"] == 2.0
+    assert firing["queue_depth"] == 3 and firing["growth"] == 3
+    assert "queue grew" in firing["message"]
+    assert cleared["t"] == 5.0 and cleared["active_s"] == 3.0
+    assert engine.active() == []
+    for record in transitions:
+        assert validate_record(record) == [], record
+
+
+def test_queue_burst_that_drains_never_fires():
+    """A momentary burst that shrinks inside the window is not sustained
+    growth — the rule needs monotone non-decreasing depth across it."""
+    engine = AlertEngine([QueueGrowthRule(window=3, min_depth=3)])
+    transitions = []
+    for t, depth in enumerate([0, 9, 4, 9, 3, 9, 2]):
+        transitions += engine.feed({"queue_depth": depth}, float(t))
+    assert transitions == []
+
+
+def test_block_exhaustion_projects_time_to_dry():
+    engine = AlertEngine([BlockExhaustionRule(window=3, horizon_s=30.0)])
+    # Drain accelerates from -1 to -10 blocks/s: early windows project
+    # hundreds of seconds to dry (no fire); at 278 free and -10/s the
+    # projection crosses the 30s horizon and the rule fires once.
+    out = []
+    for t, free in enumerate([300, 299, 298, 288, 278, 268]):
+        out += engine.feed({"kv_blocks_free": free}, float(t))
+    assert len(out) == 1 and out[0]["state"] == "firing"
+    assert out[0]["rule"] == "block_exhaustion"
+    assert out[0]["projected_dry_s"] == pytest.approx(27.8, abs=0.1)
+    # Pool refills (retirements freed blocks): slope flips, alert clears.
+    out2 = engine.feed({"kv_blocks_free": 400}, 6.0)
+    assert [r["state"] for r in out2] == ["cleared"]
+    # Already-dry pool fires immediately, no trend needed.
+    engine2 = AlertEngine([BlockExhaustionRule(window=4)])
+    out3 = engine2.feed({"kv_blocks_free": 0}, 0.0)
+    assert out3 and out3[0]["projected_dry_s"] == 0.0
+
+
+def test_accept_collapse_and_compile_storm_rules():
+    engine = AlertEngine(
+        [
+            AcceptRateCollapseRule(threshold=0.4, min_proposed=50),
+            CompileStormRule(window=3, min_compiles=4),
+        ]
+    )
+    # Too few proposals: rate 0.1 must NOT fire yet (cold-start guard).
+    assert engine.feed(
+        {"spec_accept_rate": 0.1, "spec_proposed": 10, "compile_events": 3},
+        0.0,
+    ) == []
+    out = engine.feed(
+        {"spec_accept_rate": 0.1, "spec_proposed": 100, "compile_events": 3},
+        1.0,
+    )
+    assert [r["rule"] for r in out] == ["accept_rate_collapse"]
+    # Compile counter jumps 5 inside the window: storm fires; recovery of
+    # the accept rate clears the collapse in the same feed.
+    out2 = engine.feed(
+        {"spec_accept_rate": 0.8, "spec_proposed": 200, "compile_events": 8},
+        2.0,
+    )
+    states = {r["rule"]: r["state"] for r in out2}
+    assert states == {
+        "accept_rate_collapse": "cleared", "compile_storm": "firing",
+    }
+
+
+def test_replica_flap_rule_counts_transitions_in_window():
+    engine = AlertEngine([ReplicaFlapRule(window_s=100.0, max_transitions=3)])
+    a_states = [True, False, True, False, True]  # 4 transitions: flapping
+    out = []
+    for t, up in enumerate(a_states):
+        out += engine.feed(
+            {"replica_online": {"http://a": up, "http://b": True}}, float(t)
+        )
+    assert len(out) == 1 and out[0]["state"] == "firing"
+    assert out[0]["replica"] == "http://a" and out[0]["transitions"] >= 3
+    # Edges age out of the window: the alert clears.
+    out2 = engine.feed(
+        {"replica_online": {"http://a": True, "http://b": True}}, 500.0
+    )
+    assert [r["state"] for r in out2] == ["cleared"]
+
+
+def test_alert_engine_missing_data_keeps_state():
+    """A sample with no evidence for a rule (dense replica without kv
+    gauges) must neither fire nor clear it."""
+    engine = AlertEngine([BlockExhaustionRule(window=3, horizon_s=1e9)])
+    assert engine.feed({"kv_blocks_free": 100}, 0.0) == []
+    assert engine.feed({"kv_blocks_free": 75}, 1.0) == []
+    out = engine.feed({"kv_blocks_free": 50}, 2.0)
+    assert [r["state"] for r in out] == ["firing"]
+    # Evidence-free samples: the alert stays active.
+    assert engine.feed({"queue_depth": 0}, 3.0) == []
+    assert [a["rule"] for a in engine.active()] == ["block_exhaustion"]
+
+
+def test_induced_queue_growth_and_block_exhaustion_incident():
+    """ACCEPTANCE (watchdog): one incident trace — demand outruns the
+    fleet (queue ramps) while the block pool drains — fires BOTH rules,
+    and the recovery (queue drains, blocks freed) clears both."""
+    engine = AlertEngine(default_fleet_rules())
+    samples = [
+        # t, queue, blocks_free  (64-block pool draining ~8/s)
+        (0, 0, 60), (1, 2, 52), (2, 5, 44), (3, 9, 36), (4, 14, 28),
+        # recovery: retirements free blocks, queue drains
+        (5, 6, 50), (6, 1, 60), (7, 0, 62),
+    ]
+    log = []
+    for t, queue, free in samples:
+        log += engine.feed(
+            {
+                "queue_depth": queue,
+                "kv_blocks_free": free,
+                "kv_blocks_total": 64,
+            },
+            float(t),
+        )
+    fired = [r["rule"] for r in log if r["state"] == "firing"]
+    cleared = [r["rule"] for r in log if r["state"] == "cleared"]
+    assert set(fired) == {"queue_growth", "block_exhaustion"}
+    assert set(cleared) == {"queue_growth", "block_exhaustion"}
+    assert engine.active() == []
+    for record in log:
+        assert validate_record(record) == [], record
+
+
+# ------------------------------------------------------------------- slo
+
+
+def _fleet_record(t, ok, failed, hist=None, **extra):
+    record = {
+        "kind": "fleet", "t": float(t), "replicas_total": 2,
+        "replicas_online": 2, "requests_ok": ok, "requests_failed": failed,
+    }
+    if hist is not None:
+        record["hist_total"] = hist
+    record.update(extra)
+    return record
+
+
+def test_slo_availability_burn_rate_window_delta():
+    """Burn = (1-sli)/(1-target) over the WINDOW's counter delta, not the
+    cumulative totals: early clean traffic must not dilute a fresh
+    incident inside a short window."""
+    records = [_fleet_record(t, 100 * (t + 1), 0) for t in range(5)]
+    # Incident: 50 ok / 50 failed between t=4 and t=6.
+    records.append(_fleet_record(6.0, 550, 50))
+    objective = SLObjective(name="availability", target=0.99)
+    short, long_w = evaluate(
+        records, objectives=(objective,), windows_s=(3.0, 100.0)
+    )
+    assert short["window_s"] == 3.0
+    assert short["good"] == 150 and short["total"] == 200
+    assert short["burn_rate"] == pytest.approx((50 / 200) / 0.01)
+    assert long_w["good"] == 550 and long_w["total"] == 600
+    assert long_w["burn_rate"] == pytest.approx((50 / 600) / 0.01, rel=1e-3)
+    for row in (short, long_w):
+        assert validate_record(row) == [], row
+
+
+def test_slo_latency_objective_counts_from_histogram():
+    hist0 = [[0.5, 90], [2.5, 100], [None, 100]]
+    hist1 = [[0.5, 91], [2.5, 200], [None, 220]]
+    records = [
+        _fleet_record(0.0, 0, 0, hist=hist0),
+        _fleet_record(10.0, 0, 0, hist=hist1),
+    ]
+    objective = SLObjective(
+        name="lat", target=0.9, phase="total", threshold_s=0.5
+    )
+    (row,) = evaluate(records, objectives=(objective,), windows_s=(5.0,))
+    # Window covers only the second record: delta good=1, total=120.
+    assert row["good"] == 1 and row["total"] == 120
+    assert row["sli"] == pytest.approx(1 / 120, abs=1e-6)
+    assert row["threshold_s"] == 0.5
+    # Off-edge thresholds round DOWN (strict): a 0.7s objective judges
+    # from the 0.5 bucket — a 0.6s request cannot be PROVEN good from
+    # the histogram, so it counts bad; the SLI is only ever understated.
+    objective2 = SLObjective(
+        name="lat2", target=0.9, phase="total", threshold_s=0.7
+    )
+    (row2,) = evaluate(records, objectives=(objective2,), windows_s=(100.0,))
+    assert row2["good"] == 91 and row2["total"] == 220
+
+
+def test_slo_tolerates_counter_dips_from_replica_dropout():
+    """A merged cumulative counter DIPS when a replica dies or restarts
+    mid-window — exactly the incident an SLO must measure.  Window counts
+    are per-step clamped increase sums (Prometheus increase() form), so
+    the surviving replica's traffic still scores instead of the window
+    reading 'no traffic' off a negative raw delta."""
+    objective = SLObjective(name="availability", target=0.99)
+    records = [
+        _fleet_record(0.0, 100, 0),
+        _fleet_record(1.0, 200, 0),
+        # Replica carrying half the history dies: merged counters dip.
+        _fleet_record(2.0, 110, 5),
+        # Survivor keeps serving (5 more failures land during failover).
+        _fleet_record(3.0, 150, 10),
+    ]
+    (row,) = evaluate(records, objectives=(objective,), windows_s=(2.5,))
+    # Steps inside the window: (100,100)->(200,200) = +100/+100; the dip
+    # to (110,115) clamps to 0/0; (110,115)->(150,160) = +40/+45.
+    assert row["good"] == 140 and row["total"] == 145
+    assert row["burn_rate"] == pytest.approx((5 / 145) / 0.01, rel=1e-3)
+
+
+def test_fleet_keeps_offline_replicas_last_histograms():
+    """The requests a dead replica already served HAPPENED: its last-known
+    cumulative buckets stay in the merge, so the fleet latency counters
+    never dip on a replica death (the SLO clamp is the backstop for real
+    counter RESETS, not the primary path)."""
+    a = _FakeServeReplica(
+        hist_total=[[0.5, 10], [None, 10]],
+    )
+    b = _FakeServeReplica(
+        hist_total=[[0.5, 7], [None, 7]],
+    )
+    fleet = FleetAggregator([a.url, b.url], poll_timeout_s=1.0)
+    try:
+        first = fleet.poll_once()
+        assert first["hist_total"] == [[0.5, 17], [None, 17]]
+        a.close()
+        b.state["hist_total"] = [[0.5, 9], [None, 9]]
+        second = fleet.poll_once()
+        assert second["replicas_online"] == 1
+        # A's 10 served requests survive its death in the merge.
+        assert second["hist_total"] == [[0.5, 19], [None, 19]]
+    finally:
+        b.close()
+        try:
+            a.close()
+        except Exception:  # noqa: BLE001 — already closed above
+            pass
+
+
+def test_slo_no_traffic_reports_null_burn():
+    records = [_fleet_record(t, 100, 0) for t in range(3)]
+    (row,) = evaluate(
+        records,
+        objectives=(SLObjective(name="availability", target=0.99),),
+        windows_s=(1.5,),
+    )
+    assert row["total"] == 0 and row["burn_rate"] is None
+    assert validate_record(row) == []
+
+
+def test_objectives_from_json_validates():
+    parsed = objectives_from_json(
+        '[{"name": "availability", "target": 0.999},'
+        ' {"name": "p99", "target": 0.99, "phase": "total",'
+        ' "threshold_s": 2.5}]'
+    )
+    assert [o.name for o in parsed] == ["availability", "p99"]
+    with pytest.raises(ValueError, match="not valid JSON"):
+        objectives_from_json("{")
+    with pytest.raises(ValueError, match="non-empty JSON list"):
+        objectives_from_json("[]")
+    with pytest.raises(ValueError, match="unknown keys"):
+        objectives_from_json('[{"name": "x", "target": 0.9, "oops": 1}]')
+    with pytest.raises(ValueError, match="come together"):
+        objectives_from_json('[{"name": "x", "target": 0.9, "phase": "total"}]')
+    with pytest.raises(ValueError, match="target must be in"):
+        objectives_from_json('[{"name": "x", "target": 2}]')
+
+
+def test_histogram_merge_and_quantile():
+    merged = merge_histograms(
+        [
+            [[0.5, 10], [2.5, 20], [None, 20]],
+            [[0.5, 5], [2.5, 5], [None, 6]],
+        ]
+    )
+    assert merged == [[0.5, 15], [2.5, 25], [None, 26]]
+    assert hist_quantile(merged, 0.5) == 0.5
+    assert hist_quantile(merged, 0.99) == 2.5
+    assert hist_quantile([], 0.5) is None
+    text = (
+        'bpe_tpu_request_phase_seconds_bucket{phase="total",le="0.5"} 3\n'
+        'bpe_tpu_request_phase_seconds_bucket{phase="total",le="+Inf"} 4\n'
+        'bpe_tpu_request_phase_seconds_bucket{phase="ttfb",le="0.25"} 4\n'
+        "bpe_tpu_other_metric 7\n"
+    )
+    hists = parse_phase_histograms(text)
+    assert hists["total"] == [[0.5, 3], [None, 4]]
+    assert hists["ttfb"] == [[0.25, 4]]
+
+
+# --------------------------------------------------- aggregator (canned)
+
+
+class _FakeServeReplica:
+    """A canned replica: /statusz JSON + /metrics exposition, mutable
+    between sweeps so the aggregator's rate/trend logic is testable."""
+
+    def __init__(self, *, slots=2, queue=0, active=0, kv_free=None,
+                 kv_total=None, draining=False, tokens=0.0,
+                 hist_total=None, hist_ttfb=None, alerts=None):
+        self.state = {
+            "slots": slots, "queue": queue, "active": active,
+            "kv_free": kv_free, "kv_total": kv_total,
+            "draining": draining, "tokens": tokens,
+            "hist_total": hist_total or [], "hist_ttfb": hist_ttfb or [],
+            "alerts": alerts or [],
+        }
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                state = outer.state
+                if self.path == "/statusz":
+                    page = {
+                        "worker_alive": True,
+                        "draining": state["draining"],
+                        "engine_kind": "paged",
+                        "queue_depth": state["queue"],
+                        "slots": state["slots"],
+                        "active_slots": state["active"],
+                        "requests_finished": 5,
+                        "alerts": state["alerts"],
+                    }
+                    if state["kv_total"] is not None:
+                        page["kvpool"] = {
+                            "kv_blocks_free": state["kv_free"],
+                            "kv_blocks_total": state["kv_total"],
+                        }
+                    body = json.dumps(page).encode()
+                    ctype = "application/json"
+                elif self.path == "/metrics":
+                    lines = [
+                        f"bpe_tpu_tokens_generated_total {state['tokens']}",
+                        "bpe_tpu_compile_events_total 7",
+                    ]
+                    for phase, hist in (
+                        ("total", state["hist_total"]),
+                        ("ttfb", state["hist_ttfb"]),
+                    ):
+                        for le, count in hist:
+                            le_text = "+Inf" if le is None else f"{le:g}"
+                            lines.append(
+                                "bpe_tpu_request_phase_seconds_bucket"
+                                f'{{phase="{phase}",le="{le_text}"}} {count}'
+                            )
+                    body = "\n".join(lines).encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    body, ctype = b"{}", "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.thread.join(timeout=10)
+
+
+class _FakeRouter:
+    def __init__(self, routed=100, failed=0):
+        self.routed, self.failed = routed, failed
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                body = json.dumps(
+                    {
+                        "requests_routed": outer.routed,
+                        "requests_failed": outer.failed,
+                        "requests_retried": 0,
+                    }
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.thread.join(timeout=10)
+
+
+def test_fleet_sweep_merges_replicas_router_and_histograms():
+    """ACCEPTANCE (aggregator): one sweep folds statusz occupancy,
+    /metrics counters, worst-replica KV headroom, router availability,
+    and EXACTLY-merged latency histograms into one schema-valid
+    kind=fleet record; a second sweep derives token rates from the
+    cumulative counters."""
+    a = _FakeServeReplica(
+        slots=2, queue=1, active=2, kv_free=8, kv_total=32, tokens=100,
+        hist_total=[[0.5, 10], [2.5, 10], [None, 10]],
+        hist_ttfb=[[0.25, 10], [None, 10]],
+    )
+    b = _FakeServeReplica(
+        slots=2, queue=0, active=1, kv_free=24, kv_total=32, tokens=50,
+        draining=True,
+        hist_total=[[0.5, 5], [2.5, 9], [None, 10]],
+        hist_ttfb=[[0.25, 2], [None, 10]],
+        alerts=[{"rule": "queue_growth"}],
+    )
+    router = _FakeRouter(routed=99, failed=1)
+    try:
+        fleet = FleetAggregator(
+            [a.url, b.url], router_url=router.url, poll_timeout_s=5.0
+        )
+        record = fleet.poll_once()
+        assert validate_record(record) == [], record
+        assert record["replicas_total"] == 2
+        assert record["replicas_online"] == 2
+        assert record["replicas_draining"] == 1
+        assert record["queue_depth"] == 1 and record["active_slots"] == 3
+        assert record["kv_blocks_free"] == 32
+        assert record["kv_headroom_frac"] == pytest.approx(8 / 32)
+        assert record["requests_ok"] == 99 and record["requests_failed"] == 1
+        assert record["availability"] == pytest.approx(0.99)
+        assert record["hist_total"] == [[0.5, 15], [2.5, 19], [None, 20]]
+        # Merged p99: rank 20 of 20 -> the 2.5 bucket; per-replica p99s
+        # averaged would have said 0.5 and 2.5 — the merge is the truth.
+        assert record["request_p99_s"] == 2.5
+        assert record["ttfb_p99_s"] == 0.25
+        by_url = {r["url"]: r for r in record["per_replica"]}
+        assert by_url[b.url]["alerts_firing"] == 1
+        assert record["tokens_per_sec"] is None  # no previous sweep yet
+
+        a.state["tokens"] = 300.0
+        b.state["tokens"] = 150.0
+        time.sleep(0.05)
+        record2 = fleet.poll_once()
+        assert record2["tokens_per_sec"] is not None
+        assert record2["tokens_per_sec"] > 0
+        by_url2 = {r["url"]: r for r in record2["per_replica"]}
+        assert by_url2[a.url]["tokens_per_sec"] > by_url2[b.url][
+            "tokens_per_sec"
+        ]
+    finally:
+        a.close()
+        b.close()
+        router.close()
+
+
+def test_fleet_dead_host_marks_offline_without_stalling():
+    """PR-8 poller discipline: a dead replica costs ONE poll timeout and
+    is reported offline; the live replica's data still lands."""
+    live = _FakeServeReplica(slots=2, active=1)
+    try:
+        fleet = FleetAggregator(
+            [live.url, "http://127.0.0.1:9"], poll_timeout_s=1.0
+        )
+        t0 = time.monotonic()
+        record = fleet.poll_once()
+        assert time.monotonic() - t0 < 5.0
+        assert record["replicas_online"] == 1
+        dead = next(
+            r for r in record["per_replica"]
+            if r["url"] == "http://127.0.0.1:9"
+        )
+        assert not dead["online"] and "poll failed" in dead["error"]
+    finally:
+        live.close()
+
+
+def test_fleet_emits_slo_and_alert_records_through_telemetry():
+    """Sweeps write kind=fleet + kind=slo rows each poll, and the fleet
+    alert rules (here: queue growth across sweeps) fire/clear through the
+    same stream — every record schema-valid."""
+    replica = _FakeServeReplica(slots=2, queue=0)
+    router = _FakeRouter(routed=10, failed=0)
+    records = []
+
+    class _Sink:
+        def emit(self, record):
+            records.append(record)
+
+    try:
+        fleet = FleetAggregator(
+            [replica.url],
+            router_url=router.url,
+            telemetry=_Sink(),
+            alert_rules=[QueueGrowthRule(window=2, min_depth=2)],
+            slo_windows_s=(60.0,),
+        )
+        for queue in (0, 2, 5, 0):
+            replica.state["queue"] = queue
+            fleet.poll_once()
+        kinds = [r.get("kind") for r in records]
+        assert kinds.count("fleet") == 4
+        assert kinds.count("slo") == 4 * len(DEFAULT_OBJECTIVES)
+        alert_states = [
+            r["state"] for r in records if r.get("kind") == "alert"
+        ]
+        assert alert_states == ["firing", "cleared"]
+        for record in records:
+            assert validate_record(record) == [], record
+        # The availability objective saw router counters: sli == 1.0.
+        avail = [
+            r for r in records
+            if r.get("kind") == "slo" and r["objective"] == "availability"
+        ]
+        assert avail[-1]["sli"] == 1.0 and avail[-1]["burn_rate"] == 0.0
+        # statusz mirrors the stream.
+        page = fleet.statusz()
+        assert page["fleet"]["replicas_online"] == 1
+        assert page["alerts"] == []  # cleared by the last sweep
+        assert len(page["slo"]) == len(DEFAULT_OBJECTIVES)
+    finally:
+        replica.close()
+        router.close()
+
+
+def test_fleet_http_surface_statusz_and_metrics():
+    replica = _FakeServeReplica(slots=2, active=1, kv_free=4, kv_total=32)
+    try:
+        fleet = FleetAggregator([replica.url])
+        fleet.poll_once()
+        server = make_fleet_http_server(fleet, port=0)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            page = json.loads(
+                urllib.request.urlopen(f"{base}/statusz", timeout=30).read()
+            )
+            assert page["fleet"]["replicas_online"] == 1
+            assert page["replicas"][0]["url"] == replica.url
+            health = json.loads(
+                urllib.request.urlopen(f"{base}/healthz", timeout=30).read()
+            )
+            assert health["ok"]
+            prom = urllib.request.urlopen(
+                f"{base}/metrics", timeout=30
+            ).read().decode()
+            assert "bpe_tpu_fleet_replicas_online 1" in prom
+            assert "bpe_tpu_fleet_kv_headroom_frac 0.125" in prom
+            assert 'bpe_tpu_fleet_replica_online{replica="' in prom
+            assert "bpe_tpu_fleet_slo_burn_rate" in prom
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
+    finally:
+        replica.close()
+
+
+def test_fleet_and_monitor_jax_free():
+    """ACCEPTANCE: the fleet/slo/alert/monitor paths import and run with
+    jax made unimportable — pinned like the router and monitor."""
+    script = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "from bpe_transformer_tpu.telemetry.fleet import FleetAggregator\n"
+        "from bpe_transformer_tpu.telemetry.slo import evaluate\n"
+        "from bpe_transformer_tpu.telemetry.alerts import AlertEngine, "
+        "default_serving_rules, default_fleet_rules\n"
+        "from bpe_transformer_tpu.telemetry.monitor import FleetSource, "
+        "fold_records, render_frame\n"
+        "from bpe_transformer_tpu.telemetry.trace import request_timeline\n"
+        "fleet = FleetAggregator(['http://127.0.0.1:9'], "
+        "poll_timeout_s=0.5)\n"
+        "record = fleet.poll_once()\n"
+        "assert record['replicas_online'] == 0\n"
+        "assert fleet.statusz()['fleet']['replicas_total'] == 1\n"
+        "assert 'bpe_tpu_fleet_replicas_online 0' in "
+        "fleet.prometheus_metrics()\n"
+        "state = fold_records([record])\n"
+        "assert state['fleet_replicas_total'] == 1\n"
+        "render_frame(state, 'test')\n"
+        "print('ok')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": str(REPO)},
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.strip() == "ok"
+
+
+def test_fleet_cli_once_mode():
+    """`bpe-tpu fleet --once`: one sweep, the record on stdout, exit 0 —
+    scriptable like monitor --once (and jax-free through the real CLI)."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "bpe_transformer_tpu.training.cli",
+            "fleet", "--replica", "http://127.0.0.1:9",
+            "--poll-timeout", "0.5", "--once",
+        ],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"},
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["kind"] == "fleet" and record["replicas_online"] == 0
+
+
+def test_fleet_cli_rejects_bad_slo_config():
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "bpe_transformer_tpu.training.cli",
+            "fleet", "--replica", "http://127.0.0.1:9",
+            "--slo-config", '[{"name": "x"}]', "--once",
+        ],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": str(REPO), "JAX_PLATFORMS": "cpu"},
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 2
+    assert "bad --slo-config" in proc.stderr
+
+
+# ------------------------------------------------- report/monitor pins
+
+
+def test_report_fleet_fixture_sections_pinned():
+    from bpe_transformer_tpu.telemetry.report import (
+        load_records,
+        render_report,
+        summarize,
+    )
+
+    records = load_records(FIXTURE)
+    summary = summarize(records)
+    assert summary["fleet"]["n"] == 3
+    assert summary["fleet"]["replicas_total"] == 2
+    assert summary["fleet"]["kv_headroom_frac"]["min"] == pytest.approx(
+        0.3125
+    )
+    assert summary["slo"]["max_burn_rate"] == 40.0
+    assert summary["alerts"]["fired"] == 2
+    assert summary["alerts"]["firing_at_end"] == ["block_exhaustion"]
+    text = render_report(records)
+    assert "== fleet (3 sweeps) ==" in text
+    assert "== slo (5 evaluations) ==" in text
+    assert "BURNING ERROR BUDGET" in text
+    assert "== alerts (2 fired, 1 still firing) ==" in text
+    assert "alert queue_growth fired" in text
+    assert "alerts still firing at stream end: block_exhaustion" in text
+
+
+def test_report_baseline_gates_slo_burn_regression(capsys):
+    """ACCEPTANCE: `report --baseline` exits 3 when the stream's worst
+    SLO burn rate regresses past the pinned capture baseline — a serving
+    SLO regression fails CI exactly like a throughput regression."""
+    from bpe_transformer_tpu.telemetry.report import main as report_main
+
+    rc = report_main([str(FIXTURE), "--baseline", str(BASELINE)])
+    out = capsys.readouterr().out
+    assert rc == 3
+    assert "slo_max_burn_rate" in out and "regressed" in out
+    assert "fleet_request_p99_s" in out
+
+
+def test_report_slo_flag_graceful_without_fleet_records(capsys, tmp_path):
+    """Satellite: --slo on a stream with no fleet/slo records prints a
+    notice and exits 0 (PR-3 graceful-empty precedent), and on a
+    fleet-records-only stream evaluates the default objectives."""
+    from bpe_transformer_tpu.telemetry.report import (
+        load_records,
+        main as report_main,
+    )
+
+    rc = report_main(
+        [str(REPO / "tests" / "fixtures" / "telemetry_tiny.jsonl"), "--slo"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no fleet/slo records in this stream" in out
+
+    # Fleet records only (slo rows stripped): --slo evaluates on demand.
+    fleet_only = tmp_path / "fleet_only.jsonl"
+    with open(fleet_only, "w") as f:
+        for record in load_records(FIXTURE):
+            if record.get("kind") in ("fleet", "manifest"):
+                f.write(json.dumps(record) + "\n")
+    rc = report_main([str(fleet_only), "--slo"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "== slo (" in out and "availability" in out
+
+
+def test_monitor_folds_fleet_slo_alert_records():
+    from bpe_transformer_tpu.telemetry.monitor import (
+        fold_records,
+        render_frame,
+    )
+    from bpe_transformer_tpu.telemetry.report import load_records
+
+    state = fold_records(load_records(FIXTURE))
+    assert state["fleet_replicas_online"] == 1
+    assert state["fleet_replicas_total"] == 2
+    assert state["slo_max_burn"] == 40.0
+    # queue_growth cleared; block_exhaustion still firing.
+    assert state["alerts_firing"] == ["block_exhaustion"]
+    frame = render_frame(state, "fixture")
+    assert "fleet  replicas 1/2" in frame
+    assert "burn 40" in frame
+    assert "FIRING: block_exhaustion" in frame
+
+
+def test_monitor_fleet_source_polls_aggregator_statusz():
+    replica = _FakeServeReplica(slots=2, active=1, kv_free=16, kv_total=32)
+    try:
+        fleet = FleetAggregator([replica.url], slo_windows_s=(60.0,))
+        fleet.poll_once()
+        server = make_fleet_http_server(fleet, port=0)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            from bpe_transformer_tpu.telemetry.monitor import (
+                FleetSource,
+                render_frame,
+            )
+
+            source = FleetSource(f"127.0.0.1:{port}")
+            state = source.refresh()
+            assert state["fleet_replicas_online"] == 1
+            assert state["fleet_kv_headroom_frac"] == 0.5
+            frame = render_frame(state, source.label)
+            assert "fleet  replicas 1/1" in frame
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
+    finally:
+        replica.close()
+
+
+# ------------------------------------------------------ request tracing
+
+
+def _span(path, t, dur, rid, wall, **attrs):
+    return {
+        "kind": "span", "name": path.split("/")[-1], "path": path,
+        "t": t, "dur_s": dur, "request_id": rid, "time_unix": wall,
+        **attrs,
+    }
+
+
+def test_request_timeline_joins_router_and_replica_streams():
+    """ACCEPTANCE (tracing, stream shape): one trace_id assembles the
+    router's hop spans and the replica's phase spans — from two streams
+    with DIFFERENT t epochs — into one wall-clock-ordered timeline, the
+    failover case showing both attempted hops."""
+    rid = "trace-e2e-1"
+    wall = 1_785_758_000.0
+    router_stream = [
+        {"kind": "manifest", "run_kind": "route", "time_utc": "x",
+         "host": "front"},
+        _span("router/pick", 5.0, 0.001, rid, wall, n_available=2),
+        _span("router/hop", 5.002, 0.02, rid, wall + 0.002,
+              replica="http://a", hop=0, outcome="connect_failed"),
+        _span("router/hop", 5.03, 0.4, rid, wall + 0.03,
+              replica="http://b", hop=1, outcome="ok", ttfb_s=0.39),
+        _span("router/request", 5.0, 0.45, rid, wall, status=200, hops=2),
+        _span("router/hop", 9.0, 0.1, "other-trace", wall + 9.0,
+              replica="http://b", hop=0, outcome="ok"),
+    ]
+    # The replica's own epoch started much earlier: its t values are
+    # large, but time_unix places its spans inside the router's hop.
+    replica_stream = [
+        _span("serve/queue_wait", 100.0, 0.01, rid, wall + 0.04),
+        _span("serve/prefill", 100.01, 0.05, rid, wall + 0.05),
+        _span("serve/decode", 100.06, 0.3, rid, wall + 0.1),
+    ]
+    from bpe_transformer_tpu.telemetry.trace import (
+        request_timeline,
+        trace_events,
+    )
+
+    rows = request_timeline([router_stream, replica_stream], rid)
+    assert [r["path"] for r in rows] == [
+        "router/pick", "router/request", "router/hop", "router/hop",
+        "serve/queue_wait", "serve/prefill", "serve/decode",
+    ]
+    hops = [r for r in rows if r["path"] == "router/hop"]
+    assert [h["outcome"] for h in hops] == ["connect_failed", "ok"]
+    assert all(r["stream"] == 0 for r in rows[:4])
+    assert all(r["stream"] == 1 for r in rows[4:])
+    assert rows[0]["t_rel"] == 0.0
+    rels = [r["t_rel"] for r in rows]
+    assert rels == sorted(rels)
+    # Other requests never leak into the timeline.
+    assert all(r["request_id"] == rid for r in rows)
+
+    # Chrome export: router spans with a request_id land in the same
+    # request/<id> lane the serve spans use.
+    events = trace_events(router_stream + replica_stream)
+    lanes = {
+        e["args"]["name"]: e["tid"]
+        for e in events
+        if e.get("name") == "thread_name"
+    }
+    assert f"request/{rid}" in lanes
+    lane = lanes[f"request/{rid}"]
+    in_lane = [
+        e for e in events if e.get("ph") == "X" and e["tid"] == lane
+    ]
+    assert len(in_lane) == 7
+
+
+# ------------------------------------------------------ tier-1 budget
+
+
+def test_tier1_budget_collect_gate():
+    """Satellite: the PR-11 budget guard GATES commits — tier-1 runs it
+    in --collect mode, so a pile of unmarked heavy tests fails here
+    before the driver's 870s kill ever fires."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "tools" / "check_tier1_budget.py"),
+            "--collect",
+        ],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, (
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    )
+    assert "within ceiling" in proc.stdout
+
+
+# ------------------------------------------------------------------- e2e
+
+
+@pytest.mark.slow
+@pytest.mark.serving
+def test_fleet_observability_e2e_two_paged_replicas(tmp_path):
+    """ACCEPTANCE: two in-process paged replicas behind the REAL router,
+    each narrating its own JSONL — one trace_id assembles the full
+    router -> replica -> engine timeline across the streams (the
+    failover case shows BOTH attempted hops), and the fleet aggregator
+    folds the live fleet (one replica down) into schema-valid records."""
+    import dataclasses
+
+    import jax
+
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, init_params
+    from bpe_transformer_tpu.serving import ServingEngine, make_http_server
+    from bpe_transformer_tpu.serving.router import (
+        Router,
+        make_router_http_server,
+    )
+    from bpe_transformer_tpu.telemetry import MetricsLogger, Telemetry
+    from bpe_transformer_tpu.telemetry.report import load_records
+    from bpe_transformer_tpu.telemetry.trace import request_timeline
+
+    cfg = dataclasses.replace(
+        TS_TEST_CONFIG, vocab_size=128, context_length=32
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    def start_replica(name):
+        logger = MetricsLogger(jsonl_path=tmp_path / f"{name}.jsonl")
+        telemetry = Telemetry(sink=logger.log)
+        serving = ServingEngine(
+            params, cfg, slots=2, min_bucket=8, paged=True, block_size=8,
+            telemetry=telemetry, engine_record_every_s=0.2,
+        )
+        serving.start()
+        server = make_http_server(serving, port=0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return {
+            "serving": serving, "server": server, "thread": thread,
+            "logger": logger, "port": server.server_address[1],
+            "stream": tmp_path / f"{name}.jsonl",
+        }
+
+    a = start_replica("replica_a")
+    b = start_replica("replica_b")
+    url_a = f"http://127.0.0.1:{a['port']}"
+    url_b = f"http://127.0.0.1:{b['port']}"
+    router_stream = tmp_path / "router.jsonl"
+    router_logger = MetricsLogger(jsonl_path=router_stream)
+    router = Router(
+        [url_a, url_b], poll_interval_s=0.2,
+        telemetry=Telemetry(sink=router_logger.log),
+    ).start()
+    rserver = make_router_http_server(router, port=0)
+    rthread = threading.Thread(target=rserver.serve_forever, daemon=True)
+    rthread.start()
+    rport = rserver.server_address[1]
+
+    try:
+        # Happy path through the real HTTP front: client-supplied trace
+        # id, echoed end to end.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rport}/generate",
+            data=json.dumps(
+                {"prompt_ids": [3, 5, 7, 9], "max_new_tokens": 4,
+                 "temperature": 0.0}
+            ).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "e2e-happy"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["X-Request-Id"] == "e2e-happy"
+            out = json.loads(resp.read())
+        assert out["request_id"] == "e2e-happy"
+        served_url = out["replica"]
+
+        # Failover: kill replica A's HTTP front (engine still alive —
+        # a network death, the router's connect-failure path), stop the
+        # poller, and force A first in weight order so the request MUST
+        # burn a hop on it before winning on B.
+        router.close()  # deterministic: no poll races the assertion
+        a["server"].shutdown()
+        a["server"].server_close()
+        a["thread"].join(timeout=10)
+        state = {r.url: r for r in router.replicas}
+        for r in router.replicas:
+            r.healthy, r.draining = True, False
+        state[url_a].slots, state[url_a].active_slots = 8, 0
+        state[url_b].slots, state[url_b].active_slots = 1, 0
+        code, payload = router.handle_generate(
+            json.dumps(
+                {"prompt_ids": [2, 4, 6], "max_new_tokens": 3,
+                 "temperature": 0.0}
+            ).encode(),
+            trace_id="e2e-failover",
+        )
+        assert code == 200 and payload["replica"] == url_b
+        assert payload["request_id"] == "e2e-failover"
+
+        # Cross-stream assembly: one trace_id stitches the router's
+        # hops and the replica's engine-phase spans into one timeline.
+        streams = [
+            load_records(router_stream),
+            load_records(a["stream"]),
+            load_records(b["stream"]),
+        ]
+        rows = request_timeline(streams, "e2e-failover")
+        hops = [r for r in rows if r["path"] == "router/hop"]
+        assert [h["outcome"] for h in hops] == ["connect_failed", "ok"]
+        assert [h["replica"] for h in hops] == [url_a, url_b]
+        serve_paths = [
+            r["path"] for r in rows if r["path"].startswith("serve/")
+        ]
+        assert serve_paths == [
+            "serve/queue_wait", "serve/prefill", "serve/decode"
+        ]
+        assert all(r["stream"] == 2 for r in rows
+                   if r["path"].startswith("serve/"))
+        rels = [r["t_rel"] for r in rows if r["t_rel"] is not None]
+        assert rels == sorted(rels) and rels[0] == 0.0
+        # The happy request traces too (single ok hop on its replica).
+        happy = request_timeline(streams, "e2e-happy")
+        happy_hops = [r for r in happy if r["path"] == "router/hop"]
+        assert [h["outcome"] for h in happy_hops] == ["ok"]
+        assert happy_hops[0]["replica"] == served_url
+        assert any(r["path"] == "serve/decode" for r in happy)
+
+        # Fleet aggregator over the live fleet: A's front is dead, B is
+        # serving — the sweep marks one online, merges B's histograms
+        # (the ttfb/total evidence the requests above produced), and
+        # every emitted record validates.
+        fleet_records = []
+
+        class _Sink:
+            def emit(self, record):
+                fleet_records.append(record)
+
+        fleet = FleetAggregator(
+            [url_a, url_b], poll_timeout_s=2.0, telemetry=_Sink(),
+            slo_windows_s=(60.0,),
+        )
+        record = fleet.poll_once()
+        assert record["replicas_online"] == 1
+        assert record["hist_total"] and record["hist_ttfb"]
+        assert record["request_p99_s"] is not None
+        by_url = {r["url"]: r for r in record["per_replica"]}
+        assert not by_url[url_a]["online"]
+        assert by_url[url_b]["engine_kind"] == "paged"
+        assert by_url[url_b]["kv_blocks_total"] > 0
+        for emitted in fleet_records:
+            assert validate_record(emitted) == [], emitted
+    finally:
+        rserver.shutdown()
+        rserver.server_close()
+        rthread.join(timeout=10)
+        router.close()
+        b["server"].shutdown()
+        b["server"].server_close()
+        b["thread"].join(timeout=10)
+        for replica in (a, b):
+            replica["serving"].close()
+            replica["logger"].close()
+        router_logger.close()
+
+
+def test_burn_summary_keeps_windows_separate():
+    """Regression: the 5-minute burn paging while the 1-hour burn shrugs
+    is the whole point of multi-window evaluation — the digest must not
+    overwrite the short window's spike with the long window's calm."""
+    rows = [
+        {"kind": "slo", "t": 1.0, "objective": "availability",
+         "window_s": 300.0, "target": 0.999, "sli": 0.986,
+         "burn_rate": 14.0},
+        {"kind": "slo", "t": 1.0, "objective": "availability",
+         "window_s": 3600.0, "target": 0.999, "sli": 0.9996,
+         "burn_rate": 0.4},
+    ]
+    digest = burn_summary(rows)
+    short = digest["objectives"]["availability (300s)"]
+    long_w = digest["objectives"]["availability (3600s)"]
+    assert short["last_burn"] == 14.0 and short["window_s"] == 300.0
+    assert long_w["last_burn"] == 0.4 and long_w["window_s"] == 3600.0
+    assert digest["max_burn_rate"] == 14.0
+
+
+def test_report_slo_on_demand_feeds_baseline_gate(capsys, tmp_path):
+    """Regression: `--slo --baseline` on a fleet-records-only stream must
+    GATE the on-demand burn, not just print it — exit 3 when the
+    evaluated worst burn regresses past the pinned capture."""
+    from bpe_transformer_tpu.telemetry.report import (
+        load_records,
+        main as report_main,
+    )
+
+    fleet_only = tmp_path / "fleet_only.jsonl"
+    with open(fleet_only, "w") as f:
+        for record in load_records(FIXTURE):
+            if record.get("kind") in ("fleet", "manifest"):
+                f.write(json.dumps(record) + "\n")
+    rc = report_main(
+        [str(fleet_only), "--slo", "--baseline", str(BASELINE)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 3
+    assert "slo_max_burn_rate" in out and "regressed" in out

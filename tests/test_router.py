@@ -56,6 +56,7 @@ class _FakeReplica:
         self.generate_code = generate_code
         self.generate_delay_s = generate_delay_s
         self.requests_served = 0
+        self.seen_request_ids: list = []  # X-Request-Id headers received
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -76,6 +77,9 @@ class _FakeReplica:
                 return self._reply(404, {"error": "?"})
 
             def do_POST(self):
+                outer.seen_request_ids.append(
+                    self.headers.get("X-Request-Id")
+                )
                 length = int(self.headers.get("Content-Length", 0))
                 self.rfile.read(length)
                 if outer.generate_delay_s:
@@ -90,10 +94,13 @@ class _FakeReplica:
                         outer.generate_code, {"error": detail}
                     )
                 outer.requests_served += 1
+                # Like the real serve layer: adopt the forwarded trace id
+                # as the request_id (minting one when none was sent).
+                rid = self.headers.get("X-Request-Id") or "x"
                 return self._reply(
                     200,
                     {"token_ids": [1, 2], "finish_reason": "length",
-                     "request_id": "x", "timings": {}},
+                     "request_id": rid, "timings": {}},
                 )
 
         self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
@@ -227,6 +234,13 @@ def test_router_passes_client_errors_through_without_retry():
         code, _ = router.handle_generate(_body())
         assert code == 400
         assert fallback.requests_served == 0, "4xx must not be replayed"
+        # The caller's error is not a FLEET failure: it must not burn the
+        # availability SLO's budget (separate client-error counter).
+        assert router.requests_failed == 0
+        assert router.requests_client_errors == 1
+        page = router.statusz()
+        assert page["requests_client_errors"] == 1
+        assert "requests_client_errors_total 1" in router.prometheus_metrics()
     finally:
         bad.close()
         fallback.close()
@@ -504,3 +518,205 @@ def test_router_session_affinity_sticky_and_fallback():
                 replica.close()
             except Exception:  # noqa: BLE001 — a may already be closed
                 pass
+
+
+# ----------------------------------------------------- tracing (ISSUE 12)
+
+
+class _ListTelemetry:
+    """Minimal Telemetry stand-in: collects emitted records, provides the
+    now() the router's span emission reads."""
+
+    def __init__(self):
+        self.records = []
+        self._t0 = time.monotonic()
+
+    def now(self):
+        return round(time.monotonic() - self._t0, 6)
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+def test_router_trace_spans_failover_shows_both_hops():
+    """ACCEPTANCE (tracing, router side): a request that fails over
+    records one router/hop span per ATTEMPTED replica — the dead hop with
+    its failure outcome, the serving hop with connect/ttfb timings — plus
+    pick and request envelope spans, all tagged with the SAME trace id,
+    which is also forwarded to the replica as X-Request-Id."""
+    from bpe_transformer_tpu.telemetry.schema import validate_record
+
+    survivor = _FakeReplica()
+    telemetry = _ListTelemetry()
+    try:
+        router = Router(
+            [survivor.url, "http://127.0.0.1:9"], telemetry=telemetry
+        )
+        router.poll_once()
+        for r in router.replicas:  # force the dead replica first
+            r.healthy = True
+            r.slots = 4 if r.url != survivor.url else 1
+        code, payload = router.handle_generate(
+            _body(), trace_id="trace-hops-1"
+        )
+        assert code == 200 and payload["request_id"] == "trace-hops-1"
+        assert survivor.seen_request_ids == ["trace-hops-1"]
+
+        spans = [r for r in telemetry.records if r.get("kind") == "span"]
+        assert all(s["request_id"] == "trace-hops-1" for s in spans)
+        by_path: dict = {}
+        for s in spans:
+            by_path.setdefault(s["path"], []).append(s)
+        assert set(by_path) == {"router/pick", "router/hop",
+                                "router/request"}
+        hops = sorted(by_path["router/hop"], key=lambda s: s["hop"])
+        assert len(hops) == 2
+        assert hops[0]["outcome"] == "connect_failed"
+        assert hops[0]["replica"] == "http://127.0.0.1:9"
+        assert hops[1]["outcome"] == "ok" and hops[1]["status"] == 200
+        assert hops[1]["replica"] == survivor.url
+        assert hops[1]["ttfb_s"] >= 0 and hops[1]["connect_s"] >= 0
+        (request_span,) = by_path["router/request"]
+        assert request_span["hops"] == 2
+        assert request_span["replica"] == survivor.url
+        assert request_span["status"] == 200
+        # Cross-stream ordering contract: absolute start stamps present.
+        assert all(
+            isinstance(s.get("time_unix"), float) for s in spans
+        )
+        for s in spans:
+            assert validate_record(s) == [], s
+    finally:
+        survivor.close()
+
+
+def test_router_echoes_request_id_on_success_and_both_error_paths():
+    """Satellite pin: X-Request-Id comes back on EVERY router response —
+    success, the all-replicas-down 503, and the not-replayed 504 read
+    timeout — and an inbound id is honored, not replaced."""
+    # Success + inbound honor.
+    replica = _FakeReplica()
+    try:
+        router = Router([replica.url])
+        router.poll_once()
+        server = make_router_http_server(router, port=0)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate", data=_body(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "client-id-7"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.headers["X-Request-Id"] == "client-id-7"
+                payload = json.loads(resp.read())
+            assert payload["request_id"] == "client-id-7"
+            assert replica.seen_request_ids == ["client-id-7"]
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
+    finally:
+        replica.close()
+
+    # 503: no available replica.  The router MINTS an id when the client
+    # sent none, so even this failure is traceable.
+    router = Router(["http://127.0.0.1:9"])
+    router.poll_once()
+    server = make_router_http_server(router, port=0)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=_body(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as err:
+            assert err.code == 503
+            minted = err.headers["X-Request-Id"]
+            assert minted and len(minted) == 32
+            assert json.loads(err.read())["request_id"] == minted
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=10)
+
+    # 504: established-but-slow replica (not replayed) still echoes.
+    slow = _FakeReplica(generate_delay_s=0.6)
+    try:
+        router = Router([slow.url], request_timeout_s=0.2)
+        router.poll_once()
+        server = make_router_http_server(router, port=0)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate", data=_body(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "timeout-id-9"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected 504")
+            except urllib.error.HTTPError as err:
+                assert err.code == 504
+                assert err.headers["X-Request-Id"] == "timeout-id-9"
+                body = json.loads(err.read())
+                assert body["request_id"] == "timeout-id-9"
+                assert "not replayed" in body["error"]
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
+    finally:
+        slow.close()
+
+
+def test_router_metrics_jsonl_cli_writes_trace_stream(tmp_path):
+    """`bpe-tpu route --metrics-jsonl`: the router narrates its stream
+    jax-free — manifest header (host_manifest: no device probe), spans
+    per request, footer — parseable by the report loader."""
+    script = (
+        "import sys, threading, urllib.request, json\n"
+        "sys.modules['jax'] = None\n"
+        "from bpe_transformer_tpu.serving.router import main\n"
+        "import bpe_transformer_tpu.serving.router as router_mod\n"
+        "server_holder = {}\n"
+        "orig = router_mod.make_router_http_server\n"
+        "def capture(router, host='127.0.0.1', port=8100):\n"
+        "    server = orig(router, host, port)\n"
+        "    server_holder['server'] = server\n"
+        "    def stop():\n"
+        "        import time\n"
+        "        time.sleep(1.0)\n"
+        "        server.shutdown()\n"
+        "    threading.Thread(target=stop, daemon=True).start()\n"
+        "    return server\n"
+        "router_mod.make_router_http_server = capture\n"
+        "rc = main(['--replica', 'http://127.0.0.1:9', '--port', '0',\n"
+        "           '--metrics-jsonl', sys.argv[1]])\n"
+        "print('rc', rc)\n"
+    )
+    out = tmp_path / "router_metrics.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-c", script, str(out)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": str(REPO)},
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    from bpe_transformer_tpu.telemetry.report import load_records
+
+    records = load_records(out)
+    kinds = [r.get("kind") for r in records]
+    assert kinds[0] == "manifest" and kinds[-1] == "footer"
+    manifest = records[0]
+    assert manifest["run_kind"] == "route"
+    assert "devices" not in manifest  # host_manifest: no backend probe

@@ -940,3 +940,234 @@ def test_statusz_recent_requests_ring_traces_phases(setup):
     # two surfaces).
     assert first["prefill_s"] == pytest.approx(r1.prefill_s, abs=1e-6)
     json.dumps(page)  # statusz stays one JSON document
+
+
+# ------------------------------------------------ fleet tracing (ISSUE 12)
+
+
+def test_serve_http_echoes_request_id_on_success_and_error_paths(setup):
+    """Satellite pin: X-Request-Id comes back on EVERY serve response —
+    success (adopted as the request_id tagging spans/slots), 400 bad
+    input, and the draining 503 — so clients correlate failures with
+    traces."""
+    params, _ = setup
+    tokenizer = _byte_tokenizer()
+    with ServingEngine(
+        params, CFG, tokenizer=tokenizer, slots=2, min_bucket=8,
+        default_max_new_tokens=4,
+    ) as serving:
+        server = make_http_server(serving, port=0)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            req = urllib.request.Request(
+                f"{base}/generate",
+                data=json.dumps(
+                    {"prompt": "ab", "temperature": 0.0,
+                     "max_new_tokens": 3}
+                ).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "router-trace-42"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                assert resp.headers["X-Request-Id"] == "router-trace-42"
+                out = json.loads(resp.read())
+            # ADOPTED, not just echoed: the inbound id IS the request id.
+            assert out["request_id"] == "router-trace-42"
+
+            # 400 path: bad input still carries the id.
+            req = urllib.request.Request(
+                f"{base}/generate", data=json.dumps({"bogus": 1}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "bad-input-id"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as err:
+                assert err.code == 400
+                assert err.headers["X-Request-Id"] == "bad-input-id"
+                assert json.loads(err.read())["request_id"] == "bad-input-id"
+
+            # Headerless requests get a minted id (echo always holds).
+            req = urllib.request.Request(
+                f"{base}/generate", data=json.dumps({"bogus": 1}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as err:
+                assert len(err.headers["X-Request-Id"]) == 32
+
+            # 503 path: drain stops admission; the rejection is traceable.
+            assert serving.drain(timeout_s=30)
+            req = urllib.request.Request(
+                f"{base}/generate",
+                data=json.dumps({"prompt": "ab"}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "drained-id"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as err:
+                assert err.code == 503
+                assert err.headers["X-Request-Id"] == "drained-id"
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
+
+
+def test_engines_carry_request_id_and_request_level_histograms(setup):
+    """The engines adopt the request id as slot metadata (statusz slot
+    table names the occupying request), and the metrics layer grows the
+    request-level ttfb/total histograms the fleet SLO layer counts from."""
+    params, prompts = setup
+    engine = SlotPoolEngine(params, CFG, slots=2, min_bucket=8)
+    event = engine.admit(
+        prompts[0], max_new_tokens=8, request_id="rid-slot-1"
+    )
+    state = next(s for s in engine.slot_states() if s["active"])
+    assert state["request_id"] == "rid-slot-1"
+    engine.release(event.slot)
+
+    with ServingEngine(params, CFG, slots=1, min_bucket=8) as serving:
+        result = serving.generate(
+            prompts[0], max_new_tokens=3, temperature=0.0,
+            request_id="rid-gen-1",
+        )
+        assert result.request_id == "rid-gen-1"
+        stats = serving.stats()
+        # Request-level histograms observed exactly once per request.
+        assert stats["phase_p50_s"]["ttfb"] is not None
+        assert stats["phase_p50_s"]["total"] is not None
+        prom = serving.prometheus_metrics()
+        assert 'phase="ttfb"' in prom and 'phase="total"' in prom
+        assert "bpe_tpu_alerts_firing 0" in prom
+        # Duplicate in-flight ids are refused (the id keys the trace).
+        handle = serving.submit(
+            Request(prompt_ids=tuple(prompts[0]), max_new_tokens=32,
+                    request_id="dup-id")
+        )
+        with pytest.raises(ValueError, match="already in flight"):
+            serving.submit(
+                Request(prompt_ids=tuple(prompts[0]), max_new_tokens=4,
+                        request_id="dup-id")
+            )
+        handle.result(timeout=60)
+
+
+def test_serving_block_exhaustion_alert_fires_and_clears(setup):
+    """ACCEPTANCE (watchdog, engine side): a real paged engine whose
+    block pool drains across watchdog samples fires the exhaustion alert
+    — visible in statusz and as kind=alert records — and the alert
+    clears when retirements refill the pool."""
+    from bpe_transformer_tpu.telemetry.alerts import BlockExhaustionRule
+
+    params, prompts = setup
+    records = []
+
+    class _Sink:
+        def emit(self, record):
+            records.append(record)
+
+    serving = ServingEngine(
+        params, CFG, slots=4, min_bucket=8, paged=True, block_size=4,
+        num_kv_blocks=24, prefix_cache=False,
+        alert_rules=[BlockExhaustionRule(window=3, horizon_s=1e9)],
+        telemetry=_Sink(),
+    )
+    # Drive the watchdog directly (no worker): each begin() reserves the
+    # request's worst-case block chain, so admissions ARE the drain.
+    serving._feed_alerts(0.0, None)
+    slots = []
+    for t, prompt in enumerate(prompts[:2], start=1):
+        slots.append(
+            serving.engine.begin(prompt[:4], max_new_tokens=8)
+        )
+        serving._feed_alerts(float(t), None)
+    page = serving.statusz()
+    assert [a["rule"] for a in page["alerts"]] == ["block_exhaustion"]
+    assert page["alerts"][0]["projected_dry_s"] > 0
+    assert serving.stats()["alerts_firing"] == 1
+    firing = [r for r in records if r.get("kind") == "alert"]
+    assert [r["state"] for r in firing] == ["firing"]
+
+    # Retirements free the blocks: the trend flips and the alert clears.
+    for slot in slots:
+        serving.engine.release(slot)
+    serving._feed_alerts(3.0, None)
+    assert serving.statusz()["alerts"] == []
+    alert_states = [
+        r["state"] for r in records if r.get("kind") == "alert"
+    ]
+    assert alert_states == ["firing", "cleared"]
+
+
+def test_watchdog_compile_rule_fed_without_telemetry_sink(setup):
+    """Regression: the compile counter must reach the watchdog even on a
+    server run with NO --metrics-jsonl — resources are sampled on the
+    record cadence unconditionally, so a compile storm is visible in
+    /statusz alerts with no telemetry sink attached."""
+    from bpe_transformer_tpu.telemetry.alerts import CompileStormRule
+
+    params, _ = setup
+    rule = CompileStormRule(window=2, min_compiles=0)
+    serving = ServingEngine(
+        params, CFG, slots=1, min_bucket=8,
+        alert_rules=[rule], engine_record_every_s=0.0,
+    )
+    assert serving._telemetry is None
+    serving._maybe_emit_engine_record()
+    serving._maybe_emit_engine_record()
+    # min_compiles=0: any two samples fire IF compile_events reached the
+    # rule — which is the thing under test.
+    assert len(rule._hist) == 2
+    assert all(isinstance(n, int) for n in rule._hist)
+    assert [a["rule"] for a in serving._alerts.active()] == [
+        "compile_storm"
+    ]
+
+
+def test_duplicate_inflight_request_id_is_retryable_503(setup):
+    """Regression: a client retrying a router 504 keeps its echoed
+    X-Request-Id — hitting the replica still running the original must
+    503 (router fails over to a peer), never 400 (which the router would
+    pass through as the CALLER's fault without trying anyone else)."""
+    params, prompts = setup
+    with ServingEngine(params, CFG, slots=1, min_bucket=8) as serving:
+        server = make_http_server(serving, port=0)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            handle = serving.submit(
+                Request(prompt_ids=tuple(prompts[0]), max_new_tokens=24,
+                        request_id="retry-trace-1")
+            )
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps(
+                    {"prompt_ids": prompts[0], "max_new_tokens": 2}
+                ).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "retry-trace-1"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as err:
+                assert err.code == 503
+                assert err.headers["X-Request-Id"] == "retry-trace-1"
+                assert "already in flight" in json.loads(
+                    err.read()
+                )["error"]
+            handle.result(timeout=120)
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
