@@ -105,6 +105,33 @@ def _preset_moe_dispatch(config_name: str) -> str:
     sync with models/config.py."""
     return "gather" if "moe" in config_name else "einsum"
 
+
+def _preset_remat_policy(config_name: str) -> str:
+    """The preset's resolved remat policy, mirrored without importing the
+    package (replay must not initialize jax).  GPT2_MEDIUM moved from the
+    deprecated remat=True to remat_policy="save_attn" in PR 13; keep in
+    sync with models/config.py."""
+    return "save_attn" if config_name == "gpt2-medium" else "none"
+
+
+def _want_remat_policy() -> str:
+    """The remat policy this run wants: BENCH_REMAT_POLICY, the deprecated
+    BENCH_REMAT=1 (alias for full), or the preset default."""
+    policy = os.environ.get("BENCH_REMAT_POLICY")
+    if policy:
+        return policy
+    if os.environ.get("BENCH_REMAT") == "1":
+        return "full"
+    return _preset_remat_policy(ARGS.config)
+
+
+def _want_scan_layers() -> bool:
+    return os.environ.get("BENCH_SCAN_LAYERS") == "1"
+
+
+def _want_grads_dtype() -> str:
+    return os.environ.get("BENCH_GRADS_DTYPE") or "float32"
+
 ARGS = argparse.Namespace(
     config="tinystories-4l", batch=None, attention=None, flash_block=None
 )
@@ -156,10 +183,15 @@ def _capture_path() -> Path:
         suffix += f"_{os.environ['BENCH_MOE_DISPATCH']}"
     if ARGS.attention not in (None, _default_accel_attention(ARGS.config)):
         suffix += f"_att{ARGS.attention}"
-    if os.environ.get("BENCH_REMAT") == "1" and ARGS.config != "gpt2-medium":
-        # gpt2-medium remats by default, so BENCH_REMAT=1 is not a deviation
-        # there (mirrors _try_replay_capture's want_remat resolution).
-        suffix += "_remat"
+    if _want_remat_policy() != _preset_remat_policy(ARGS.config):
+        # A non-default remat policy (BENCH_REMAT_POLICY, or the deprecated
+        # BENCH_REMAT=1 alias for full) gets its own capture file — the
+        # mfu_push matrix runs must never clobber the headline capture.
+        suffix += f"_rp_{_want_remat_policy()}"
+    if _want_scan_layers():
+        suffix += "_scan"
+    if _want_grads_dtype() != "float32":
+        suffix += "_gbf16"
     if _dynamics_enabled():
         # Dynamics-introspection overhead run (tpu_queue.sh dyn_overhead):
         # its own capture file, compared against the plain headline by the
@@ -327,11 +359,29 @@ def _try_replay_capture() -> bool:
     # moe_dispatch means the capture predates the knob, i.e. it was
     # MEASURED under the pre-knob behavior (einsum) — NOT the current
     # preset default, which has since flipped to gather for the moe preset.
-    want_remat = (
-        os.environ.get("BENCH_REMAT") == "1" or ARGS.config == "gpt2-medium"
+    # Policy resolution for captures across schema generations: a capture
+    # carrying remat_policy pins it exactly; an older bool-only capture
+    # means full-or-none; an absent key means the preset default AT
+    # CAPTURE TIME (gpt2-medium then rematted by default).
+    want_policy = _want_remat_policy()
+    cap_policy = captured.get("remat_policy") or (
+        "full"
+        if captured.get("remat", ARGS.config == "gpt2-medium")
+        else "none"
     )
-    if bool(captured.get("remat", ARGS.config == "gpt2-medium")) != want_remat:
-        print("capture remat setting differs; not replaying", file=sys.stderr)
+    if cap_policy != want_policy:
+        print(
+            f"capture remat_policy={cap_policy}, run wants {want_policy}; "
+            "not replaying",
+            file=sys.stderr,
+        )
+        return False
+    if bool(captured.get("scan_layers")) != _want_scan_layers():
+        print("capture scan_layers setting differs; not replaying",
+              file=sys.stderr)
+        return False
+    if (captured.get("grads_dtype") or "float32") != _want_grads_dtype():
+        print("capture grads_dtype differs; not replaying", file=sys.stderr)
         return False
     want_dispatch = os.environ.get("BENCH_MOE_DISPATCH") or _preset_moe_dispatch(
         ARGS.config
@@ -516,9 +566,13 @@ def resolve_config(on_accel: bool):
     overrides["attention_impl"] = attention
     if ARGS.flash_block is not None:
         overrides["flash_block_size"] = ARGS.flash_block
-    if os.environ.get("BENCH_REMAT") == "1":
-        # Larger-batch variants that don't fit activations un-rematerialized.
-        overrides["remat"] = True
+    # Graduated remat policy (PR 13): BENCH_REMAT_POLICY (or the deprecated
+    # BENCH_REMAT=1 -> full) overrides the preset; normalize the old bool
+    # away so the policy string is the single source of truth.
+    overrides["remat_policy"] = _want_remat_policy()
+    overrides["remat"] = False
+    if _want_scan_layers():
+        overrides["scan_layers"] = True
     moe_dispatch = os.environ.get("BENCH_MOE_DISPATCH")
     if moe_dispatch:
         overrides["moe_dispatch"] = moe_dispatch
@@ -576,16 +630,17 @@ def bench_jax(platform: str) -> None:
     x = jnp.asarray(ids)
     y = jnp.asarray(np.roll(ids, -1, axis=1))
     dynamics = _dynamics_enabled()
+    hparams = TrainHParams(grads_dtype=_want_grads_dtype())
     if inner > 1:
         from bpe_transformer_tpu.training.train_step import make_scanned_train_step
 
         step = make_scanned_train_step(
-            config, TrainHParams(), inner, dynamics=dynamics
+            config, hparams, inner, dynamics=dynamics
         )
         x = jnp.broadcast_to(x, (inner, *x.shape))
         y = jnp.broadcast_to(y, (inner, *y.shape))
     else:
-        step = make_train_step(config, TrainHParams(), dynamics=dynamics)
+        step = make_train_step(config, hparams, dynamics=dynamics)
 
     # A value fetch is the only reliable execution barrier on every backend
     # (block_until_ready has proven unreliable on relayed remote devices).
@@ -623,7 +678,12 @@ def bench_jax(platform: str) -> None:
             seq=config.context_length,
             attention_impl=config.attention_impl,
             flash_block_size=config.flash_block_size,
-            remat=config.remat,
+            # Legacy bool kept so pre-PR-13 readers of capture files keep
+            # working; remat_policy is the source of truth.
+            remat=config.resolved_remat_policy == "full",
+            remat_policy=config.resolved_remat_policy,
+            scan_layers=config.scan_layers,
+            grads_dtype=_want_grads_dtype(),
             ffn_impl=config.ffn_impl,
             moe_dispatch=config.moe_dispatch if config.ffn_type == "moe" else None,
             dynamics_stats=dynamics,

@@ -49,6 +49,27 @@ def main() -> int:
         "attribution the gpt2 decode-cell timeouts need (compile vs "
         "prefill vs token loop)",
     )
+    parser.add_argument(
+        "--remat-policy", default=None,
+        choices=["none", "full", "dots_saveable", "save_attn"],
+        help="remat policy for the measured step (default: config's)",
+    )
+    parser.add_argument(
+        "--scan-layers", action="store_true",
+        help="measure the scan-over-layers step",
+    )
+    parser.add_argument(
+        "--grads-dtype", default="float32",
+        choices=["float32", "bfloat16"],
+        help="gradient width at the reduction boundary",
+    )
+    parser.add_argument(
+        "--mfu-push", action="store_true",
+        help="training-MFU knob matrix (ISSUE 13): one full_step row per "
+        "(remat_policy, grads_dtype, scan_layers) combination with "
+        "implied tok/s + mfu + peak_hbm_bytes, so the tpu_queue "
+        "self-report can diff each knob against the BENCH_r04 headline",
+    )
     args = parser.parse_args()
 
     # BREAKDOWN_ALLOW_CPU=1 is a functional smoke for the script itself
@@ -84,6 +105,12 @@ def main() -> int:
         base, activation_dtype="bfloat16",
         attention_impl="flash" if base.context_length >= 1024 else "xla",
     )
+    if args.remat_policy:
+        base = dataclasses.replace(
+            base, remat_policy=args.remat_policy, remat=False
+        )
+    if args.scan_layers:
+        base = dataclasses.replace(base, scan_layers=True)
     device = jax.devices()[0]
     rng = np.random.default_rng(0)
 
@@ -102,24 +129,70 @@ def main() -> int:
             flush=True,
         )
 
-    def step_row(config) -> tuple[float, dict]:
+    def step_row(config, grads_dtype: str | None = None) -> tuple[float, dict]:
         # The shared attribution probe: a NON-donating AOT copy of the
         # update (no state threading needed — the loop's buffers stay
         # valid) timed with the same fenced path the telemetry records
-        # use, plus the program's XLA cost-model roofline verdict.
+        # use, plus the program's XLA cost-model roofline verdict and
+        # peak-HBM envelope, labelled with the execution knobs that
+        # produced them (the ISSUE 13 attribution contract: every knob's
+        # win or regression names its cause).
         params = init_params(jax.random.PRNGKey(0), config)
         opt_state = adamw_init(params)
+        hparams = TrainHParams(grads_dtype=grads_dtype or args.grads_dtype)
         probe = StepProbe(
-            config, TrainHParams(), batch_size=args.batch, iters=args.iters
+            config, hparams, batch_size=args.batch, iters=args.iters
         )
         cost = probe.program_costs(params, opt_state)[0]
+        memory = probe.memory_stats(params, opt_state)
         measured = probe.measure(params, opt_state)
         return measured["device_step_s"] * 1e3, {
             "flops": cost["flops"],
             "bytes_accessed": cost["bytes_accessed"],
             "arithmetic_intensity": cost["arithmetic_intensity"],
             "bound": cost["bound"],
+            "peak_hbm_bytes": memory.get("peak_hbm_bytes"),
+            "remat_policy": config.resolved_remat_policy,
+            "grads_dtype": hparams.grads_dtype,
+            "scan_layers": config.scan_layers,
         }
+
+    if args.mfu_push:
+        # Training-MFU knob matrix: the graduated remat ladder at f32
+        # grads, then the bf16-collective and scan-layers combinations on
+        # the selective-recompute point.  Each row carries implied tok/s +
+        # mfu so the queue's jax-free self-report can diff it against the
+        # BENCH_r04 headline capture without re-deriving geometry.
+        from bpe_transformer_tpu.utils.flops import mfu as mfu_of
+
+        matrix = [
+            ("none", "float32", False),
+            ("dots_saveable", "float32", False),
+            ("full", "float32", False),
+            ("save_attn", "float32", False),
+            ("save_attn", "bfloat16", False),
+            ("save_attn", "bfloat16", True),
+        ]
+        for policy, grads_dtype, scan in matrix:
+            cfg = dataclasses.replace(
+                base, remat_policy=policy, remat=False, scan_layers=scan
+            )
+            ms, cost = step_row(cfg, grads_dtype=grads_dtype)
+            tokens_per_sec = args.batch * cfg.context_length / (ms / 1e3)
+            emit(
+                "mfu_push", ms,
+                attention=cfg.attention_impl,
+                loss_chunk=cfg.loss_chunk,
+                tokens_per_sec=round(tokens_per_sec, 1),
+                mfu=(
+                    round(m, 4)
+                    if (m := mfu_of(cfg, args.batch, ms / 1e3,
+                                    device.device_kind)) is not None
+                    else None
+                ),
+                **cost,
+            )
+        return 0
 
     if args.decode:
         from bench_decode import PROMPT_LEN  # shared geometry: these rows
@@ -176,7 +249,7 @@ def main() -> int:
     # 1. The full update as shipped.
     ms, cost = step_row(base)
     emit("full_step", ms, attention=base.attention_impl,
-         flash_block=base.flash_block_size, loss_chunk=base.loss_chunk_size,
+         flash_block=base.flash_block_size, loss_chunk=base.loss_chunk,
          **cost)
 
     # 2. Forward-only and grad-only splits (optimizer cost = full - valgrad).
@@ -197,19 +270,23 @@ def main() -> int:
         ms, cost = step_row(dataclasses.replace(base, **over))
         emit(
             "full_step", ms,
-            attention=attn, flash_block=block, loss_chunk=base.loss_chunk_size,
+            attention=attn, flash_block=block, loss_chunk=base.loss_chunk,
             **cost,
         )
 
-    # 4. CE chunking policy.
-    for chunk in (None, 512):
-        if chunk == base.loss_chunk_size:
-            continue
-        ms, cost = step_row(dataclasses.replace(base, loss_chunk_size=chunk))
+    # 4. CE chunking policy.  loss_chunk_size=None now resolves to the
+    # AUTO chunk on these forced-bf16 configs (PR 13), so the full-logits
+    # comparison point must be requested explicitly as 0; rows are
+    # labelled with the RESOLVED chunk (null = full logits).
+    for chunk in (0, 512):
+        cfg = dataclasses.replace(base, loss_chunk_size=chunk)
+        if cfg.loss_chunk == base.loss_chunk:
+            continue  # already row 1
+        ms, cost = step_row(cfg)
         emit(
             "full_step", ms,
             attention=base.attention_impl, flash_block=base.flash_block_size,
-            loss_chunk=chunk,
+            loss_chunk=cfg.loss_chunk,
             **cost,
         )
     return 0

@@ -71,8 +71,13 @@ def main() -> int:
         for key in ("ffn_impl", "moe_dispatch"):
             if c.get(key) not in (None, "xla", "einsum"):
                 knobs.append(f"{key}={c[key]}")
-        if c.get("remat"):
-            knobs.append("remat")
+        policy = c.get("remat_policy") or ("full" if c.get("remat") else None)
+        if policy and policy != "none":
+            knobs.append(f"remat={policy}")
+        if c.get("scan_layers"):
+            knobs.append("scan_layers")
+        if c.get("grads_dtype") not in (None, "float32"):
+            knobs.append(f"grads={c['grads_dtype']}")
         print(
             f"  {p.name[12:-5]:28s} {c.get('value') or 0:>12,.0f} tok/s"
             f"  mfu={c.get('mfu')}  vs_torch={c.get('vs_baseline')}"

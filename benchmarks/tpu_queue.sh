@@ -385,6 +385,16 @@ run_job attribution 900 "$OUT/attribution.jsonl" \
 run_job sharded_opt 1500 "$CAP/sharded_opt.jsonl" \
   python benchmarks/bench_sharded_opt.py --config tinystories-4l
 
+# Training-MFU knob matrix (ISSUE 13): one measured full-step row per
+# (remat_policy, grads_dtype, scan_layers) point on the headline config —
+# graduated remat ladder, bf16 grad boundary, scanned layer stack — each
+# carrying implied tok/s + mfu + the compiled step's peak_hbm_bytes.  The
+# jax-free self-report at the end diffs the best row against the BENCH_r04
+# headline (mfu=0.128) so every knob's win is measured, not asserted.
+run_job mfu_push 1200 "$CAP/mfu_push.jsonl" \
+  python benchmarks/bench_breakdown.py --config tinystories-4l \
+  --batch 32 --mfu-push
+
 # Kill-resume smoke (resilience layer, PR 5): SIGTERM a short training
 # run midway on the chip and assert the preemption exit code + emergency
 # checkpoint + clean --resume completion — the recovery paths the CPU
@@ -522,6 +532,70 @@ print("  ".join(parts))
 PY
 )
   [ -n "$SHARD_LINE" ] && log "sharded_opt self-report: $SHARD_LINE"
+fi
+# Training-MFU-push self-report (jax-free, CPU-only): the newest mfu_push
+# matrix — per-knob tok/s + mfu + peak-HBM vs the baseline (none/f32) row
+# and vs the replayed BENCH_r04 headline (674k tok/s/chip, mfu=0.128).
+# NOTE: BENCH_r03/r04 are a replayed 2026-07-31 capture; the PR 7-12 chip
+# jobs (sharded_opt, serve_open_pnative*, restart_traffic, serve_open_spec,
+# serve_open_w8*) are still queued-but-unmeasured — drain this queue on a
+# live chip window before claiming any cross-PR win.
+if [ -s "$CAP/mfu_push.jsonl" ]; then
+  MFU_LINE=$(env JAX_PLATFORMS=cpu python - "$CAP/mfu_push.jsonl" "$HEADLINE_CAP" <<'PY'
+import json, sys
+
+rows = {}
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if not ln:
+        continue
+    try:
+        r = json.loads(ln)
+    except json.JSONDecodeError:
+        continue
+    if r.get("stage") == "mfu_push" and r.get("platform") != "cpu":
+        key = (r.get("remat_policy"), r.get("grads_dtype"),
+               bool(r.get("scan_layers")))
+        rows[key] = r  # newest row per knob point wins
+if not rows:
+    sys.exit(0)
+
+base = rows.get(("none", "float32", False))
+best = max(rows.values(), key=lambda r: r.get("tokens_per_sec") or 0)
+headline = None
+try:
+    with open(sys.argv[2]) as f:
+        cap = json.load(f)
+    parsed = cap.get("parsed") if isinstance(cap.get("parsed"), dict) else cap
+    headline = parsed.get("value")
+except Exception:
+    pass
+
+
+def knob(r):
+    tags = [r.get("remat_policy") or "?", r.get("grads_dtype") or "?"]
+    if r.get("scan_layers"):
+        tags.append("scan")
+    return "+".join(tags)
+
+
+def num(v):
+    return f"{v:,.0f}" if isinstance(v, (int, float)) else "n/a"
+
+
+parts = [f"best {knob(best)}: {num(best.get('tokens_per_sec'))} tok/s "
+         f"mfu={best.get('mfu', 'n/a')} "
+         f"peak {num(best.get('peak_hbm_bytes'))} B"]
+if base is not None and base is not best:
+    parts.append(f"baseline none+f32: {num(base.get('tokens_per_sec'))} "
+                 f"tok/s peak {num(base.get('peak_hbm_bytes'))} B")
+if isinstance(headline, (int, float)):
+    parts.append(f"BENCH_r04 headline {num(headline)} tok/s/chip (replayed "
+                 "2026-07-31 capture; PR 7-12 chip jobs still undrained)")
+print("  ".join(parts))
+PY
+)
+  [ -n "$MFU_LINE" ] && log "mfu_push self-report: $MFU_LINE"
 fi
 # Paged-serving self-report (jax-free, CPU-only): newest paged vs dense
 # open-loop rows — prefix-cache hit rate, prefill compute delta, and the

@@ -52,7 +52,43 @@ class ModelConfig:
     moe_dispatch: str = "einsum"
     # TPU execution knobs (not part of the reference schema).
     activation_dtype: str = "float32"  # "bfloat16" for the perf path
-    remat: bool = False  # rematerialize each block on the backward pass
+    #: DEPRECATED (PR 13): the all-or-nothing remat switch.  ``remat=True``
+    #: is accepted as an alias for ``remat_policy="full"`` so old configs,
+    #: checkpoints, and bench captures keep loading; new code should set
+    #: ``remat_policy``.  Setting BOTH (``remat=True`` with a non-full
+    #: ``remat_policy``) is a contradiction and fails validation.
+    remat: bool = False
+    #: Graduated activation-rematerialization policy for the backward pass
+    #: (the training-MFU memory/flops dial; `models/transformer.py`):
+    #:
+    #: * ``"none"``  — save every intermediate (max memory, zero recompute);
+    #: * ``"full"``  — ``jax.checkpoint`` each block saving only its input
+    #:   (min memory; the whole block, flash-attention kernel included,
+    #:   recomputes on the backward — the old ``remat=True``);
+    #: * ``"dots_saveable"`` — block remat that SAVES matmul outputs
+    #:   (``jax.checkpoint_policies.dots_saveable``): only cheap
+    #:   elementwise/norm work recomputes, but the Pallas flash-attention
+    #:   kernel is an opaque custom-vjp call the policy cannot see inside,
+    #:   so its forward still re-runs;
+    #: * ``"save_attn"`` — selective recompute (Korthikanti et al.): the
+    #:   flash-attention call runs OUTSIDE the remat region, so the
+    #:   backward reuses the FA-2 residuals the kernel already emits
+    #:   (q/k/v, output, logsumexp — tagged ``checkpoint_name``) and the
+    #:   O(S^2 d) attention never recomputes, while the memory-heavy,
+    #:   cheap-flops FFN tail (ln2 + FFN + residual) rematerializes.
+    #:   Peak HBM sits strictly below ``none``; recompute flops strictly
+    #:   below ``full``/``dots_saveable``.
+    remat_policy: str = "none"
+    #: Stack the per-block parameters and run the layer stack as ONE
+    #: policy-rematerialized ``lax.scan`` over blocks (training forward
+    #: only; decode keeps its per-layer programs).  Compile time becomes
+    #: O(1) in depth — the pjit-era trainer formulation (arXiv:2204.06514).
+    #: The at-rest param pytree is unchanged (checkpoints, state-dict
+    #: interop, ZeRO-1 flat layout all untouched); the stack happens inside
+    #: the traced step and rides the mixed-precision cast's existing copy
+    #: on bf16 configs.  Requires num_layers >= 1 and homogeneous blocks
+    #: (always true for this architecture).
+    scan_layers: bool = False
     # "xla" (materialized) | "flash" (Pallas) | "flash_fused" (RoPE in-kernel)
     attention_impl: str = "xla"
     # "xla" | "pallas" (fused SwiGLU kernel; swiglu FFNs only)
@@ -77,7 +113,11 @@ class ModelConfig:
     flash_fused_min_seq: int = 2048
     # Sequence-chunked LM loss: cap peak logits memory at
     # O(batch * chunk * vocab) instead of O(batch * seq * vocab).
-    # None -> materialize full logits.  Must divide context_length.
+    # None -> AUTO: bfloat16 training configs default to chunking (the f32
+    # (B, T, V) logits buffer is exactly the peak-memory spike the remat
+    # policy fights; see ``loss_chunk``), float32 configs materialize full
+    # logits.  0 -> force full logits.  N -> chunk N (must divide the
+    # sequence; `ops.losses.lm_loss` falls back when it doesn't).
     loss_chunk_size: int | None = None
     # Sequence-parallel ring attention: sub-chunk each visiting K/V shard
     # so per-device score memory is O(S_local * chunk) instead of
@@ -85,9 +125,38 @@ class ModelConfig:
     # block per ring step.
     ring_kv_chunk: int | None = None
 
+    #: Default sequence chunk of the AUTO loss-chunking policy (bf16
+    #: configs; clamped to the context length).
+    AUTO_LOSS_CHUNK = 256
+
     @property
     def d_head(self) -> int:
         return self.d_model // self.num_heads
+
+    @property
+    def resolved_remat_policy(self) -> str:
+        """The effective remat policy: ``remat_policy``, with the
+        deprecated ``remat: bool`` accepted as ``"full"``."""
+        if self.remat and self.remat_policy == "none":
+            return "full"
+        return self.remat_policy
+
+    @property
+    def loss_chunk(self) -> int | None:
+        """The effective loss chunk size: explicit N, ``0`` -> None (full
+        logits), ``None`` -> auto — bfloat16 training configs whose
+        context exceeds :data:`AUTO_LOSS_CHUNK` chunk at that size, so the
+        compiled step never materializes the f32 ``(B, T, V)`` logits
+        tensor.  Shorter contexts (the chunk would BE the sequence — no
+        buffer shrinks) and float32 configs keep full logits."""
+        if self.loss_chunk_size is not None:
+            return self.loss_chunk_size or None
+        if (
+            self.activation_dtype == "bfloat16"
+            and self.context_length > self.AUTO_LOSS_CHUNK
+        ):
+            return self.AUTO_LOSS_CHUNK
+        return None
 
     def __post_init__(self):
         if self.d_model % self.num_heads:
@@ -121,6 +190,24 @@ class ModelConfig:
             raise ValueError(
                 f"router_top_k={self.router_top_k} must be in "
                 f"[1, n_experts={self.n_experts}]"
+            )
+        if self.remat_policy not in (
+            "none", "full", "dots_saveable", "save_attn"
+        ):
+            raise ValueError(
+                f"remat_policy={self.remat_policy!r} must be one of "
+                '"none", "full", "dots_saveable", "save_attn"'
+            )
+        if self.remat and self.remat_policy not in ("none", "full"):
+            raise ValueError(
+                f"remat=True (deprecated alias for remat_policy=\"full\") "
+                f"contradicts remat_policy={self.remat_policy!r}; drop the "
+                "bool and set only remat_policy"
+            )
+        if self.loss_chunk_size is not None and self.loss_chunk_size < 0:
+            raise ValueError(
+                f"loss_chunk_size={self.loss_chunk_size} must be None "
+                "(auto), 0 (full logits), or a positive chunk"
             )
 
     @classmethod
@@ -225,6 +312,8 @@ GPT2_MEDIUM = ModelConfig(
     d_ff=2731,
     rope_theta=10000.0,
     activation_dtype="bfloat16",
-    remat=True,
+    # Selective recompute (PR 13): strictly less recompute than the old
+    # remat=True at a peak-HBM point that still fits the FSDP target.
+    remat_policy="save_attn",
     loss_chunk_size=256,
 )

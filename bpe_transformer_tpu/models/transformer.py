@@ -7,11 +7,14 @@ adapters.py:209-361`): token embeddings -> N pre-norm blocks
 
 TPU-first design: parameters are a plain nested dict of arrays (a pytree —
 no module system), the forward pass is a pure function traced once under
-``jax.jit``, blocks optionally rematerialize (``jax.checkpoint``) to trade
-FLOPs for HBM, and activations can run in bfloat16 while norms/softmax/loss
-accumulate in float32.  The torch-style flat state-dict key schema
-(`adapters.py:307-353`) is supported bidirectionally so reference
-checkpoints map 1:1.
+``jax.jit``, blocks rematerialize under a graduated policy
+(``ModelConfig.remat_policy`` -> :func:`policy_block`: none / full /
+dots_saveable / save_attn, trading FLOPs for HBM at four operating
+points), the layer stack optionally runs as one ``lax.scan``
+(``scan_layers`` — O(1)-in-depth compile time), and activations can run
+in bfloat16 while norms/softmax/loss accumulate in float32.  The
+torch-style flat state-dict key schema (`adapters.py:307-353`) is
+supported bidirectionally so reference checkpoints map 1:1.
 """
 
 from __future__ import annotations
@@ -240,6 +243,56 @@ def _attention(
     )
 
 
+def _attn_half(
+    x: Array,
+    block_params: dict,
+    config: ModelConfig,
+    rope_cos_sin: tuple[Array, Array] | None,
+    positions: Array,
+    attention_fn=None,
+    entropy_tap: dict | None = None,
+) -> Array:
+    """The residual attention half of one block: ``x + attn(norm(x))``
+    pre-norm, ``norm(x + attn(x))`` post-norm.
+
+    The attention output is tagged :func:`jax.ad_checkpoint.checkpoint_name`
+    (``"flash_attn_out"``) so remat policies can address it by name; under
+    ``remat_policy="save_attn"`` this half runs OUTSIDE the checkpointed
+    region, so the flash kernel's custom-vjp residuals (q/k/v, output,
+    logsumexp — the FA-2 statistics the kernel already emits) stay saved
+    and the O(S^2 d) attention never recomputes on the backward.
+    """
+    from jax.ad_checkpoint import checkpoint_name
+
+    h = x if config.use_post_norm else _maybe_norm(
+        x, block_params["ln1"], config
+    )
+    attn_out = checkpoint_name(
+        _attention(
+            h, block_params["attn"], config, rope_cos_sin, positions,
+            attention_fn, entropy_tap,
+        ),
+        "flash_attn_out",
+    )
+    if config.use_post_norm:
+        return _maybe_norm(x + attn_out, block_params["ln1"], config)
+    return x + attn_out
+
+
+def _ffn_half(
+    x: Array, block_params: dict, config: ModelConfig
+) -> tuple[Array, Array]:
+    """The residual FFN half of one block; returns ``(x, aux_loss)``.
+    Cheap flops, heavy memory (the ``d_ff`` expansion) — the part
+    ``remat_policy="save_attn"`` rematerializes."""
+    if config.use_post_norm:
+        f, aux = _ffn(x, block_params["ffn"], config)
+        return _maybe_norm(x + f, block_params["ln2"], config), aux
+    h = _maybe_norm(x, block_params["ln2"], config)
+    f, aux = _ffn(h, block_params["ffn"], config)
+    return x + f, aux
+
+
 def transformer_block_aux(
     x: Array,
     block_params: dict,
@@ -257,26 +310,88 @@ def transformer_block_aux(
     ``entropy_tap`` (a dict, dynamics introspection) receives this layer's
     mean attention entropy under ``"attn_entropy"``.
     """
-    if config.use_post_norm:
-        x = _maybe_norm(
-            x
-            + _attention(
-                x, block_params["attn"], config, rope_cos_sin, positions,
-                attention_fn, entropy_tap,
-            ),
-            block_params["ln1"],
-            config,
-        )
-        f, aux = _ffn(x, block_params["ffn"], config)
-        return _maybe_norm(x + f, block_params["ln2"], config), aux
-    h = _maybe_norm(x, block_params["ln1"], config)
-    x = x + _attention(
-        h, block_params["attn"], config, rope_cos_sin, positions, attention_fn,
+    x = _attn_half(
+        x, block_params, config, rope_cos_sin, positions, attention_fn,
         entropy_tap,
     )
-    h = _maybe_norm(x, block_params["ln2"], config)
-    f, aux = _ffn(h, block_params["ffn"], config)
-    return x + f, aux
+    return _ffn_half(x, block_params, config)
+
+
+def _block_save_attn(
+    x: Array,
+    block_params: dict,
+    config: ModelConfig,
+    rope_cos_sin: tuple[Array, Array] | None,
+    positions: Array,
+    attention_fn=None,
+    entropy_tap: dict | None = None,
+) -> tuple[Array, Array]:
+    """One block under ``remat_policy="save_attn"`` (selective activation
+    recomputation, Korthikanti et al. / arXiv:2302.01107 §recompute):
+
+    * the attention half runs at the ambient level — the flash kernel's
+      custom-vjp keeps its FA-2 residuals (q/k/v, tagged output,
+      logsumexp), so the flops-dense attention is computed exactly once;
+    * the FFN half (ln2 + FFN + residual) is ``jax.checkpoint``'d — its
+      ``(B, T, d_ff)`` expansion intermediates, the block's memory bulk,
+      are dropped and rematerialized on the backward.
+
+    Peak activation memory lands strictly between ``full`` and ``none``;
+    recompute flops strictly below ``full``/``dots_saveable`` (both re-run
+    the opaque kernel).  Numerics are identical to the plain block.
+    """
+    x = _attn_half(
+        x, block_params, config, rope_cos_sin, positions, attention_fn,
+        entropy_tap,
+    )
+    tail = jax.checkpoint(_ffn_half, static_argnums=(2,))
+    return tail(x, block_params, config)
+
+
+def policy_block(
+    config: ModelConfig, with_stats: bool = False, in_scan: bool = False
+):
+    """The remat-policy-wrapped block callable for ``config``.
+
+    Dispatches on ``config.resolved_remat_policy`` (the graduated dial;
+    ``remat: bool`` back-compat included):
+
+    * ``none`` — the plain block;
+    * ``full`` — ``jax.checkpoint`` around the whole block, save nothing;
+    * ``dots_saveable`` — block checkpoint saving matmul outputs
+      (``jax.checkpoint_policies.dots_saveable``);
+    * ``save_attn`` — :func:`_block_save_attn` (remat lives INSIDE the
+      block: wrapping it whole would drag the kernel back into the region).
+
+    ``with_stats=True`` returns the dynamics-instrumented variant
+    (``(x, aux, stats)`` instead of ``(x, aux)``).  ``in_scan=True`` drops
+    the checkpoint CSE barrier (documented safe under ``lax.scan``, where
+    the scan structure already prevents forward/backward merging) — used
+    by ``scan_layers`` and the pipeline tick scan.
+
+    Shared by ``forward_hidden``/``forward_hidden_stats`` and
+    ``parallel/pp.py`` so the policy semantics cannot drift between the
+    single-program and pipelined forwards.
+    """
+    policy_name = config.resolved_remat_policy
+    if with_stats:
+        base = _block_with_stats
+    elif policy_name == "save_attn":
+        base = _block_save_attn
+    else:
+        base = transformer_block_aux
+    if policy_name in ("none", "save_attn"):
+        # save_attn self-checkpoints its FFN tail (the stats variant
+        # dispatches internally); nothing to wrap here.
+        return base
+    pol = (
+        jax.checkpoint_policies.dots_saveable
+        if policy_name == "dots_saveable"
+        else None
+    )
+    return jax.checkpoint(
+        base, static_argnums=(2, 5), policy=pol, prevent_cse=not in_scan
+    )
 
 
 def transformer_block(
@@ -350,18 +465,75 @@ def forward_hidden(
         params, token_ids, config, positions
     )
 
-    block = transformer_block_aux
-    if config.remat:
-        # config and attention_fn are non-array (static) arguments.
-        block = jax.checkpoint(
-            transformer_block_aux, static_argnums=(2, 5), policy=None
-        )
     aux_total = jnp.zeros((), jnp.float32)
-    for block_params in compute_params["layers"]:
-        x, aux = block(x, block_params, config, rope_cos_sin, positions, attention_fn)
-        aux_total = aux_total + aux
+    if config.scan_layers:
+        x, aux_total = _scan_blocks(
+            x, aux_total, compute_params["layers"], config, rope_cos_sin,
+            positions, attention_fn,
+        )
+    else:
+        block = policy_block(config)
+        for block_params in compute_params["layers"]:
+            x, aux = block(
+                x, block_params, config, rope_cos_sin, positions, attention_fn
+            )
+            aux_total = aux_total + aux
 
     x = _maybe_norm(x, compute_params["ln_final"], config)
+    return x, aux_total
+
+
+def _scan_blocks(
+    x: Array,
+    aux_total: Array,
+    layers: list,
+    config: ModelConfig,
+    rope_cos_sin,
+    positions: Array,
+    attention_fn=None,
+    with_stats: bool = False,
+):
+    """Run the layer stack as ONE ``lax.scan`` over stacked block params
+    (``config.scan_layers``): the jaxpr contains a single
+    (policy-rematerialized) block body whatever ``num_layers`` is, so
+    compile time is O(1) in depth — the pjit-era trainer formulation
+    (arXiv:2204.06514).
+
+    The at-rest pytree keeps its per-layer list layout; the stack happens
+    here, inside the traced step.  Under bf16 activation configs the
+    prologue's mixed-precision cast already copies every leaf, so stacking
+    adds no extra HBM beyond layout; f32 configs pay one transient stacked
+    copy of the block params (and XLA's gradient of the stack is the
+    per-layer slice, so grads land back in the list layout unchanged).
+
+    ``with_stats=True`` scans the dynamics-instrumented block and returns
+    ``(x, aux_total, act_stats)`` with the per-layer stats stacked by the
+    scan itself.
+    """
+    block = policy_block(config, with_stats=with_stats, in_scan=True)
+    stacked = jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves), *layers)
+
+    if with_stats:
+        def body(carry, layer_params):
+            h, aux = carry
+            h, a, stats = block(
+                h, layer_params, config, rope_cos_sin, positions, attention_fn
+            )
+            return (h, aux + a), stats
+
+        (x, aux_total), act_stats = jax.lax.scan(
+            body, (x, aux_total), stacked
+        )
+        return x, aux_total, act_stats
+
+    def body(carry, layer_params):
+        h, aux = carry
+        h, a = block(
+            h, layer_params, config, rope_cos_sin, positions, attention_fn
+        )
+        return (h, aux + a), None
+
+    (x, aux_total), _ = jax.lax.scan(body, (x, aux_total), stacked)
     return x, aux_total
 
 
@@ -378,9 +550,16 @@ def _block_with_stats(
     The stats are part of the RETURN value (not a side channel), so the
     function stays pure and composes with ``jax.checkpoint`` — under remat
     the tap simply recomputes with the block in the backward pass.
+    Dispatches the ``save_attn`` block structure internally so the stats
+    variant honors the same remat policy as the plain forward.
     """
     tap: dict = {}
-    x, aux = transformer_block_aux(
+    base = (
+        _block_save_attn
+        if config.resolved_remat_policy == "save_attn"
+        else transformer_block_aux
+    )
+    x, aux = base(
         x, block_params, config, rope_cos_sin, positions, attention_fn, tap
     )
     x32 = x.astype(jnp.float32)
@@ -408,18 +587,24 @@ def forward_hidden_stats(
     counts plus the mean attention entropy (sampled from batch element 0).
     The stats are ordinary traced scalars, so the dynamics-enabled train
     step gets them from the SAME forward it differentiates — no second
-    pass, no host syncs (`telemetry.dynamics`).  Honors ``config.remat``
-    like :func:`forward_hidden`.
+    pass, no host syncs (`telemetry.dynamics`).  Honors the graduated
+    ``config.remat_policy`` (and ``scan_layers``) like
+    :func:`forward_hidden`.
     """
     x, compute_params, rope_cos_sin, positions = _forward_prologue(
         params, token_ids, config, positions
     )
 
-    block = _block_with_stats
-    if config.remat:
-        # config and attention_fn are non-array (static) arguments.
-        block = jax.checkpoint(_block_with_stats, static_argnums=(2, 5), policy=None)
     aux_total = jnp.zeros((), jnp.float32)
+    if config.scan_layers:
+        x, aux_total, act_stats = _scan_blocks(
+            x, aux_total, compute_params["layers"], config, rope_cos_sin,
+            positions, attention_fn, with_stats=True,
+        )
+        x = _maybe_norm(x, compute_params["ln_final"], config)
+        return x, aux_total, act_stats
+
+    block = policy_block(config, with_stats=True)
     per_layer: list[dict] = []
     for block_params in compute_params["layers"]:
         x, aux, stats = block(

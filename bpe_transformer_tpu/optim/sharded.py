@@ -85,13 +85,20 @@ def shard_len(total: int, n_shards: int) -> int:
     return -(-total // n_shards)
 
 
-def flatten_f32(tree, pad_to: int | None = None) -> Array:
-    """Ravel every leaf to float32 and concatenate; zero-pad to ``pad_to``."""
+def flatten_cast(tree, dtype, pad_to: int | None = None) -> Array:
+    """Ravel every leaf to ``dtype`` and concatenate; zero-pad to
+    ``pad_to``.  The bf16 gradient boundary flattens at the narrow width
+    so the reduce-scatter moves half the bytes."""
     leaves = jax.tree_util.tree_leaves(tree)
-    flat = jnp.concatenate([leaf.astype(jnp.float32).ravel() for leaf in leaves])
+    flat = jnp.concatenate([leaf.astype(dtype).ravel() for leaf in leaves])
     if pad_to is not None and pad_to > flat.size:
         flat = jnp.pad(flat, (0, pad_to - flat.size))
     return flat
+
+
+def flatten_f32(tree, pad_to: int | None = None) -> Array:
+    """Ravel every leaf to float32 and concatenate; zero-pad to ``pad_to``."""
+    return flatten_cast(tree, jnp.float32, pad_to)
 
 
 def unflatten_like(flat: Array, template) -> Any:
@@ -156,6 +163,7 @@ def sharded_adamw_update(
     weight_decay: float = 0.01,
     grad_clip_norm: float | None = None,
     clip_eps: float = 1e-6,
+    grads_dtype: str = "float32",
 ):
     """One ZeRO-1 AdamW step INSIDE ``shard_map`` over ``axis``.
 
@@ -165,16 +173,25 @@ def sharded_adamw_update(
     block (``in_specs=P(axis)`` on the leading shard dim).  Returns
     ``(new_params, new_state, grad_norm)`` with ``grad_norm`` the global
     pre-clip norm of the MEAN gradients (what the unsharded path reports).
+
+    ``grads_dtype="bfloat16"`` flattens the gradient tree at bf16 so the
+    reduce-scatter — the training step's one big collective on this path —
+    moves HALF the bytes; the scattered shard widens straight back to
+    float32, so the clip norm, moments, and fp32 master math below are
+    untouched (only sub-bf16 gradient precision is rounded away, bounded
+    by the parity tests).
     """
     b1, b2 = betas
     total = flat_total(params)
     L = int(state.m.shape[-1])
 
     # Reduce-scatter: one collective hands each replica the summed shard it
-    # owns; dividing by N makes it the mean (== pmean semantics).
-    flat_g = flatten_f32(grads, pad_to=n_shards * L)
+    # owns; dividing by N makes it the mean (== pmean semantics).  The
+    # flatten happens at the (possibly narrowed) collective width.
+    flat_g = flatten_cast(grads, jnp.dtype(grads_dtype), pad_to=n_shards * L)
     g_local = (
         lax.psum_scatter(flat_g, axis, scatter_dimension=0, tiled=True)
+        .astype(jnp.float32)
         / n_shards
     )
 

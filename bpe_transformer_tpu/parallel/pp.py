@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from bpe_transformer_tpu.models.config import ModelConfig
 from bpe_transformer_tpu.models.transformer import (
     Params,
-    transformer_block_aux,
+    policy_block,
 )
 from bpe_transformer_tpu.ops.core import embedding, rmsnorm
 from bpe_transformer_tpu.ops.rope import rope_tables
@@ -142,15 +142,16 @@ def _pp_loss_fn(
 
         def apply_stage(act):
             aux_sum = jnp.zeros((), jnp.float32)
+            # Graduated remat policy (PR 13): the same policy dispatch as
+            # the single-program forward — full/dots_saveable checkpoint
+            # the block (in_scan: the tick scan already bars CSE),
+            # save_attn keeps the attention kernel's residuals and remats
+            # only the FFN tail.  The deprecated remat bool maps to full.
+            block = policy_block(config, in_scan=True)
             for i in range(per_stage):
                 block_params = jax.tree_util.tree_map(
                     lambda l: l[0, i].astype(act_dtype), stages
                 )
-                block = transformer_block_aux
-                if config.remat:
-                    block = jax.checkpoint(
-                        transformer_block_aux, static_argnums=(2, 5)
-                    )
                 act, aux = block(
                     act, block_params, config, rope_cos_sin, positions, None
                 )
@@ -163,7 +164,7 @@ def _pp_loss_fn(
             from bpe_transformer_tpu.ops.losses import lm_loss
 
             head_w = shared.get("lm_head", shared["token_embeddings"])
-            return lm_loss(act, head_w, targets, config.loss_chunk_size)
+            return lm_loss(act, head_w, targets, config.loss_chunk)
 
         fwd_perm = [(i, (i + 1) % pp_size) for i in range(pp_size)]
         ticks = num_micro + pp_size - 1
@@ -296,7 +297,19 @@ def make_pp_train_step(
         # head/final-norm on the last): psum over pp makes them global.
         grads["shared"] = lax.psum(grads["shared"], pp_axis)
         if use_dp:
+            # The dp gradient all-reduce optionally crosses at bf16
+            # (train_step._reduce_grads semantics; the pp-axis psums above
+            # are correctness sums of DISJOINT partials and stay f32).
+            narrow = jnp.dtype(hparams.grads_dtype)
+            if narrow != jnp.float32:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(narrow), grads
+                )
             grads = lax.pmean(grads, dp_axis)
+            if narrow != jnp.float32:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads
+                )
             loss = lax.pmean(loss, dp_axis)
 
         # Global grad-norm: stage grads live on distinct pp ranks (sum their

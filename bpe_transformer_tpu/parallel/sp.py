@@ -214,7 +214,7 @@ def make_sp_train_step(
                 p, x, config, positions=positions, attention_fn=attention_fn
             )
             loss = lm_loss(
-                hidden, lm_head_weight(p, config), y, config.loss_chunk_size
+                hidden, lm_head_weight(p, config), y, config.loss_chunk
             )
             if config.ffn_type == "moe":
                 # Load-balance aux per dispatch group (the Switch
@@ -232,8 +232,20 @@ def make_sp_train_step(
         else:
             loss, grads = grad_fn(params, x, y)
         # Equal-size shards: the global mean is the mean of shard means —
-        # ONE collective per update, after any local accumulation.
+        # ONE collective per update, after any local accumulation.  Under
+        # grads_dtype="bfloat16" the tree crosses the (data, seq)
+        # all-reduce at half width (train_step._reduce_grads semantics);
+        # clip/AdamW below stay f32.
+        narrow = jnp.dtype(hparams.grads_dtype)
+        if narrow != jnp.float32:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(narrow), grads
+            )
         grads = jax.lax.pmean(grads, (data_axis, seq_axis))
+        if narrow != jnp.float32:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads
+            )
         loss = jax.lax.pmean(loss, (data_axis, seq_axis))
 
         grads, grad_norm = clip_by_global_norm(grads, hparams.grad_clip_norm)

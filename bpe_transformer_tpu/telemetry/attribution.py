@@ -56,6 +56,7 @@ __all__ = [
     "StepProbe",
     "decode_tick_roofline",
     "program_cost",
+    "program_memory",
     "roofline",
     "serving_program_costs",
     "time_call",
@@ -113,6 +114,50 @@ def program_cost(compiled) -> dict:
         return float(value) if isinstance(value, (int, float)) else None
 
     return {"flops": grab("flops"), "bytes_accessed": grab("bytes accessed")}
+
+
+def program_memory(compiled) -> dict:
+    """Peak-HBM accounting of an AOT-compiled executable from XLA's
+    ``memory_analysis()`` (None values when the backend publishes none).
+
+    ``peak_hbm_bytes = temp + arguments + outputs − aliased``: the
+    buffer-assignment envelope the program needs live at once.  ``temp``
+    alone is where a remat policy's win shows (activation residuals are
+    temp buffers); arguments/outputs are the resident state.  For a
+    NON-donating probe program this is an upper bound on the live
+    (donating) step's peak — params/opt-state are counted once as
+    arguments and once as outputs — but the bound is CONSTRUCTED
+    identically for every knob setting, so deltas across
+    remat/precision/scan configurations (and the ``train_peak_hbm_bytes``
+    compare-gate row) attribute real wins, which is what the gate needs.
+    """
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:
+        stats = None
+    if stats is None:
+        return {
+            "peak_hbm_bytes": None, "temp_bytes": None,
+            "argument_bytes": None, "output_bytes": None,
+        }
+
+    def grab(name):
+        value = getattr(stats, name, None)
+        return int(value) if isinstance(value, (int, float)) else None
+
+    temp = grab("temp_size_in_bytes")
+    args = grab("argument_size_in_bytes")
+    out = grab("output_size_in_bytes")
+    alias = grab("alias_size_in_bytes") or 0
+    peak = None
+    if temp is not None and args is not None and out is not None:
+        peak = temp + args + out - alias
+    return {
+        "peak_hbm_bytes": peak,
+        "temp_bytes": temp,
+        "argument_bytes": args,
+        "output_bytes": out,
+    }
 
 
 def roofline(
@@ -252,6 +297,7 @@ class StepProbe:
         self._rng = np.random.default_rng(seed)
         self._compiled: dict[str, object] = {}
         self._costs: list[dict] | None = None
+        self._memory: dict | None = None
         self._batches: dict[str, tuple] = {}
 
     # -- internal builders -------------------------------------------------
@@ -339,6 +385,12 @@ class StepProbe:
                     name=name,
                 )
             )
+            if name == "train_step":
+                # Peak-HBM accounting of the full update program: the
+                # number the remat policy / bf16 boundary / loss chunking
+                # move, stamped onto every attribution record so the
+                # train_peak_hbm_bytes compare gate can pin it.
+                self._memory = program_memory(compiled)
         self._costs = costs
 
     def _mesh_jit(self, body, params, opt_state):
@@ -396,6 +448,14 @@ class StepProbe:
         if self._costs is None:
             self._compile(params, opt_state)
         return self._costs
+
+    def memory_stats(self, params, opt_state) -> dict:
+        """:func:`program_memory` of the compiled full-step program
+        (``peak_hbm_bytes``/``temp_bytes``/...), compiling on first use —
+        the number the remat-policy and loss-chunking knobs move."""
+        if self._costs is None:
+            self._compile(params, opt_state)
+        return dict(self._memory or {})
 
     def measure(self, params, opt_state) -> dict:
         """Fenced device timings of the probe programs (seconds per
@@ -494,7 +554,14 @@ class StepProbe:
         """One ``kind="attribution"`` record: the measured compute /
         collective / host-gap split of ``wall_step_s`` (fractions sum to
         1.0), carrying the static roofline rows on the first record of the
-        run (``include_programs`` overrides)."""
+        run (``include_programs`` overrides).
+
+        Every record additionally carries the update program's
+        ``train_peak_hbm_bytes`` (:func:`program_memory` of the compiled
+        step) and the execution-knob labels that produced it —
+        ``remat_policy`` / ``grads_dtype`` / ``scan_layers`` — so a
+        peak-memory or MFU move is attributable to the knob that caused
+        it instead of read off a dashboard and guessed at."""
         first = self._costs is None
         measured = self.measure(params, opt_state)
         device_s = measured["device_step_s"]
@@ -502,6 +569,7 @@ class StepProbe:
         compute_s = measured["compute_s"]
         host_gap_s = max(wall_step_s - device_s, 0.0)
         denom = max(wall_step_s, device_s, 1e-12)
+        memory = self._memory or {}
         record = {
             "kind": "attribution",
             "t": round(t, 6),
@@ -516,6 +584,11 @@ class StepProbe:
             ),
             "host_gap_frac": round(host_gap_s / denom, 4),
             "probe_iters": self.iters,
+            "train_peak_hbm_bytes": memory.get("peak_hbm_bytes"),
+            "train_temp_hbm_bytes": memory.get("temp_bytes"),
+            "remat_policy": self.config.resolved_remat_policy,
+            "grads_dtype": getattr(self.hparams, "grads_dtype", "float32"),
+            "scan_layers": self.config.scan_layers,
         }
         if include_programs if include_programs is not None else first:
             record["programs"] = self._costs

@@ -142,7 +142,9 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
             # Latest performance-attribution split (telemetry/attribution):
             # fractions + the top compiled program's roofline verdict, so a
             # live operator sees WHERE step time goes, not just how much.
-            for key in ("compute_frac", "collective_frac", "host_gap_frac"):
+            for key in ("compute_frac", "collective_frac", "host_gap_frac",
+                        "train_peak_hbm_bytes", "remat_policy",
+                        "grads_dtype", "scan_layers"):
                 if record.get(key) is not None:
                     state[key] = record[key]
             state["attribution_step"] = record.get("step")
@@ -469,6 +471,18 @@ def render_frame(state: dict, source: str) -> str:
         if state.get("bound_verdict"):
             parts.append(f"[{state['bound_verdict']}]")
         lines.append("  attr   " + "  ".join(parts))
+        # Training-step memory + execution knobs (PR 13): the compiled
+        # update's peak-HBM envelope and the remat/precision/scan labels
+        # that produced it, when the stream carries them.
+        if state.get("train_peak_hbm_bytes") is not None:
+            knob_parts = [f"peak {_mib(state['train_peak_hbm_bytes'])}"]
+            if state.get("remat_policy"):
+                knob_parts.append(f"remat {state['remat_policy']}")
+            if state.get("grads_dtype"):
+                knob_parts.append(f"grads {state['grads_dtype']}")
+            if state.get("scan_layers"):
+                knob_parts.append("scan_layers")
+            lines.append("  step   " + "  ".join(knob_parts))
 
     dyn = state.get("dynamics")
     if dyn:

@@ -99,6 +99,16 @@ def _stats(values: list[float]) -> dict:
     }
 
 
+def _last_number(records: list[dict], key: str):
+    """The newest finite value of ``key`` across ``records`` (None when no
+    record carries one — older streams predate the field)."""
+    for record in reversed(records):
+        value = record.get(key)
+        if isinstance(value, (int, float)) and math.isfinite(value):
+            return value
+    return None
+
+
 def _pctl(values: list[float], q: float) -> float | None:
     """Nearest-rank percentile (q in [0, 1]) of the finite values."""
     finite = sorted(
@@ -668,6 +678,16 @@ def summarize(records: list[dict]) -> dict:
             ),
             "mfu_last": mfu_last,
             "mfu_if_compute_only": mfu_compute_bound,
+            # Peak-HBM + execution-knob labels (PR 13): the LAST record's
+            # compiled-step memory envelope and the remat/precision/scan
+            # knobs that produced it — the compare gate's
+            # train_peak_hbm_bytes row and the report's attribution line.
+            "train_peak_hbm_bytes": _last_number(
+                attributions, "train_peak_hbm_bytes"
+            ),
+            "remat_policy": attributions[-1].get("remat_policy"),
+            "grads_dtype": attributions[-1].get("grads_dtype"),
+            "scan_layers": attributions[-1].get("scan_layers"),
             "programs": programs,
         }
 
@@ -1098,6 +1118,18 @@ def render_report(records: list[dict]) -> str:
                 "collective + host gap were zero (beyond that: kernels/"
                 "layout, not overlap)"
             )
+        peak = at.get("train_peak_hbm_bytes")
+        if isinstance(peak, (int, float)):
+            knobs = [
+                f"remat={at.get('remat_policy') or 'n/a'}",
+                f"grads={at.get('grads_dtype') or 'n/a'}",
+            ]
+            if at.get("scan_layers"):
+                knobs.append("scan_layers")
+            lines.append(
+                f"  train step peak HBM {peak / 2**20:,.1f} MiB"
+                f"  ({', '.join(knobs)})"
+            )
         if at["programs"]:
             lines.append(
                 f"  {'program':<18s}{'GFLOPs':>10s}{'MB moved':>10s}"
@@ -1257,6 +1289,18 @@ COMPARE_METRICS: dict = {
     "host_gap_frac": (
         lambda s: ((s.get("attribution") or {}).get("host_gap_frac", {})
                    or {}).get("mean"), "lower"),
+    # Training-step memory/MFU gate (ISSUE 13): the compiled update's peak
+    # HBM envelope (what the remat policy, bf16 grad boundary, and loss
+    # chunking move) and the compute-only MFU ceiling (mfu /
+    # compute_frac — rises when kernels/layout improve, independent of
+    # host-gap noise).  A run whose peak grows back or whose ceiling sinks
+    # against the baseline lost a pinned training-efficiency win.
+    "train_peak_hbm_bytes": (
+        lambda s: (s.get("attribution") or {}).get("train_peak_hbm_bytes"),
+        "lower"),
+    "mfu_compute_ceiling": (
+        lambda s: (s.get("attribution") or {}).get("mfu_if_compute_only"),
+        "higher"),
     "hbm_peak_bytes": (
         lambda s: (s["resources"] or {}).get("hbm_peak_bytes_in_use", {}).get("max")
         if s.get("resources") else None, "lower"),
@@ -1357,6 +1401,11 @@ def baseline_capture_metrics(capture: dict) -> dict:
         ("params_bytes", "params_bytes_per_chip"),
         ("host_gap_frac", "host_gap_frac"),
         ("collective_frac", "collective_frac"),
+        # Training-MFU push capture rows (ISSUE 13, bench_breakdown
+        # --mfu-push): the compiled step's peak-HBM envelope gates a later
+        # stream's attribution records.
+        ("train_peak_hbm_bytes", "train_peak_hbm_bytes"),
+        ("mfu_compute_ceiling", "mfu_compute_ceiling"),
         # Speculative-serving capture rows (bench_serving.py --speculate):
         # acceptance evidence gates against a later stream's spec records.
         ("accept_rate", "accept_rate"),

@@ -76,6 +76,15 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
     # record of a run, the static XLA cost-model roofline rows under an
     # optional ``programs`` list (name, flops, bytes_accessed,
     # arithmetic_intensity, ridge_flops_per_byte, bound verdict).
+    # Records additionally carry the compiled step's peak-HBM envelope and
+    # the execution-knob labels that produced it (all optional — older
+    # streams predate them): ``train_peak_hbm_bytes`` /
+    # ``train_temp_hbm_bytes`` (XLA memory_analysis: temp + args + outputs
+    # − aliased of the non-donating probe program; null on backends
+    # without the counters) and ``remat_policy`` / ``grads_dtype`` /
+    # ``scan_layers`` — so a peak or MFU move is attributable to the knob
+    # that caused it.  ``train_peak_hbm_bytes`` feeds the report compare
+    # gate (lower), as does the derived ``mfu_compute_ceiling``.
     "attribution": {
         "kind", "t", "step", "wall_step_s", "device_step_s",
         "compute_frac", "collective_frac", "host_gap_frac",

@@ -71,8 +71,68 @@ def _load_model_config(args, stored: dict | None = None) -> ModelConfig:
             ffn_impl="xla",
             decode_attention_impl="xla",
             remat=False,
+            remat_policy="none",
+            scan_layers=False,
         )
     return PRESETS[getattr(args, "default_preset", "tinystories-4l")]
+
+
+def _add_mfu_knob_flags(p) -> None:
+    """The training-MFU execution knobs (ISSUE 13), shared by ``train``,
+    ``warmup --train`` (whose jit-baked programs must match the run they
+    warm), and ``profile``: the graduated remat policy, scan-over-layers,
+    and the bf16 gradient-collective boundary."""
+    p.add_argument(
+        "--remat-policy",
+        default=None,
+        choices=["none", "full", "dots_saveable", "save_attn"],
+        help="activation-rematerialization policy for the backward pass: "
+        "none (save everything), full (recompute whole blocks — the "
+        "deprecated remat:true), dots_saveable (save matmul outputs), "
+        "save_attn (keep the flash-attention kernel's FA-2 residuals, "
+        "rematerialize the FFN tail — lower peak HBM than none, less "
+        "recompute than full); default: the model config's setting",
+    )
+    p.add_argument(
+        "--scan-layers",
+        action="store_true",
+        help="run the layer stack as one policy-rematerialized lax.scan "
+        "over stacked block params: O(1)-in-depth compile time, identical "
+        "numerics; param pytree/checkpoints unchanged",
+    )
+    p.add_argument(
+        "--grads-dtype",
+        default="float32",
+        choices=["float32", "bfloat16"],
+        help="gradient width at the reduction boundary: bfloat16 rounds "
+        "the grad tree before the dp pmean / ZeRO-1 reduce-scatter "
+        "(half the collective bytes; f32 clip/AdamW/master math "
+        "unchanged; same rounding applied in every execution mode)",
+    )
+
+
+def _apply_mfu_knobs(model_config: ModelConfig, args) -> ModelConfig:
+    """Fold the --remat-policy/--scan-layers flags into the resolved model
+    config, with the deprecation note for configs still using the old
+    ``remat: bool`` (accepted as remat_policy="full")."""
+    import dataclasses
+
+    if model_config.remat and not args.remat_policy:
+        print(
+            'note: ModelConfig.remat is deprecated — treating remat=true '
+            'as remat_policy="full"; set remat_policy (or --remat-policy) '
+            "explicitly",
+            file=sys.stderr,
+        )
+    overrides = {}
+    if args.remat_policy:
+        # The explicit flag wins over (and silences) the deprecated bool.
+        overrides.update(remat_policy=args.remat_policy, remat=False)
+    if args.scan_layers:
+        overrides["scan_layers"] = True
+    if overrides:
+        model_config = dataclasses.replace(model_config, **overrides)
+    return model_config
 
 
 def cmd_train_tokenizer(args) -> int:
@@ -155,7 +215,7 @@ def cmd_train(args) -> int:
 
         enable_compile_cache(args.compile_cache)
 
-    model_config = _load_model_config(args)
+    model_config = _apply_mfu_knobs(_load_model_config(args), args)
     hparams = TrainHParams(
         max_learning_rate=args.lr,
         min_learning_rate=args.min_lr if args.min_lr is not None else args.lr / 10,
@@ -163,6 +223,7 @@ def cmd_train(args) -> int:
         cosine_cycle_iters=args.lr_cycle if args.lr_cycle else args.steps,
         weight_decay=args.weight_decay,
         grad_clip_norm=args.grad_clip,
+        grads_dtype=args.grads_dtype,
     )
     mesh_axes = None
     if args.mesh:
@@ -593,6 +654,10 @@ def _warmup_train(args) -> int:
         model_config = _load_model_config(args)
         params = init_params(jax.random.PRNGKey(0), model_config)
 
+    # The MFU knobs change the LOWERED program (remat structure, scanned
+    # layer stack, grad-cast boundary), so warming them must mirror the
+    # run's flags exactly — same contract as --lr/--batch-size above.
+    model_config = _apply_mfu_knobs(model_config, args)
     hparams = TrainHParams(
         max_learning_rate=args.lr,
         min_learning_rate=(
@@ -602,6 +667,7 @@ def _warmup_train(args) -> int:
         cosine_cycle_iters=args.lr_cycle if args.lr_cycle else args.steps,
         weight_decay=args.weight_decay,
         grad_clip_norm=args.grad_clip,
+        grads_dtype=args.grads_dtype,
     )
     ctx = model_config.context_length
     batch = args.batch_size
@@ -652,6 +718,9 @@ def _warmup_train(args) -> int:
         "grad_accum_steps": args.grad_accum_steps,
         "inner_steps": args.inner_steps,
         "health_stats": health,
+        "remat_policy": model_config.resolved_remat_policy,
+        "scan_layers": model_config.scan_layers,
+        "grads_dtype": hparams.grads_dtype,
         "cache_dir": str(args.compile_cache),
         "cache_hits": compile_cache_hits(),
     }))
@@ -895,12 +964,13 @@ def cmd_profile(args) -> int:
     else:
         model_config = _load_model_config(args)
         params = init_params(jax.random.PRNGKey(args.seed), model_config)
+    model_config = _apply_mfu_knobs(model_config, args)
     opt_state = adamw_init(params)
     device = jax.devices()[0]
 
     probe = StepProbe(
         model_config,
-        TrainHParams(),
+        TrainHParams(grads_dtype=args.grads_dtype),
         batch_size=args.batch,
         iters=max(args.measure, 1),
         seed=args.seed,
@@ -990,6 +1060,8 @@ def cmd_profile(args) -> int:
                     for k in (
                         "wall_step_s", "device_step_s", "compute_frac",
                         "collective_frac", "host_gap_frac",
+                        "train_peak_hbm_bytes", "remat_policy",
+                        "grads_dtype", "scan_layers",
                     )
                 }
             )
@@ -1276,6 +1348,7 @@ def build_parser() -> argparse.ArgumentParser:
         "accumulation; single device; must divide --batch-size)",
     )
     p.add_argument("--seed", type=int, default=0)
+    _add_mfu_knob_flags(p)
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("eval", help="evaluate a checkpoint's loss")
@@ -1571,6 +1644,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="(--train) warm the health-stats step variant")
     p.add_argument("--dynamics-every", type=int, default=0,
                    help="(--train) warm the dynamics step variant")
+    _add_mfu_knob_flags(p)
     p.set_defaults(fn=cmd_warmup, default_preset="tinystories-4l")
 
     p = sub.add_parser(
@@ -1602,6 +1676,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print a machine-readable summary line (bench "
                    "queue evidence rows)")
     p.add_argument("--seed", type=int, default=0)
+    _add_mfu_knob_flags(p)
     p.set_defaults(fn=cmd_profile, default_preset="tinystories-4l")
 
     p = sub.add_parser(

@@ -34,6 +34,24 @@ class TrainHParams:
     eps: float = 1e-8
     weight_decay: float = 0.01
     grad_clip_norm: float = 1.0
+    #: Width of the gradient tree AT THE REDUCTION BOUNDARY (PR 13):
+    #: ``"bfloat16"`` rounds gradients to bf16 just before the dp ``pmean``
+    #: / ZeRO-1 reduce-scatter, halving the bytes every training collective
+    #: moves, then widens back to float32 — clipping, AdamW moments, and
+    #: the fp32 master update are unchanged.  Applied uniformly in every
+    #: step variant (single-device and GSPMD pay the same round-trip
+    #: rounding, so numerics never depend on the execution mode); the only
+    #: information lost is sub-bf16 gradient precision, bounded by the
+    #: parity tests.  ``"float32"`` (default) is byte-identical to the
+    #: historical step.
+    grads_dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.grads_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f'grads_dtype={self.grads_dtype!r} must be "float32" or '
+                '"bfloat16"'
+            )
 
 
 def make_loss_fn(
@@ -61,8 +79,8 @@ def make_loss_fn(
         def stats_loss_fn(params, x, y):
             hidden, aux, act_stats = forward_hidden_stats(params, x, config)
             head_w = lm_head_weight(params, config)
-            if config.loss_chunk_size:
-                loss = lm_loss(hidden, head_w, y, config.loss_chunk_size)
+            if config.loss_chunk:
+                loss = lm_loss(hidden, head_w, y, config.loss_chunk)
             else:
                 loss = cross_entropy(head_logits(hidden, head_w), y)
             if is_moe:
@@ -71,7 +89,7 @@ def make_loss_fn(
 
         return stats_loss_fn
 
-    if config.loss_chunk_size:
+    if config.loss_chunk:
         from bpe_transformer_tpu.models.transformer import (
             forward_hidden,
             lm_head_weight,
@@ -81,7 +99,7 @@ def make_loss_fn(
         def loss_fn(params, x, y):
             hidden, aux = forward_hidden(params, x, config)
             loss = lm_loss(
-                hidden, lm_head_weight(params, config), y, config.loss_chunk_size
+                hidden, lm_head_weight(params, config), y, config.loss_chunk
             )
             if is_moe:
                 loss = loss + config.router_aux_weight * aux
@@ -118,6 +136,28 @@ def _reduce_act_stats(act_stats: dict, axis: str) -> dict:
         "nonfinite": jax.lax.psum(act_stats["nonfinite"], axis),
         "attn_entropy": jax.lax.pmean(act_stats["attn_entropy"], axis),
     }
+
+
+def _reduce_grads(grads, reduce_axis: str | None, grads_dtype: str):
+    """The gradient-reduction boundary shared by every non-ZeRO step body.
+
+    Under ``grads_dtype="bfloat16"`` the tree is rounded to bf16 just
+    before the dp ``pmean`` — the collective moves half the bytes — and
+    widened back to float32 for the clip/AdamW math.  The round-trip
+    applies even with no mapped axis (single device; GSPMD, where XLA owns
+    the collective placement and frequently schedules the derived
+    all-reduce on the narrowed values), so one ``grads_dtype`` means one
+    set of numerics across execution modes."""
+    narrow = jnp.dtype(grads_dtype)
+    if narrow != jnp.float32:
+        grads = jax.tree_util.tree_map(lambda g: g.astype(narrow), grads)
+    if reduce_axis is not None:
+        grads = jax.lax.pmean(grads, reduce_axis)
+    if narrow != jnp.float32:
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads
+        )
+    return grads
 
 
 def _check_zero1(zero1_shards, reduce_axis, health, dynamics, context):
@@ -167,6 +207,7 @@ def _zero1_update(params, opt_state, loss, grads, hparams, axis, n_shards):
         eps=hparams.eps,
         weight_decay=hparams.weight_decay,
         grad_clip_norm=hparams.grad_clip_norm,
+        grads_dtype=hparams.grads_dtype,
     )
     metrics = {
         "loss": loss.astype(jnp.float32),
@@ -240,8 +281,8 @@ def train_step_fn(
         else:
             loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
             moe_aux = None
+        grads = _reduce_grads(grads, reduce_axis, hparams.grads_dtype)
         if reduce_axis is not None:
-            grads = jax.lax.pmean(grads, reduce_axis)
             loss = jax.lax.pmean(loss, reduce_axis)
             if moe_aux is not None:
                 # The exported expert-balance stat must describe GLOBAL
@@ -407,8 +448,8 @@ def grad_accum_step_fn(
         loss, grads = accumulate_grads(
             jax.value_and_grad(loss_fn), params, xs, ys, accum_steps
         )
+        grads = _reduce_grads(grads, reduce_axis, hparams.grads_dtype)
         if reduce_axis is not None:
-            grads = jax.lax.pmean(grads, reduce_axis)
             loss = jax.lax.pmean(loss, reduce_axis)
 
         raw_grads = grads
@@ -538,7 +579,7 @@ def make_eval_step(config: ModelConfig) -> Callable:
     Honors ``loss_chunk_size`` so eval fits in the same memory envelope as
     the train step."""
 
-    if config.loss_chunk_size:
+    if config.loss_chunk:
         from bpe_transformer_tpu.models.transformer import (
             forward_hidden,
             lm_head_weight,
@@ -548,7 +589,7 @@ def make_eval_step(config: ModelConfig) -> Callable:
         def eval_loss(params, x, y):
             hidden, _ = forward_hidden(params, x, config)
             return lm_loss(
-                hidden, lm_head_weight(params, config), y, config.loss_chunk_size
+                hidden, lm_head_weight(params, config), y, config.loss_chunk
             )
 
     else:

@@ -27,7 +27,9 @@ def bench(monkeypatch, tmp_path):
     mod.ARGS.flash_block = None
     # The queue exports these; an inherited value would suffix every
     # capture path and fail the default-knob assertions spuriously.
-    for var in ("BENCH_FFN_IMPL", "BENCH_MOE_DISPATCH", "BENCH_REMAT"):
+    for var in ("BENCH_FFN_IMPL", "BENCH_MOE_DISPATCH", "BENCH_REMAT",
+                "BENCH_REMAT_POLICY", "BENCH_SCAN_LAYERS",
+                "BENCH_GRADS_DTYPE"):
         monkeypatch.delenv(var, raising=False)
     return mod
 
@@ -47,7 +49,16 @@ def test_capture_path_suffixes_every_guarded_knob(bench, monkeypatch):
     # not collide; ADVICE r4).
     assert "_ffn_pallas" in name
     assert "_b64" in name and "_blk512" in name
-    assert "_gather" in name and "_remat" in name
+    # BENCH_REMAT=1 is the deprecated alias for the full policy.
+    assert "_gather" in name and "_rp_full" in name
+
+
+def test_capture_path_suffixes_mfu_push_knobs(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_REMAT_POLICY", "save_attn")
+    monkeypatch.setenv("BENCH_SCAN_LAYERS", "1")
+    monkeypatch.setenv("BENCH_GRADS_DTYPE", "bfloat16")
+    name = bench._capture_path().name
+    assert "_rp_save_attn" in name and "_scan" in name and "_gbf16" in name
 
 
 def test_capture_path_moe_dispatch_default_tracks_preset(bench, monkeypatch):
@@ -74,11 +85,33 @@ def test_preset_moe_dispatch_mirror_in_sync(bench):
         assert bench._preset_moe_dispatch(name) == preset.moe_dispatch, name
 
 
-def test_capture_path_remat_not_a_deviation_for_gpt2_medium(bench, monkeypatch):
+def test_capture_path_remat_policy_tracks_preset_for_gpt2_medium(
+    bench, monkeypatch
+):
+    """gpt2-medium's preset policy moved to save_attn (PR 13): the bare
+    run stays unsuffixed, matching the policy explicitly is not a
+    deviation, and the deprecated BENCH_REMAT=1 (-> full) now IS one —
+    old full-remat captures must not replay for the new default."""
     bench.ARGS.config = "gpt2-medium"
     bench.ARGS.batch = 16
-    monkeypatch.setenv("BENCH_REMAT", "1")
     assert bench._capture_path().name == "tpu_capture_gpt2-medium.json"
+    monkeypatch.setenv("BENCH_REMAT_POLICY", "save_attn")
+    assert bench._capture_path().name == "tpu_capture_gpt2-medium.json"
+    monkeypatch.delenv("BENCH_REMAT_POLICY")
+    monkeypatch.setenv("BENCH_REMAT", "1")
+    assert bench._capture_path().name == "tpu_capture_gpt2-medium_rp_full.json"
+
+
+def test_preset_remat_policy_mirror_in_sync(bench):
+    """bench.py mirrors the presets' resolved remat policy without
+    importing the package (replay must not initialize jax)."""
+    from bpe_transformer_tpu import models
+
+    for name, (attr, *_rest) in bench.BENCH_CONFIGS.items():
+        preset = getattr(models, attr)
+        assert (
+            bench._preset_remat_policy(name) == preset.resolved_remat_policy
+        ), name
 
 
 def _fresh_result(bench, value=100.0, steps=100):

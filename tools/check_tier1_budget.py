@@ -41,9 +41,12 @@ REPO = Path(__file__).resolve().parent.parent
 DEFAULT_BUDGET_S = 800.0
 
 #: Tier-1 test-count ceiling for --collect mode.  ~430 tests ran in
-#: ~640 s at PR 10 on a 2-cpu runner (~1.5 s/test amortized); 520 keeps
-#: headroom while catching a silent 20%+ jump.
-DEFAULT_MAX_TESTS = 520
+#: ~640 s at PR 10 on a 2-cpu runner (~1.5 s/test amortized); the ceiling
+#: keeps headroom while catching a silent 20%+ jump.  Raised 520 -> 545
+#: in PR 13 (deliberately, per the policy above) for the 11 tier-1
+#: MFU-push tests (tests/test_mfu_push.py — remat-policy parity/ordering,
+#: bf16 collective bytes, donation audit, peak-HBM gate).
+DEFAULT_MAX_TESTS = 545
 
 #: Pytest summary trailer: "== 398 passed, 27 deselected in 612.34s =="
 #: (also plain "in 612.34s (0:10:12)" forms).
