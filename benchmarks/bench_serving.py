@@ -101,6 +101,71 @@ def _warmup(serving, config):
     return serving.stats()
 
 
+def _parse_prompt_mix(spec: str) -> tuple[int, int, float]:
+    """``--prompt-mix SHORT,LONG,LONG_FRAC`` (e.g. ``12,160,0.25``):
+    bimodal prompt lengths — the disaggregated-serving workload, where a
+    minority of long prompts is exactly what blows a monolithic
+    replica's decode p99."""
+    parts = spec.split(",")
+    if len(parts) != 3:
+        raise ValueError(
+            f"--prompt-mix wants SHORT,LONG,LONG_FRAC, got {spec!r}"
+        )
+    short, long_, frac = int(parts[0]), int(parts[1]), float(parts[2])
+    if short < 1 or long_ <= short or not 0.0 < frac < 1.0:
+        raise ValueError(
+            f"--prompt-mix needs 1 <= SHORT < LONG and 0 < LONG_FRAC < 1, "
+            f"got {spec!r}"
+        )
+    return short, long_, frac
+
+
+def _prompts_mix(rng, config, *, n_requests, new_tokens, short, long_, frac):
+    """Bimodal prompts: ``frac`` of requests at ~``long_`` tokens, the
+    rest at ~``short`` (±25% jitter so bucket ladders stay honest).
+    Returns ``(prompts, is_long flags)``."""
+    ctx = config.context_length
+    vocab = config.vocab_size
+    cap = max(ctx - new_tokens - 1, 2)
+    prompts, is_long = [], []
+    for _ in range(n_requests):
+        lng = rng.random() < frac
+        base = long_ if lng else short
+        n = int(rng.integers(max(1, (3 * base) // 4), (5 * base) // 4 + 1))
+        prompts.append(
+            [int(t) for t in rng.integers(0, vocab, size=min(n, cap))]
+        )
+        is_long.append(lng)
+    return prompts, is_long
+
+
+def _bucket_fields(results, is_long) -> dict:
+    """Per-bucket (short/long) and overall request + decode latency
+    percentiles — the row evidence `serve_open_disagg` is judged on:
+    disaggregation moves SHORT-bucket decode p99, which a monolithic mix
+    lets long prefills stall."""
+    out: dict = {}
+    lat = [r.queue_wait_s + r.prefill_s + r.decode_s for r in results]
+    dec = [r.decode_s for r in results]
+    out["decode_p50_s"] = round(_pctl(dec, 0.50), 4)
+    out["decode_p95_s"] = round(_pctl(dec, 0.95), 4)
+    out["decode_p99_s"] = round(_pctl(dec, 0.99), 4)
+    for label, flag in (("short", False), ("long", True)):
+        sel = [i for i, lng in enumerate(is_long) if lng is flag]
+        if not sel:
+            continue
+        for name, values in (
+            ("latency", [lat[i] for i in sel]),
+            ("decode", [dec[i] for i in sel]),
+        ):
+            for q, tag in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                out[f"{label}_{name}_{tag}_s"] = round(
+                    _pctl(values, q), 4
+                )
+        out[f"{label}_requests"] = len(sel)
+    return out
+
+
 def _prompts(rng, config, *, n_requests, new_tokens,
              shared_prefix_len=0, shared_prefix_frac=0.0):
     """Ragged prompts biased short (serving-shaped); a ``shared_prefix_len``
@@ -322,6 +387,164 @@ def run_open_loop(params, config, *, concurrency, n_requests, new_tokens,
         "shared_prefix_len": args.shared_prefix_len,
         "shared_prefix_frac": args.shared_prefix_frac,
         **extra,
+    }
+
+
+def run_open_fleet(params, config, *, concurrency, n_requests, new_tokens,
+                   qps, args, seed=0):
+    """Open-loop Poisson arrivals against a TWO-ENGINE in-process fleet
+    (equal engine count either way — the CPU smoke's stand-in for equal
+    chips):
+
+    * **monolithic** (default, ``--replicas 2``): requests round-robin
+      across N ``role="both"`` engines — every replica's decode ticks
+      share a worker loop with long-prompt prefills;
+    * **disaggregated** (``--disagg``): one prefill-role engine + one
+      decode-role engine wired through the real KV migration path —
+      long prompts (>= ``--prefill-threshold``) prefill on the prefill
+      engine, export as payload bytes, and graft onto the decode engine
+      (`submit_import`); short prompts bypass straight to the decode
+      engine.  The decode engine's ticks never wait behind a long
+      prefill, which is the whole point: compare ``decode_p99_s`` (and
+      the ``short_*`` bucket fields) across the two rows.
+
+    ``--prompt-mix`` supplies the bimodal lengths; rows carry per-bucket
+    p50/p95/p99 latency + decode fields.
+    """
+    import threading
+
+    from bpe_transformer_tpu.serving import Request, ServingEngine
+
+    short, long_, frac = _parse_prompt_mix(args.prompt_mix)
+    threshold = args.prefill_threshold or (short + long_) // 2
+    rng = np.random.default_rng(seed)
+    prompts, is_long = _prompts_mix(
+        rng, config, n_requests=n_requests, new_tokens=new_tokens,
+        short=short, long_=long_, frac=frac,
+    )
+    arrivals = np.cumsum(rng.exponential(1.0 / qps, size=n_requests))
+
+    def make(role):
+        return ServingEngine(
+            params, config, slots=concurrency, max_queue=n_requests + 1,
+            paged=True, block_size=args.block_size,
+            prefill_chunk=args.prefill_chunk,
+            prefill_token_budget=args.prefill_budget,
+            kv_dtype=None if args.kv_dtype == "act" else args.kv_dtype,
+            weight_dtype=(
+                None if args.weight_dtype == "act" else args.weight_dtype
+            ),
+            fused_sampling=args.fused_sampling,
+            role=role,
+        )
+
+    if args.disagg:
+        engines = [make("prefill"), make("decode")]
+    else:
+        engines = [make("both") for _ in range(args.replicas)]
+    for engine in engines:
+        engine.start()
+    try:
+        # Warm every engine's ladder so timed cells measure steady state
+        # (the decode engine warms tick+import through a real migration).
+        ctx = config.context_length
+        vocab = config.vocab_size
+        if args.disagg:
+            pre, dec = engines
+            for b in pre.engine.buckets:
+                plen = min(b, ctx - new_tokens - 1)
+                r = pre.generate(
+                    [(13 * b + i) % vocab for i in range(plen)],
+                    max_new_tokens=2, temperature=0.0, migrate=True,
+                    timeout=600,
+                )
+                if r.kv_payload is not None:
+                    dec.submit_import(r.kv_payload).result(timeout=600)
+            for b in dec.engine.buckets:  # short prompts prefill here
+                plen = min(b, ctx - new_tokens - 1)
+                dec.generate(
+                    [(29 * b + i) % vocab for i in range(plen)],
+                    max_new_tokens=2, temperature=0.0, timeout=600,
+                )
+        else:
+            for engine in engines:
+                for b in engine.engine.buckets:
+                    plen = min(b, ctx - new_tokens - 1)
+                    engine.generate(
+                        [(17 * b + i) % vocab for i in range(plen)],
+                        max_new_tokens=2, temperature=0.0, timeout=600,
+                    )
+
+        results: list = [None] * n_requests
+        errors: list = []
+
+        def serve_one(i: int, t0: float):
+            delay = arrivals[i] - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            req = dict(
+                max_new_tokens=new_tokens, temperature=1.0, top_k=50,
+                seed=i,
+            )
+            try:
+                if args.disagg:
+                    pre, dec = engines
+                    if len(prompts[i]) >= threshold:
+                        r = pre.generate(
+                            prompts[i], migrate=True, timeout=1800, **req
+                        )
+                        if r.finish_reason == "migrated":
+                            r = dec.submit_import(r.kv_payload).result(
+                                timeout=1800
+                            )
+                    else:
+                        r = dec.generate(prompts[i], timeout=1800, **req)
+                else:
+                    r = engines[i % len(engines)].generate(
+                        prompts[i], timeout=1800, **req
+                    )
+                results[i] = r
+            except Exception as exc:  # noqa: BLE001 — the row reports it
+                errors.append(repr(exc))
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=serve_one, args=(i, t0), daemon=True)
+            for i in range(n_requests)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=1900)
+        wall = time.perf_counter() - t0
+        done = [r for r in results if r is not None]
+        flags = [f for r, f in zip(results, is_long) if r is not None]
+        tokens = sum(len(r.token_ids) for r in done)
+        lat = [r.queue_wait_s + r.prefill_s + r.decode_s for r in done]
+        dec_stats = engines[-1].stats()
+        migrations = sum(e.stats()["migrations_in"] for e in engines)
+    finally:
+        for engine in engines:
+            engine.close()
+
+    return {
+        "wall_s": round(wall, 3),
+        "qps_target": qps,
+        "qps_achieved": round(len(done) / wall, 3) if wall else None,
+        "gen_tok_per_s": round(tokens / wall, 1),
+        "latency_p50_s": round(_pctl(lat, 0.50), 4),
+        "latency_p95_s": round(_pctl(lat, 0.95), 4),
+        "latency_p99_s": round(_pctl(lat, 0.99), 4),
+        **_bucket_fields(done, flags),
+        "requests": n_requests,
+        "completed": len(done),
+        "failed": n_requests - len(done),
+        "new_tokens": new_tokens,
+        "prompt_mix": args.prompt_mix,
+        "prefill_threshold": threshold if args.disagg else None,
+        "migrations": migrations,
+        "engines": len(engines),
+        "decode_compiled_programs": dec_stats["compiled_programs"],
     }
 
 
@@ -560,6 +783,30 @@ def main() -> int:
                         help="draft = the target's first N transformer "
                         "blocks (shared weights, zero extra memory; "
                         "with --speculate)")
+    parser.add_argument("--prompt-mix", default=None,
+                        metavar="SHORT,LONG,FRAC",
+                        help="open-loop bimodal prompt mix (needs --qps + "
+                        "--paged), e.g. 12,160,0.25: 25%% of prompts at "
+                        "~160 tokens, the rest at ~12 — rows carry "
+                        "per-bucket (short/long) p50/p95/p99 latency AND "
+                        "decode-latency fields, the disaggregation "
+                        "headline evidence")
+    parser.add_argument("--replicas", type=int, default=2,
+                        help="(with --prompt-mix) monolithic fleet size: "
+                        "N role=both engines served round-robin — the "
+                        "equal-engine-count baseline --disagg is judged "
+                        "against")
+    parser.add_argument("--disagg", action="store_true",
+                        help="(with --prompt-mix) disaggregated fleet: "
+                        "one prefill-role + one decode-role engine wired "
+                        "through the real KV migration path — long "
+                        "prompts prefill on the prefill engine and graft "
+                        "onto the decode engine, short prompts bypass; "
+                        "compare decode_p99_s vs the monolithic row")
+    parser.add_argument("--prefill-threshold", type=int, default=None,
+                        help="(with --disagg) prompt-token threshold for "
+                        "the two-tier path (default: midpoint of the "
+                        "prompt mix)")
     parser.add_argument("--restart", action="store_true",
                         help="restart-to-traffic mode: time a replica "
                         "from spawn to first token through the router "
@@ -575,6 +822,13 @@ def main() -> int:
         return 2
     if args.speculate and not args.paged:
         print("--speculate needs --paged", file=sys.stderr)
+        return 2
+    if args.disagg and not args.prompt_mix:
+        print("--disagg needs --prompt-mix", file=sys.stderr)
+        return 2
+    if args.prompt_mix and (args.qps is None or not args.paged):
+        print("--prompt-mix needs --qps (open loop) and --paged "
+              "(KV migration lives in the block pool)", file=sys.stderr)
         return 2
 
     if args.restart:
@@ -608,7 +862,17 @@ def main() -> int:
     for concurrency in levels:
         n_requests = args.requests or 4 * concurrency
         try:
-            if args.qps is not None:
+            if args.prompt_mix:
+                cell = run_open_fleet(
+                    params, config,
+                    concurrency=concurrency,
+                    n_requests=n_requests,
+                    new_tokens=new_tokens,
+                    qps=args.qps,
+                    args=args,
+                )
+                mode = f"qps={args.qps},mix={args.prompt_mix}"
+            elif args.qps is not None:
                 cell = run_open_loop(
                     params, config,
                     concurrency=concurrency,
@@ -632,7 +896,12 @@ def main() -> int:
                   file=sys.stderr)
             continue
         measured_any = True
-        engine = "paged" if args.paged else "dense"
+        if args.prompt_mix:
+            engine = (
+                "disagg" if args.disagg else f"mono-x{args.replicas}"
+            )
+        else:
+            engine = "paged" if args.paged else "dense"
         if args.paged and args.kv_dtype != "act":
             engine += f"-{args.kv_dtype}"
         if args.decode_attention:

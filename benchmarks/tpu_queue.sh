@@ -344,6 +344,25 @@ run_job serve_open_w8_spec 900 "$CAP/serving_paged.jsonl" \
   --paged --block-size 16 --prefill-chunk 64 --prefill-budget 128 \
   --speculate 4 --draft-layers 1 --weight-dtype int8 --fused-sampling
 
+# Disaggregated prefill/decode serving (ISSUE 15): the bimodal long/short
+# prompt mix served (a) by TWO monolithic role=both engines round-robin
+# and (b) by one prefill-role + one decode-role engine wired through the
+# KV migration path — equal engine count, same Poisson arrivals.  Rows
+# carry per-bucket p50/p95/p99 latency + decode fields and the decode
+# engine's compiled-program count (the no-chunk-ladder bound); the
+# self-report at the end diffs decode_p99_s — the number disaggregation
+# exists to move — and posts it next to the serve_open_pnative headline.
+run_job serve_open_mix_mono 900 "$CAP/serving_disagg.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --concurrency 8 --requests 64 --qps 8 --paged --block-size 16 \
+  --prefill-chunk 64 --prefill-budget 128 \
+  --prompt-mix 12,160,0.25 --replicas 2
+run_job serve_open_disagg 900 "$CAP/serving_disagg.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --concurrency 8 --requests 64 --qps 8 --paged --block-size 16 \
+  --prefill-chunk 64 --prefill-budget 128 \
+  --prompt-mix 12,160,0.25 --disagg
+
 # Restart-to-traffic (ROADMAP item 5): one row timing a serve replica
 # from SPAWN to first token through the router's rejoin path, cold vs
 # `bpe-tpu warmup`-warmed compile cache — the rolling-deploy window.
@@ -806,6 +825,63 @@ print("  ".join(parts))
 PY
 )
   [ -n "$W8_LINE" ] && log "int8-weight decode self-report: $W8_LINE"
+fi
+# Disaggregated-serving self-report (jax-free, CPU-only): the newest
+# disagg row vs the monolithic equal-engine-count row under the same
+# bimodal Poisson mix — decode p99 (overall and short-bucket) is the
+# headline disaggregation exists to move; migrations>0 proves the
+# two-tier path actually carried the long prompts, and the decode
+# engine's compiled-program count pins the no-chunk-ladder claim.  Judged
+# next to the serve_open_pnative headline (NOTE: replayed-capture caveat
+# — BENCH_r03/r04 are a 2026-07-31 replay; drain this queue on a live
+# chip window before claiming any cross-PR win).
+if [ -s "$CAP/serving_disagg.jsonl" ]; then
+  DISAGG_LINE=$(env JAX_PLATFORMS=cpu python - "$CAP/serving_disagg.jsonl" <<'PY'
+import json, sys
+
+disagg = mono = None
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if not ln:
+        continue
+    try:
+        r = json.loads(ln)
+    except json.JSONDecodeError:
+        continue
+    if "prompt_mix" not in r:
+        continue
+    if r.get("engine", "").startswith("disagg"):
+        disagg = r  # newest disagg row wins
+    elif r.get("engine", "").startswith("mono"):
+        mono = r
+if disagg is None:
+    sys.exit(0)
+
+
+def num(v, d=4):
+    return f"{v:,.{d}g}" if isinstance(v, (int, float)) else "n/a"
+
+
+parts = [
+    f"decode p99 {num(disagg.get('decode_p99_s'))}s"
+    + (f" (mono {num(mono.get('decode_p99_s'))}s)" if mono else ""),
+    f"short-bucket decode p99 {num(disagg.get('short_decode_p99_s'))}s"
+    + (f" (mono {num(mono.get('short_decode_p99_s'))}s)" if mono else ""),
+    f"p99 {num(disagg.get('latency_p99_s'))}s"
+    + (f" (mono {num(mono.get('latency_p99_s'))}s)" if mono else ""),
+    f"migrations {disagg.get('migrations')}",
+    f"decode-engine programs {disagg.get('decode_compiled_programs')}",
+    f"failed {disagg.get('failed')}",
+]
+dp, mp = disagg.get("decode_p99_s"), (mono or {}).get("decode_p99_s")
+if isinstance(dp, (int, float)) and isinstance(mp, (int, float)) and dp >= mp:
+    parts.append("WARNING: disaggregated decode p99 NOT below monolithic")
+if not disagg.get("migrations"):
+    parts.append("WARNING: no migrations — the two-tier path never ran")
+print("  ".join(parts))
+PY
+)
+  [ -n "$DISAGG_LINE" ] && log "disaggregated-serving self-report: $DISAGG_LINE"
 fi
 # Restart-to-traffic self-report (jax-free, CPU-only): the newest restart
 # row's cold vs warmed spawn->first-token seconds — ROADMAP item 5's

@@ -18,10 +18,16 @@ that with **paged memory** (the vLLM formulation, TPU-shaped):
   (admit / tick / release, one jitted tick, bounded compile count) on top
   of the block pool, with **chunked prefill** — long prompts prefill in
   fixed-size chunks the serving worker interleaves with decode ticks so
-  heavy prefill traffic cannot starve decode latency.
+  heavy prefill traffic cannot starve decode latency;
+- `migrate`  — the jax-free KV migration payload codec (ISSUE 15):
+  `PagedEngine.export_slot` serializes a slot (block rows + scale rows +
+  generation state) into a self-describing payload, `import_slot` grafts
+  it into another replica's pool bit-for-bit — the transport under
+  disaggregated prefill/decode serving and drain evacuation.
 
-`blocks` and `radix` import no jax (the router and tests reason about
-them on chip-free hosts); `paged_engine` owns the device programs.
+`blocks`, `radix`, and `migrate` import no jax (the router and tests
+reason about them on chip-free hosts); `paged_engine` owns the device
+programs.
 """
 
 from bpe_transformer_tpu._lazy import lazy_attrs
@@ -33,6 +39,10 @@ __getattr__ = lazy_attrs(
         "NoFreeBlocksError": "blocks",
         "RadixPrefixCache": "radix",
         "PagedEngine": "paged_engine",
+        "payload_to_bytes": "migrate",
+        "payload_from_bytes": "migrate",
+        "payload_nbytes": "migrate",
+        "synthetic_decode_payload": "migrate",
     },
 )
 
@@ -41,4 +51,8 @@ __all__ = [
     "NoFreeBlocksError",
     "PagedEngine",
     "RadixPrefixCache",
+    "payload_from_bytes",
+    "payload_nbytes",
+    "payload_to_bytes",
+    "synthetic_decode_payload",
 ]

@@ -1,0 +1,201 @@
+"""KV-slot migration payloads: the self-describing wire format that moves
+one in-flight generation between replicas (ISSUE 15, disaggregated
+prefill/decode serving).
+
+A payload is everything another replica needs to continue a generation
+bit-for-bit: the slot's *geometry* (block size, pool dtype, model KV
+shape — validated against the importing engine before any block is
+allocated), its *KV rows* (the referenced pool blocks, gathered per
+block; int8 pools ship their per-block-per-head scale rows alongside),
+and its *state machine* (prompt, prefill frontier for mid-prefill
+migrations, or the full decode state — pending token, position, RNG key,
+sampling knobs — for finished prefixes).  ``PagedEngine.export_slot``
+builds one, ``PagedEngine.import_slot`` grafts one; this module owns the
+host-side dict <-> bytes codec so the HTTP transport (``/kv/export`` ->
+``/kv/import``), the router, and the in-process drain-evacuation path all
+speak the same format.
+
+The byte format is deliberately boring — magic + JSON header + raw
+little-endian array bytes — so it is decodable with numpy alone (no
+pickle, no jax): the router can size/forward payloads opaquely, and a
+corrupted or truncated body fails loudly at the header/length checks
+rather than grafting garbage KV.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+try:  # bfloat16 payload rows need the ml_dtypes numpy extension (jax
+    # ships it); pure-numpy hosts still decode f32/int8 payloads fine.
+    import ml_dtypes  # noqa: F401
+except ImportError:
+    pass
+
+__all__ = [
+    "PAYLOAD_MAGIC",
+    "payload_to_bytes",
+    "payload_from_bytes",
+    "payload_nbytes",
+    "synthetic_decode_payload",
+]
+
+#: Format magic + version.  Bump the digits on any incompatible layout
+#: change — import refuses unknown versions instead of misreading rows.
+PAYLOAD_MAGIC = b"BPEKV001"
+
+
+def payload_to_bytes(payload: dict) -> bytes:
+    """Serialize an ``export_slot`` payload: magic, an 8-byte little-endian
+    header length, the JSON header (meta + array manifest), then each
+    array's raw bytes in manifest order."""
+    meta = payload["meta"]
+    manifest: list[dict] = []
+    chunks: list[bytes] = []
+    for i, layer in enumerate(payload["layers"]):
+        for name in sorted(layer):
+            arr = np.ascontiguousarray(layer[name])
+            manifest.append(
+                {
+                    "key": f"L{i}/{name}",
+                    "dtype": str(arr.dtype),
+                    "shape": list(arr.shape),
+                }
+            )
+            chunks.append(arr.tobytes())
+    header = json.dumps(
+        {"meta": meta, "arrays": manifest}, separators=(",", ":")
+    ).encode("utf-8")
+    return b"".join(
+        [PAYLOAD_MAGIC, len(header).to_bytes(8, "little"), header] + chunks
+    )
+
+
+def payload_from_bytes(data: bytes) -> dict:
+    """Decode :func:`payload_to_bytes` output back into the payload dict.
+    Raises ``ValueError`` on a bad magic, version, or truncated body."""
+    if not data.startswith(PAYLOAD_MAGIC[:5]):
+        raise ValueError("not a KV migration payload (bad magic)")
+    if not data.startswith(PAYLOAD_MAGIC):
+        raise ValueError(
+            f"unsupported KV payload version {data[:8]!r} "
+            f"(expected {PAYLOAD_MAGIC!r})"
+        )
+    off = len(PAYLOAD_MAGIC)
+    if len(data) < off + 8:
+        raise ValueError("truncated KV payload (no header length)")
+    hlen = int.from_bytes(data[off: off + 8], "little")
+    off += 8
+    if len(data) < off + hlen:
+        raise ValueError("truncated KV payload (header)")
+    try:
+        header = json.loads(data[off: off + hlen])
+    except json.JSONDecodeError as exc:
+        raise ValueError(f"corrupt KV payload header: {exc}") from None
+    off += hlen
+    meta = header["meta"]
+    layers: list[dict] = [{} for _ in range(int(meta["num_layers"]))]
+    for spec in header["arrays"]:
+        dtype = np.dtype(spec["dtype"])
+        shape = tuple(int(d) for d in spec["shape"])
+        nbytes = dtype.itemsize * int(np.prod(shape)) if shape else dtype.itemsize
+        if len(data) < off + nbytes:
+            raise ValueError(
+                f"truncated KV payload (array {spec['key']})"
+            )
+        arr = np.frombuffer(data, dtype=dtype, count=int(np.prod(shape)),
+                            offset=off).reshape(shape)
+        off += nbytes
+        layer_idx, name = spec["key"].split("/", 1)
+        idx = int(layer_idx[1:])
+        if not 0 <= idx < len(layers):
+            raise ValueError(
+                f"corrupt KV payload: array {spec['key']!r} names layer "
+                f"{idx} of {len(layers)}"
+            )
+        layers[idx][name] = arr
+    return {"meta": meta, "layers": layers}
+
+
+def payload_nbytes(payload: dict) -> int:
+    """Raw KV bytes a payload carries (rows + scales, header excluded) —
+    the transfer-size gauge the migration telemetry reports."""
+    return sum(
+        int(np.asarray(arr).nbytes)
+        for layer in payload["layers"]
+        for arr in layer.values()
+    )
+
+
+def synthetic_decode_payload(
+    config,
+    *,
+    block_size: int,
+    kv_dtype: str,
+    prompt_len: int = 8,
+    max_new_tokens: int = 3,
+    seed: int = 0,
+) -> dict:
+    """A zero-KV decode-state payload shaped for ``import_slot`` — what
+    ``bpe-tpu warmup --role decode`` grafts so a decode-role node compiles
+    its tick + import copy programs WITHOUT ever touching the chunk
+    ladder (the rows are zeros; warmup only cares about program shapes).
+
+    ``config`` is duck-typed (any object with ``num_layers`` /
+    ``num_heads`` / ``num_kv_heads`` / ``d_head`` / ``context_length`` /
+    ``activation_dtype``); ``kv_dtype`` is the pool label — ``"int8"`` or
+    the activation dtype name, exactly as ``PagedEngine.kv_dtype``
+    reports it.
+    """
+    kv_heads = config.num_kv_heads or config.num_heads
+    span = min(prompt_len + max_new_tokens, config.context_length)
+    n_blocks = -(-span // block_size)
+    store = "int8" if kv_dtype == "int8" else kv_dtype
+    layers = []
+    for _ in range(config.num_layers):
+        layer = {
+            "k": np.zeros(
+                (n_blocks, kv_heads, block_size, config.d_head),
+                np.dtype(store),
+            ),
+            "v": np.zeros(
+                (n_blocks, kv_heads, block_size, config.d_head),
+                np.dtype(store),
+            ),
+        }
+        if kv_dtype == "int8":
+            layer["k_scale"] = np.zeros((n_blocks, kv_heads), np.float32)
+            layer["v_scale"] = np.zeros((n_blocks, kv_heads), np.float32)
+        layers.append(layer)
+    prompt = [1] * prompt_len
+    meta = {
+        "format": 1,
+        "block_size": block_size,
+        "kv_dtype": kv_dtype,
+        "num_layers": config.num_layers,
+        "kv_heads": kv_heads,
+        "d_head": config.d_head,
+        "context_length": config.context_length,
+        "n_blocks": n_blocks,
+        "prompt": prompt,
+        "prompt_len": prompt_len,
+        "next_pos": prompt_len,
+        "decoding": True,
+        "generated": 1,
+        "max_new_tokens": max_new_tokens,
+        "stop_id": None,
+        "seed": seed,
+        "temperature": 0.0,
+        "top_k": 0,
+        "top_p": 2.0,
+        "token": 1,
+        "position": prompt_len,
+        # PRNGKey(seed) for small seeds is [seed >> 32, seed & 0xffffffff].
+        "key": [seed >> 32, seed & 0xFFFFFFFF],
+        "request_id": None,
+        "emitted": [1],
+        "history": prompt + [1],
+    }
+    return {"meta": meta, "layers": layers}

@@ -136,6 +136,26 @@ def _copy_block_program(pool, src, dst):
     ]
 
 
+def _extract_block_program(pool, src):
+    """Read one block's rows out of the pool (K/V + int8 scale rows) —
+    the device half of :meth:`PagedEngine.export_slot`.  ``src`` is a
+    traced scalar, so every block of every export shares ONE compiled
+    program regardless of chain length."""
+    return [{name: arr[src] for name, arr in layer.items()} for layer in pool]
+
+
+def _inject_block_program(pool, rows, dst):
+    """Write one migrated block's rows into the pool at block ``dst`` —
+    the device half of :meth:`PagedEngine.import_slot` (the
+    `_copy_block_program` idiom with host-supplied rows).  ``dst`` is a
+    traced scalar and ``rows`` mirrors the pool's per-layer dict
+    structure, so every grafted block shares ONE compiled program."""
+    return [
+        {name: arr.at[dst].set(row[name]) for name, arr in layer.items()}
+        for layer, row in zip(pool, rows)
+    ]
+
+
 @dataclasses.dataclass
 class PagedSlotInfo:
     """Host-side bookkeeping for one occupied slot (prefill + decode)."""
@@ -299,8 +319,22 @@ class PagedEngine:
             )
         )
         # Copy-on-write block copy (rewind into a shared block): compiled
-        # only the first time a CoW rewind actually runs.
-        self._copy_jit = jax.jit(_copy_block_program)
+        # only the first time a CoW rewind actually runs.  Per-engine
+        # partial for the same reason as the migration jits below — a
+        # bare ``jax.jit(fn)`` shares one cache across engines (keyed by
+        # function identity), which would make compiled_programs() read
+        # ANOTHER engine's CoW compile as this engine's.
+        self._copy_jit = jax.jit(functools.partial(_copy_block_program))
+        # KV migration halves (ISSUE 15): per-block extract (export) and
+        # inject (import) — each compiled only when a migration runs, and
+        # ONCE regardless of chain length (traced block ids).  Wrapped in
+        # per-engine partials so compiled_programs() stays an exact
+        # per-engine counter (bare ``jax.jit(fn)`` wrappers share one
+        # cache across engines, keyed by function identity).
+        self._extract_jit = jax.jit(
+            functools.partial(_extract_block_program)
+        )
+        self._inject_jit = jax.jit(functools.partial(_inject_block_program))
 
         self.ticks = 0
         self.tokens_emitted = 0
@@ -319,11 +353,16 @@ class PagedEngine:
         """XLA programs compiled by this engine so far — bounded by
         ``len(self.buckets) + 1`` (one chunk program per bucket + the
         tick), plus one more once a copy-on-write :meth:`rewind` has
-        run."""
+        run, and one each for the migration extract/inject programs once
+        an :meth:`export_slot`/:meth:`import_slot` has run (a pure
+        decode-role replica therefore stays within tick + inject — the
+        chunk ladder never compiles there)."""
         return (
             self._chunk_jit._cache_size()
             + self._tick_jit._cache_size()
             + self._copy_jit._cache_size()
+            + self._extract_jit._cache_size()
+            + self._inject_jit._cache_size()
         )
 
     def bucket_for(self, length: int) -> int:
@@ -536,6 +575,243 @@ class PagedEngine:
                 cow = True
         info.shared_len = min(info.shared_len, new_len)
         return {"released": released, "cow": cow}
+
+    # ------------------------------------------------------------ migration
+
+    def export_slot(self, slot: int, extra_meta: dict | None = None) -> dict:
+        """Serialize ``slot`` into a self-describing migration payload
+        (ISSUE 15): the slot's pool rows (per block, through one compiled
+        extract program; int8 pools ship their per-block-per-head scale
+        rows alongside) plus everything needed to continue the generation
+        bit-for-bit on another replica — the prompt, the prefill frontier
+        (mid-prefill exports allowed), and, for finished prefixes, the
+        full decode state including the RNG key, so greedy AND seeded
+        sampling round-trip token-identically.
+
+        Strictly read-only: refcounts, the radix index, and every pool row
+        are untouched — a radix-shared source block is never mutated (or
+        released) by exporting a slot that references it.  The caller owns
+        releasing the slot once the payload has landed.  ``extra_meta``
+        (serving-layer fields: emitted tokens, timings, the token history
+        a speculative importer re-prefills its draft from) is merged into
+        the payload meta.
+        """
+        info = self._slots[slot]
+        if info is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        decoding = bool(self._active[slot])
+        if not decoding and slot not in self._prefilling:
+            raise ValueError(f"slot {slot} has no exportable state")
+        # Ship only WRITTEN blocks: the chain holds the admission's
+        # worst-case reservation, but rows beyond the written frontier
+        # (decode: positions < position; mid-prefill: < next_pos) are
+        # recycled garbage the importer re-reserves locally — shipping
+        # them would inflate the transfer (the disaggregated path's
+        # dominant cost) with bytes nobody reads.
+        frontier = int(self._positions[slot]) if decoding else info.next_pos
+        n_written = -(-frontier // self.block_size)
+        ids = info.block_ids[:n_written]
+        per_block = [
+            jax.tree_util.tree_map(
+                np.asarray, self._extract_jit(self._pool, np.int32(bid))
+            )
+            for bid in ids
+        ]
+        layers = [
+            {
+                name: np.stack([blk[li][name] for blk in per_block])
+                for name in per_block[0][li]
+            }
+            for li in range(len(self._pool))
+        ] if per_block else [
+            {name: np.zeros((0,) + tuple(arr.shape[1:]), arr.dtype)
+             for name, arr in layer.items()}
+            for layer in self._pool
+        ]
+        kv_heads = self.config.num_kv_heads or self.config.num_heads
+        meta = {
+            "format": 1,
+            "block_size": self.block_size,
+            "kv_dtype": self.kv_dtype,
+            "num_layers": self.config.num_layers,
+            "kv_heads": kv_heads,
+            "d_head": self.config.d_head,
+            "context_length": self.config.context_length,
+            "n_blocks": len(ids),
+            "prompt": [int(t) for t in info.prompt],
+            "prompt_len": info.prompt_len,
+            "next_pos": info.next_pos,
+            "decoding": decoding,
+            "generated": info.generated,
+            "max_new_tokens": info.max_new_tokens,
+            "stop_id": info.stop_id,
+            "seed": info.seed,
+            "temperature": float(info.temp_enc),
+            "top_k": int(info.top_k_enc),
+            "top_p": float(info.top_p_enc),
+            "token": int(self._tokens[slot]),
+            "position": int(self._positions[slot]),
+            "key": [int(k) for k in self._keys[slot]],
+            "request_id": info.request_id,
+        }
+        if extra_meta:
+            meta.update(extra_meta)
+        return {"meta": meta, "layers": layers}
+
+    def validate_import_meta(self, meta: dict) -> None:
+        """Reject a payload this engine cannot graft — geometry or pool
+        dtype mismatch is a configuration error, caught before any block
+        is allocated (HTTP 400, not a half-grafted slot)."""
+        if meta.get("format") != 1:
+            raise ValueError(
+                f"unsupported payload format {meta.get('format')!r}"
+            )
+        kv_heads = self.config.num_kv_heads or self.config.num_heads
+        expect = {
+            "block_size": self.block_size,
+            "kv_dtype": self.kv_dtype,
+            "num_layers": self.config.num_layers,
+            "kv_heads": kv_heads,
+            "d_head": self.config.d_head,
+            "context_length": self.config.context_length,
+        }
+        for key, want in expect.items():
+            got = meta.get(key)
+            if got != want:
+                raise ValueError(
+                    f"payload {key}={got!r} does not match this engine's "
+                    f"{want!r}"
+                )
+        if meta["n_blocks"] > self.blocks_per_slot:
+            raise ValueError(
+                f"payload carries {meta['n_blocks']} blocks; a slot here "
+                f"holds at most {self.blocks_per_slot}"
+            )
+        need = max(
+            meta["n_blocks"],
+            self.blocks_needed(meta["prompt_len"], meta["max_new_tokens"]),
+        )
+        if need > self.allocator.usable_blocks:
+            # Could NEVER land (parking would deadlock the import queue).
+            raise ValueError(
+                f"grafting needs {need} KV blocks; the pool holds "
+                f"{self.allocator.usable_blocks}"
+            )
+        if not meta["decoding"] and meta["next_pos"] % self.block_size:
+            raise ValueError(
+                f"mid-prefill frontier {meta['next_pos']} is not "
+                f"block-aligned (block_size={self.block_size})"
+            )
+
+    def validate_import_payload(self, payload: dict) -> None:
+        """:meth:`validate_import_meta` plus a STRUCTURAL check of the
+        shipped arrays against the meta — a payload whose header parses
+        but whose rows are inconsistent (wrong shape/dtype, missing
+        scale arrays, short block dimension) must fail at the transport
+        (HTTP 400) rather than inside the worker thread, where the
+        resulting inject error would kill the replica and leak the
+        freshly allocated chain."""
+        meta = payload["meta"]
+        self.validate_import_meta(meta)
+        layers = payload["layers"]
+        if len(layers) != self.config.num_layers:
+            raise ValueError(
+                f"payload ships {len(layers)} layers; this engine has "
+                f"{self.config.num_layers}"
+            )
+        names = set(self._pool[0])
+        n = int(meta["n_blocks"])
+        for li, (layer, pool_layer) in enumerate(zip(layers, self._pool)):
+            if set(layer) != names:
+                raise ValueError(
+                    f"payload layer {li} arrays {sorted(layer)} do not "
+                    f"match the pool's {sorted(names)}"
+                )
+            for name, arr in layer.items():
+                want_shape = (n,) + tuple(pool_layer[name].shape[1:])
+                want_dtype = pool_layer[name].dtype
+                arr = np.asarray(arr)
+                if tuple(arr.shape) != want_shape or arr.dtype != want_dtype:
+                    raise ValueError(
+                        f"payload layer {li} array {name!r} is "
+                        f"{arr.dtype}{tuple(arr.shape)}; this pool wants "
+                        f"{want_dtype}{want_shape}"
+                    )
+
+    def import_slot(self, payload: dict) -> int:
+        """Graft a migration payload into this pool: fresh blocks
+        allocated (prefix-cache LRU leaves evicted to cover a shortfall,
+        :class:`NoFreeBlocksError` raised when the pool still cannot —
+        the caller parks and retries), rows scattered via one compiled
+        per-block inject program, and the generation state restored so
+        the next :meth:`tick` (or :meth:`prefill_step`, for mid-prefill
+        payloads) continues bit-for-bit.  A finished prefix's full prompt
+        blocks are indexed into the radix cache, so migrated sessions
+        seed prefix sharing on their new home.  Returns the slot."""
+        meta = payload["meta"]
+        self.validate_import_payload(payload)
+        free = [s for s in range(self.n_slots) if self._slots[s] is None]
+        if not free:
+            raise RuntimeError("no free slot")
+        slot = free[0]
+        # The payload ships only WRITTEN blocks; the rest of the
+        # admission's worst-case reservation is re-reserved locally
+        # (fresh blocks, no inject — their rows get written by this
+        # replica's own chunks/ticks).
+        n = int(meta["n_blocks"])
+        chain = max(
+            n,
+            self.blocks_needed(
+                int(meta["prompt_len"]), int(meta["max_new_tokens"])
+            ),
+        )
+        fresh = self._alloc_blocks(chain)
+        self._tables[slot, :chain] = fresh
+        self._tables[slot, chain:] = 0
+        for i, dst in enumerate(fresh[:n]):
+            rows = [
+                {name: arr[i] for name, arr in layer.items()}
+                for layer in payload["layers"]
+            ]
+            self._pool = self._inject_jit(self._pool, rows, np.int32(dst))
+
+        prompt = np.asarray(meta["prompt"], np.int32)
+        plen = int(meta["prompt_len"])
+        info = PagedSlotInfo(
+            prompt=prompt,
+            prompt_len=plen,
+            bucket=self.bucket_for(min(plen, self.prefill_chunk)),
+            max_new_tokens=int(meta["max_new_tokens"]),
+            stop_id=meta["stop_id"],
+            seed=int(meta["seed"]),
+            temp_enc=np.float32(meta["temperature"]),
+            top_k_enc=np.int32(meta["top_k"]),
+            top_p_enc=np.float32(meta["top_p"]),
+            block_ids=fresh,
+            shared_len=0,
+            next_pos=int(meta["next_pos"]),
+            generated=int(meta["generated"]),
+            request_id=meta.get("request_id"),
+        )
+        self._slots[slot] = info
+        if meta["decoding"]:
+            self._tokens[slot] = int(meta["token"])
+            self._positions[slot] = int(meta["position"])
+            self._keys[slot] = np.asarray(meta["key"], np.uint32)
+            self._temps[slot] = info.temp_enc
+            self._top_ks[slot] = info.top_k_enc
+            self._top_ps[slot] = info.top_p_enc
+            self._active[slot] = True
+            if self.prefix_cache is not None:
+                full = plen // self.block_size
+                if full:
+                    self.prefix_cache.insert(
+                        [int(t) for t in prompt[: full * self.block_size]],
+                        fresh[:full],
+                    )
+        else:
+            self._prefilling.append(slot)
+        return slot
 
     def begin(
         self,

@@ -37,8 +37,12 @@ DEFAULT_BUCKETS = (
 )
 
 #: Request finish reasons (serving/server.py Result.finish_reason) — the
-#: label set is closed so counter series never explode.
-FINISH_REASONS = ("stop", "length", "deadline", "cancelled", "error")
+#: label set is closed so counter series never explode.  ``migrated``:
+#: the request's finished prefix left this replica as a KV payload
+#: (disaggregated prefill role, or drain evacuation) — the generation
+#: continues elsewhere, so it is neither a success nor a failure here.
+FINISH_REASONS = ("stop", "length", "deadline", "cancelled", "error",
+                  "migrated")
 
 
 class LatencyHistogram:
@@ -108,9 +112,13 @@ class ServingMetrics:
         #: (the whole request).  Request-level latencies live ONLY here,
         #: never as spans: the report's per-request assembly sums a
         #: request's phase spans, and a total span would double-count.
+        #: ``migration`` observes the end-to-end export->transfer->import
+        #: wall of each INBOUND graft (the importing side holds the whole
+        #: timeline) — the compare gate's migration_p99_s row.
         self.phases: dict[str, LatencyHistogram] = {
             phase: LatencyHistogram()
-            for phase in ("queue_wait", "prefill", "decode", "ttfb", "total")
+            for phase in ("queue_wait", "prefill", "decode", "ttfb",
+                          "total", "migration")
         }
         #: Per-prefill-bucket work accounting: bucket length ->
         #: [requests, prompt tokens, seconds, compiles] — the /metrics
@@ -125,6 +133,13 @@ class ServingMetrics:
         #: wall seconds those ticks took (throughput = tokens / seconds).
         self.decode_tokens = 0
         self.decode_seconds = 0.0
+        #: KV migration traffic (ISSUE 15): sessions and payload bytes
+        #: that LEFT this replica (prefill-role exports + drain
+        #: evacuations) and that ARRIVED (grafted imports).
+        self.migrations_out = 0
+        self.migrations_in = 0
+        self.migration_bytes_out = 0
+        self.migration_bytes_in = 0
         self._max_errors = max_errors
         self._errors: list[dict] = []
 
@@ -176,6 +191,17 @@ class ServingMetrics:
         with self._lock:
             self.decode_tokens += int(tokens)
             self.decode_seconds += max(float(seconds), 0.0)
+
+    def on_migration(self, direction: str, nbytes: int) -> None:
+        """Account one KV-slot migration: ``direction`` is ``"out"``
+        (export/evacuation leaving this replica) or ``"in"`` (graft)."""
+        with self._lock:
+            if direction == "out":
+                self.migrations_out += 1
+                self.migration_bytes_out += int(nbytes)
+            else:
+                self.migrations_in += 1
+                self.migration_bytes_in += int(nbytes)
 
     def record_error(self, error: str, **attrs) -> None:
         """Append to the last-error ring buffer (oldest evicted)."""
@@ -235,6 +261,10 @@ class ServingMetrics:
                     if self.decode_seconds > 0
                     else None
                 ),
+                "migrations_out": self.migrations_out,
+                "migrations_in": self.migrations_in,
+                "migration_bytes_out": self.migration_bytes_out,
+                "migration_bytes_in": self.migration_bytes_in,
             }
 
 
@@ -299,6 +329,10 @@ def render_prometheus(
         }
         decode_tokens = metrics.decode_tokens
         decode_seconds = metrics.decode_seconds
+        migrations = (
+            metrics.migrations_out, metrics.migrations_in,
+            metrics.migration_bytes_out, metrics.migration_bytes_in,
+        )
     emit("uptime_seconds", "gauge", "Seconds since the serving engine started.",
          [({}, round(metrics.uptime_s(), 3))])
     emit("requests_submitted_total", "counter",
@@ -321,8 +355,10 @@ def render_prometheus(
     lines.append(
         f"# HELP {prefix}_request_phase_seconds "
         "Per-request phase latency (queue_wait | prefill | decode | "
-        "ttfb | total; ttfb/total are request-level: wait+prefill and "
-        "the whole request — the fleet SLO layer's good-event evidence)."
+        "ttfb | total | migration; ttfb/total are request-level: "
+        "wait+prefill and the whole request — the fleet SLO layer's "
+        "good-event evidence; migration is the export->transfer->import "
+        "wall of each inbound KV graft)."
     )
     lines.append(f"# TYPE {prefix}_request_phase_seconds histogram")
     for suffix, labels, value in samples:
@@ -365,6 +401,20 @@ def render_prometheus(
              "Cumulative decode token throughput.",
              [({}, round(decode_tokens / decode_seconds, 3))])
 
+    # KV migration traffic (ISSUE 15): how many sessions left/arrived as
+    # KV payloads, and the bytes moved — the disaggregated fleet's
+    # transport volume, foldable by `bpe-tpu fleet`.
+    emit("migrations_out_total", "counter",
+         "Sessions exported as KV payloads (prefill-role handoffs + "
+         "drain evacuations).", [({}, migrations[0])])
+    emit("migrations_in_total", "counter",
+         "Sessions grafted from KV payloads (/kv/import).",
+         [({}, migrations[1])])
+    emit("migration_bytes_out_total", "counter",
+         "KV payload bytes exported.", [({}, migrations[2])])
+    emit("migration_bytes_in_total", "counter",
+         "KV payload bytes grafted.", [({}, migrations[3])])
+
     if engine_stats:
         emit("queue_depth", "gauge", "Requests waiting in the admission queue.",
              [({}, engine_stats.get("queue_depth"))])
@@ -384,6 +434,12 @@ def render_prometheus(
              "Serving anomaly-watchdog rules currently firing "
              "(telemetry/alerts.py; details in /statusz 'alerts').",
              [({}, engine_stats.get("alerts_firing"))])
+        role = engine_stats.get("role")
+        if role:
+            emit("replica_role", "gauge",
+                 "Disaggregated-fleet role of this replica (1 for the "
+                 "labeled role: prefill | decode | both).",
+                 [({"role": role}, 1)])
         # Quantized-decode + tick-roofline gauges (ISSUE 11): resident
         # weight bytes (labeled by storage width), the per-tick weight
         # sweep int8 halves, and the analytic tick roofline's headline

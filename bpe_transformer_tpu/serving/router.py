@@ -21,6 +21,16 @@ operational surface — no new protocol:
   on the next-best replica** (generation is deterministic per seed, so a
   replayed request returns the same tokens), so a rolling restart loses
   zero requests;
+* **two-tier disaggregated scheduling** (ISSUE 15): with
+  ``--prefill-threshold N`` and a fleet containing ``--role prefill``
+  replicas, prompts of >= N tokens prefill on the best prefill-role
+  replica (``POST /kv/export`` returns the finished prefix as a binary
+  KV payload) and decode on the least-loaded decode-role replica
+  (``POST /kv/import`` grafts it and runs pure ticks) — decode p99
+  decouples from prompt-length variance because no decode tick ever
+  waits behind a prompt-sized prefill.  Short prompts bypass straight
+  to decode-capable replicas; a dead prefill tier degrades to normal
+  single-tier balancing, never to an error;
 * an optional ``"session"`` body key makes routing STICKY: the key hashes
   to one replica of the fixed fleet list, and while that replica is
   available it is tried first (weighted order is only the fallback on
@@ -70,6 +80,7 @@ class ReplicaState:
         "url", "healthy", "draining", "queue_depth", "active_slots",
         "slots", "kv_blocks_free", "kv_blocks_total", "last_error",
         "last_poll_t", "consecutive_failures", "routed", "retried_away",
+        "role",
     )
 
     def __init__(self, url: str):
@@ -81,6 +92,10 @@ class ReplicaState:
         self.slots = 0
         self.kv_blocks_free = None
         self.kv_blocks_total = None
+        #: Disaggregated-fleet role from /statusz (ISSUE 15): "prefill" |
+        #: "decode" | "both" — pre-role replicas report nothing and
+        #: default to "both".
+        self.role = "both"
         self.last_error: str | None = None
         self.last_poll_t: float | None = None
         self.consecutive_failures = 0
@@ -107,6 +122,7 @@ class ReplicaState:
     def snapshot(self) -> dict:
         return {
             "url": self.url,
+            "role": self.role,
             "healthy": self.healthy,
             "draining": self.draining,
             "available": self.available,
@@ -136,6 +152,7 @@ class Router:
         poll_timeout_s: float = 5.0,
         request_timeout_s: float = 600.0,
         connect_timeout_s: float = 5.0,
+        prefill_threshold: int | None = None,
         clock=time.monotonic,
         telemetry=None,
     ):
@@ -152,6 +169,15 @@ class Router:
         #: failover, not the whole request budget.
         self.request_timeout_s = request_timeout_s
         self.connect_timeout_s = connect_timeout_s
+        #: Two-tier scheduling (ISSUE 15): prompts of at least this many
+        #: tokens prefill on a prefill-role replica (``/kv/export``) and
+        #: decode on the least-loaded decode-role replica
+        #: (``/kv/import``), so decode ticks never pay a prompt-sized
+        #: stall.  Shorter prompts bypass straight to decode-capable
+        #: replicas.  None disables (single-tier routing) — as does a
+        #: fleet with no available prefill-role replica (the threshold
+        #: degrades to normal balancing, never to an error).
+        self.prefill_threshold = prefill_threshold
         self._clock = clock
         self._t0 = clock()
         self._lock = threading.Lock()
@@ -170,6 +196,9 @@ class Router:
         #: fallback answered — its prefix blocks start cold there).
         self.session_requests = 0
         self.affinity_hits = 0
+        #: Two-tier accounting: requests served via the prefill->decode
+        #: migration path (export + import both landed).
+        self.requests_migrated = 0
         #: Optional Telemetry: the router's OWN trace stream — pick/hop/
         #: request spans per proxied request (`bpe-tpu route
         #: --metrics-jsonl`).  Emission is direct (no nesting stack):
@@ -263,6 +292,7 @@ class Router:
         with self._lock:
             replica.healthy = bool(page.get("worker_alive", True))
             replica.draining = bool(page.get("draining", False))
+            replica.role = str(page.get("role") or "both")
             replica.queue_depth = int(page.get("queue_depth") or 0)
             replica.slots = int(page.get("slots") or 0)
             replica.active_slots = int(page.get("active_slots") or 0)
@@ -291,9 +321,19 @@ class Router:
         session: str | None = None,
         *,
         sticky: ReplicaState | None = None,
+        pool: str = "generate",
     ) -> list[ReplicaState]:
         """Available replicas, best weight first; round-robin rotation
         breaks exact ties so equal replicas share load evenly.
+
+        ``pool`` partitions the fleet by role (ISSUE 15): ``"generate"``
+        (default) is every decode-capable replica — prefill-role replicas
+        never take a whole generation; ``"prefill"`` the DEDICATED
+        chunk-machine tier (role ``prefill`` only: a ``both`` replica may
+        be dense or already loaded with decode work, and a failed export
+        there would bounce as a client error — the single-tier fallback
+        already covers it); ``"decode"`` the graft-accepting tier
+        (decode + both).
 
         A ``session`` key prepends its STICKY replica (stable hash over the
         fixed fleet list, so stickiness survives health flaps of OTHER
@@ -302,8 +342,16 @@ class Router:
         tail, so a draining/dead sticky home degrades to normal balancing
         rather than an error.  A caller that already resolved the sticky
         home passes it as ``sticky`` (skips the re-hash)."""
+        roles = {
+            "generate": ("decode", "both"),
+            "decode": ("decode", "both"),
+            "prefill": ("prefill",),
+        }[pool]
         with self._lock:
-            avail = [r for r in self.replicas if r.available]
+            avail = [
+                r for r in self.replicas
+                if r.available and r.role in roles
+            ]
             self._rr += 1
             rotation = self._rr
         rotated = avail[rotation % len(avail):] + avail[: rotation % len(avail)] if avail else []
@@ -315,6 +363,12 @@ class Router:
             order.insert(0, sticky)
         return order
 
+    def _has_prefill_tier(self) -> bool:
+        with self._lock:
+            return any(
+                r.available and r.role == "prefill" for r in self.replicas
+            )
+
     def sticky_replica(self, session: str) -> ReplicaState:
         """The session's affinity home: a stable hash into the FIXED
         replica list (never the currently-available subset — availability
@@ -322,21 +376,26 @@ class Router:
         digest = zlib.crc32(str(session).encode("utf-8"))
         return self.replicas[digest % len(self.replicas)]
 
-    def _post_generate(
-        self, replica: ReplicaState, body: bytes, trace_id: str | None = None
+    def _post(
+        self,
+        replica: ReplicaState,
+        path: str,
+        body: bytes,
+        trace_id: str | None = None,
+        content_type: str = "application/json",
     ):
-        """POST /generate with a short CONNECT timeout and the full
+        """POST ``path`` with a short CONNECT timeout and the full
         request timeout only on the response.  Returns ``(phase, value,
-        timing)`` — ``phase``/``value`` as before: ``("response",
-        (status, payload))`` on an HTTP answer, ``("connect", exc)`` when
-        the replica was unreachable (safe to fail over), ``("slow",
-        exc)`` when an ESTABLISHED request timed out (the generation is
-        still running — replaying would duplicate it), ``("read", exc)``
-        when the connection died mid-request (replica killed — replay is
-        safe, the work died with it).  ``timing`` carries ``connect_s``
-        and ``ttfb_s`` (send -> response headers; for this blocking
-        endpoint the first byte arrives when the replica finishes, so hop
-        ttfb ~= the replica's whole request) for the hop span.  The
+        timing)``: ``("response", (status, ctype, data_bytes))`` on an
+        HTTP answer, ``("connect", exc)`` when the replica was
+        unreachable (safe to fail over), ``("slow", exc)`` when an
+        ESTABLISHED request timed out (the generation is still running —
+        replaying would duplicate it), ``("read", exc)`` when the
+        connection died mid-request (replica killed — replay is safe,
+        the work died with it).  ``timing`` carries ``connect_s`` and
+        ``ttfb_s`` (send -> response headers; for these blocking
+        endpoints the first byte arrives when the replica finishes, so
+        hop ttfb ~= the replica's whole request) for the hop span.  The
         trace id is forwarded as ``X-Request-Id`` so the replica adopts
         it."""
         parts = urlsplit(replica.url)
@@ -352,14 +411,12 @@ class Router:
                 return "connect", exc, timing
             timing["connect_s"] = round(self._clock() - t0, 6)
             conn.sock.settimeout(self.request_timeout_s)
-            headers = {"Content-Type": "application/json"}
+            headers = {"Content-Type": content_type}
             if trace_id is not None:
                 headers["X-Request-Id"] = trace_id
             try:
                 t_send = self._clock()
-                conn.request(
-                    "POST", "/generate", body=body, headers=headers,
-                )
+                conn.request("POST", path, body=body, headers=headers)
                 resp = conn.getresponse()
                 timing["ttfb_s"] = round(self._clock() - t_send, 6)
                 data = resp.read()
@@ -367,15 +424,27 @@ class Router:
                 return "slow", exc, timing
             except (OSError, http.client.HTTPException) as exc:
                 return "read", exc, timing
-            try:
-                payload = json.loads(data)
-                if not isinstance(payload, dict):
-                    raise ValueError
-            except ValueError:
-                payload = {"error": data.decode("utf-8", "replace")[:200]}
-            return "response", (resp.status, payload), timing
+            ctype = (resp.getheader("Content-Type") or "").split(";")[0]
+            return "response", (resp.status, ctype, data), timing
         finally:
             conn.close()
+
+    def _post_generate(
+        self, replica: ReplicaState, body: bytes, trace_id: str | None = None
+    ):
+        """:meth:`_post` to /generate with the response parsed as JSON —
+        the single-tier proxy hop."""
+        phase, value, timing = self._post(replica, "/generate", body, trace_id)
+        if phase != "response":
+            return phase, value, timing
+        status, _ctype, data = value
+        try:
+            payload = json.loads(data)
+            if not isinstance(payload, dict):
+                raise ValueError
+        except ValueError:
+            payload = {"error": data.decode("utf-8", "replace")[:200]}
+        return "response", (status, payload), timing
 
     def handle_generate(
         self, body: bytes, trace_id: str | None = None
@@ -403,20 +472,60 @@ class Router:
         )
         return code, payload
 
+    @staticmethod
+    def _prompt_tokens(parsed: dict) -> int:
+        """Approximate prompt length for the two-tier threshold:
+        ``prompt_ids`` counts exactly; a text ``prompt`` is estimated at
+        ~4 chars/token (the router has no tokenizer — the threshold is a
+        scheduling heuristic, not a contract)."""
+        ids = parsed.get("prompt_ids")
+        if isinstance(ids, list):
+            return len(ids)
+        prompt = parsed.get("prompt")
+        if isinstance(prompt, str):
+            return -(-len(prompt) // 4)
+        return 0
+
     def _route_generate(
         self, body: bytes, trace_id: str, route: dict
     ) -> tuple[int, dict]:
         session = None
         # The router treats the body as opaque bytes; only a request that
         # can actually carry a session key pays the JSON parse (long
-        # sessionless prompt_ids bodies stay zero-parse on the proxy path).
-        if body and b'"session"' in body:
+        # sessionless prompt_ids bodies stay zero-parse on the proxy
+        # path) — unless the two-tier threshold is armed, which needs the
+        # prompt length.
+        parsed = None
+        if body and (
+            b'"session"' in body or self.prefill_threshold is not None
+        ):
             try:
                 parsed = json.loads(body)
                 if isinstance(parsed, dict):
                     session = parsed.get("session")
+                else:
+                    parsed = None
             except ValueError:
                 pass  # the replica will 400 it; routing just goes unsticky
+        # Two-tier dispatch (ISSUE 15): a long prompt with a live prefill
+        # tier prefills there and decodes on the least-loaded decode
+        # node; everything else (short prompts, no prefill tier, no
+        # threshold) takes the single-tier path below.
+        if (
+            self.prefill_threshold is not None
+            and parsed is not None
+            and self._prompt_tokens(parsed) >= self.prefill_threshold
+            and self._has_prefill_tier()
+        ):
+            return self._route_disagg(body, trace_id, route, session)
+        return self._route_single(body, trace_id, route, session)
+
+    def _route_single(
+        self, body: bytes, trace_id: str, route: dict, session
+    ) -> tuple[int, dict]:
+        """Single-tier proxying with failover (the pre-disaggregation
+        path): weighted order over decode-capable replicas, the sticky
+        session home first."""
         sticky = (
             self.sticky_replica(session) if session is not None else None
         )
@@ -439,7 +548,9 @@ class Router:
                 with self._lock:
                     self.requests_retried += 1
                     order[i - 1].retried_away += 1
-            route["hops"] = i + 1
+            # Accumulate, don't assign: a request that burned prefill-tier
+            # hops before falling back here keeps them on its span.
+            route["hops"] += 1
             t_hop = self._clock()
             phase, value, timing = self._post_generate(
                 replica, body, trace_id
@@ -510,6 +621,161 @@ class Router:
             self.requests_failed += 1
         return 503, {"error": f"all replicas unavailable (last: {last_error})"}
 
+    def _route_disagg(
+        self, body: bytes, trace_id: str, route: dict, session
+    ) -> tuple[int, dict]:
+        """The two-tier path: ``/kv/export`` on the best prefill replica
+        (failover across the prefill pool), then ``/kv/import`` of the
+        returned payload on the least-loaded decode replica (failover
+        across the decode pool — an import replay is safe: the dead
+        replica's graft died with it).  A JSON 200 from /kv/export means
+        the first token already finished the request — returned as-is.
+        When every prefill attempt fails, the request falls back to the
+        single-tier path rather than failing (decode-capable replicas can
+        always serve it whole)."""
+        payload = None
+        for i, replica in enumerate(self.pick_order(pool="prefill")):
+            route["hops"] += 1
+            t_hop = self._clock()
+            phase, value, timing = self._post(
+                replica, "/kv/export", body, trace_id
+            )
+            hop_dur = self._clock() - t_hop
+
+            def hop_span(outcome, status=None, replica=replica,
+                         timing=timing, hop_dur=hop_dur, i=i):
+                self._span(
+                    "hop", hop_dur, trace_id, replica=replica.url,
+                    hop=i, outcome=outcome, status=status, tier="prefill",
+                    connect_s=timing["connect_s"], ttfb_s=timing["ttfb_s"],
+                )
+
+            if phase == "response":
+                status, ctype, data = value
+                if status == 200 and ctype == "application/octet-stream":
+                    hop_span("exported", status=200)
+                    payload = data
+                    break
+                if status == 200:
+                    # Finished at the first token: a complete JSON result.
+                    hop_span("ok", status=200)
+                    try:
+                        out = json.loads(data)
+                    except ValueError:
+                        out = {"error": "bad replica response"}
+                    route["replica"] = replica.url
+                    with self._lock:
+                        replica.routed += 1
+                        self.requests_routed += 1
+                    out["replica"] = replica.url
+                    return 200, out
+                hop_span(
+                    "backpressure" if status == 503 else "client_error",
+                    status=status,
+                )
+                if status == 503:
+                    if b"drain" in data:
+                        with self._lock:
+                            replica.draining = True
+                    continue
+                with self._lock:
+                    self.requests_client_errors += 1
+                detail = data.decode("utf-8", "replace")[:200]
+                return status, {"error": detail or f"HTTP {status}"}
+            if phase == "slow":
+                hop_span("slow")
+                with self._lock:
+                    self.requests_failed += 1
+                return 504, {
+                    "error": f"{replica.url} did not answer within "
+                    f"{self.request_timeout_s}s (prefill still running; "
+                    "not replayed)"
+                }
+            hop_span(f"{phase}_failed")
+            self._mark_down(replica, f"{phase} failed: {value}")
+        if payload is None:
+            # No prefill tier could take it: serve whole on the decode
+            # pool (strictly better than failing the request).
+            return self._route_single(body, trace_id, route, session)
+
+        # Decode tier: graft the payload, weighted least-loaded first
+        # (sticky session home tried first — the migrated prefix seeds
+        # its radix cache there).
+        if session is not None:
+            with self._lock:
+                self.session_requests += 1
+        last_error = "no available decode replica"
+        order = self.pick_order(session, pool="decode")
+        for i, replica in enumerate(order):
+            route["hops"] += 1
+            t_hop = self._clock()
+            phase, value, timing = self._post(
+                replica, "/kv/import", payload, trace_id,
+                content_type="application/octet-stream",
+            )
+            hop_dur = self._clock() - t_hop
+
+            def hop_span(outcome, status=None, replica=replica,
+                         timing=timing, hop_dur=hop_dur, i=i):
+                self._span(
+                    "hop", hop_dur, trace_id, replica=replica.url,
+                    hop=i, outcome=outcome, status=status, tier="decode",
+                    connect_s=timing["connect_s"], ttfb_s=timing["ttfb_s"],
+                )
+
+            if phase == "response":
+                status, _ctype, data = value
+                try:
+                    out = json.loads(data)
+                    if not isinstance(out, dict):
+                        raise ValueError
+                except ValueError:
+                    out = {"error": data.decode("utf-8", "replace")[:200]}
+                if status == 200:
+                    hop_span("ok", status=200)
+                    route["replica"] = replica.url
+                    with self._lock:
+                        replica.routed += 1
+                        self.requests_routed += 1
+                        self.requests_migrated += 1
+                        if session is not None and replica is self.sticky_replica(session):
+                            self.affinity_hits += 1
+                    out["replica"] = replica.url
+                    return 200, out
+                detail = str(out.get("error", ""))
+                hop_span(
+                    "backpressure" if status == 503 else "client_error",
+                    status=status,
+                )
+                if status == 503:
+                    if "drain" in detail:
+                        with self._lock:
+                            replica.draining = True
+                    last_error = f"{replica.url}: 503 {detail}"
+                    continue
+                with self._lock:
+                    self.requests_client_errors += 1
+                return status, {"error": detail or f"HTTP {status}"}
+            if phase == "slow":
+                hop_span("slow")
+                with self._lock:
+                    self.requests_failed += 1
+                return 504, {
+                    "error": f"{replica.url} did not answer within "
+                    f"{self.request_timeout_s}s (decode still running; "
+                    "not replayed)"
+                }
+            # connect/read failure: the graft died with the replica —
+            # replaying the payload elsewhere is safe and deterministic.
+            hop_span(f"{phase}_failed")
+            self._mark_down(replica, f"{phase} failed: {value}")
+            last_error = f"{replica.url}: {value}"
+        with self._lock:
+            self.requests_failed += 1
+        return 503, {
+            "error": f"no decode replica could graft (last: {last_error})"
+        }
+
     # ------------------------------------------------------------- surface
 
     def statusz(self) -> dict:
@@ -522,14 +788,19 @@ class Router:
             )
             client_errors = self.requests_client_errors
             sessions, hits = self.session_requests, self.affinity_hits
+            migrated = self.requests_migrated
         return {
             "uptime_s": round(self._clock() - self._t0, 3),
             "replicas": replicas,
             "available": sum(1 for r in replicas if r["available"]),
+            "prefill_threshold": self.prefill_threshold,
             "requests_routed": routed,
             "requests_retried": retried,
             "requests_failed": failed,
             "requests_client_errors": client_errors,
+            # Two-tier scheduling (ISSUE 15): requests served through the
+            # prefill->migrate->decode path.
+            "requests_migrated": migrated,
             # Session affinity (sticky routing): how much multi-turn
             # traffic actually landed on its prefix-block home.
             "session_requests": sessions,
@@ -549,6 +820,7 @@ class Router:
             )
             client_errors = self.requests_client_errors
             sessions, hits = self.session_requests, self.affinity_hits
+            migrated = self.requests_migrated
         # serving/metrics.py is jax-free at import: the router can share
         # the exposition formatter without touching an accelerator runtime.
         from bpe_transformer_tpu.serving.metrics import emit_prometheus
@@ -577,8 +849,16 @@ class Router:
         emit("affinity_hits_total", "counter",
              "Session requests served by their sticky replica.",
              [({}, hits)])
+        emit("requests_migrated_total", "counter",
+             "Requests served via the two-tier prefill->decode KV "
+             "migration path.", [({}, migrated)])
         emit("replica_healthy", "gauge", "Replica reachable and worker alive.",
              [({"replica": r["url"]}, int(r["healthy"])) for r in replicas])
+        emit("replica_role", "gauge",
+             "Disaggregated-fleet role per replica (1 for the labeled "
+             "role).",
+             [({"replica": r["url"], "role": r["role"]}, 1)
+              for r in replicas])
         emit("replica_draining", "gauge", "Replica draining (rolling restart).",
              [({"replica": r["url"]}, int(r["draining"])) for r in replicas])
         emit("replica_weight", "gauge", "Free-capacity routing weight.",
@@ -676,6 +956,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--connect-timeout", type=float, default=5.0,
                         help="seconds to wait for a replica's TCP connect "
                         "(failover to the next replica after)")
+    parser.add_argument("--prefill-threshold", type=int, default=None,
+                        metavar="TOKENS",
+                        help="two-tier disaggregated scheduling: prompts "
+                        "of >= TOKENS prefill on a --role prefill replica "
+                        "(/kv/export) and decode on the least-loaded "
+                        "decode replica (/kv/import); shorter prompts "
+                        "bypass straight to decode nodes (default: "
+                        "single-tier routing)")
     parser.add_argument("--metrics-jsonl", default=None,
                         help="write the router's trace stream (pick/hop/"
                         "request spans per proxied request, manifest + "
@@ -699,6 +987,7 @@ def main(argv: list[str] | None = None) -> int:
         poll_interval_s=args.poll_interval,
         request_timeout_s=args.request_timeout,
         connect_timeout_s=args.connect_timeout,
+        prefill_threshold=args.prefill_threshold,
         telemetry=telemetry,
     )
     server = make_router_http_server(router, host=args.host, port=args.port)

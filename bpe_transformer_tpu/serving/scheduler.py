@@ -156,6 +156,13 @@ class FifoScheduler:
         with self._lock:
             return len(self._q)
 
+    def notify(self) -> None:
+        """Wake the worker for work that lives OUTSIDE this queue (a KV
+        import landing in the serving layer's graft queue) — without it an
+        idle worker would sleep out its poll interval first."""
+        with self._lock:
+            self._work.notify_all()
+
     # ---------------------------------------------------------- worker side
 
     def pop_ready(self, n_free: int, engine_idle: bool = False) -> PopResult:

@@ -93,6 +93,12 @@ class Request:
     #: session's radix prefix blocks live.  The replica itself only
     #: carries it (request metadata) — affinity is a routing concern.
     session: str | None = None
+    #: Disaggregated prefill (ISSUE 15): run the chunk machine, then —
+    #: instead of entering decode — export the finished prefix as a KV
+    #: migration payload (``Result.kv_payload``, finish_reason
+    #: ``"migrated"``).  The ``/kv/export`` endpoint sets this; needs a
+    #: paged engine.
+    migrate: bool = False
     request_id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex
     )
@@ -104,10 +110,14 @@ class Result:
 
     request_id: str
     token_ids: tuple[int, ...]
-    finish_reason: str  # stop | length | deadline | cancelled | error
+    finish_reason: str  # stop | length | deadline | cancelled | error | migrated
     queue_wait_s: float = 0.0
     prefill_s: float = 0.0
     decode_s: float = 0.0
+    #: ``finish_reason == "migrated"`` only: the serialized KV payload
+    #: (serving/kvpool/migrate.py) another replica's ``/kv/import`` (or
+    #: ``submit_import``) continues the generation from.
+    kv_payload: bytes | None = None
 
     def timings(self) -> dict:
         return {
@@ -124,7 +134,7 @@ class _Entry:
         "request", "tokens", "stream", "done", "result", "slot",
         "t_submit", "t_decode_start", "queue_wait_s", "prefill_s",
         "cancel_requested", "bucket", "t_prefill_start", "programs_before",
-        "shared_tokens",
+        "shared_tokens", "migrated_in",
     )
 
     def __init__(self, request: Request, t_submit: float):
@@ -143,6 +153,7 @@ class _Entry:
         self.t_prefill_start = t_submit  # first chunk start (paged engine)
         self.programs_before = 0  # compile counter at admission (paged)
         self.shared_tokens = 0  # prefix-cache-reused prompt tokens (paged)
+        self.migrated_in = False  # arrived as a KV graft (ISSUE 15)
 
 
 class RequestHandle:
@@ -215,11 +226,21 @@ class ServingEngine:
         speculate_k: int = 0,
         draft_spec=None,
         alert_rules=None,
+        role: str = "both",
     ):
         # Count XLA compiles (the engine's bucketed prefills included) into
         # the process-wide telemetry.resources counter before the first
         # program builds.
         install_compile_counter()
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f'role={role!r} must be "prefill", "decode", or "both"'
+            )
+        if role != "both" and not paged:
+            raise ValueError(
+                f"role={role!r} needs paged=True (KV migration lives in "
+                "the block pool)"
+            )
         if speculate_k and not paged:
             raise ValueError(
                 "speculate_k needs paged=True (the verify pass scores "
@@ -263,6 +284,13 @@ class ServingEngine:
                 weight_dtype=weight_dtype, fused_sampling=fused_sampling,
             )
         self.paged = paged
+        #: Disaggregated-fleet role (ISSUE 15): ``"prefill"`` replicas run
+        #: the chunk machine then stream finished prefixes out over
+        #: ``/kv/export`` instead of ticking (plain /generate refused);
+        #: ``"decode"`` replicas additionally accept grafts on
+        #: ``/kv/import`` and — fed only imports — never compile a chunk
+        #: program; ``"both"`` (default) serves everything.
+        self.role = role
         #: Speculative decoding active (the engine is a SpecEngine): the
         #: stats/statusz/metrics surfaces grow the acceptance gauges and
         #: the engine-record cadence emits kind="spec" records.
@@ -279,6 +307,17 @@ class ServingEngine:
         self._admit_backlog: list[_Entry] = []
         #: Slots mid-chunked-prefill -> their entries (paged).
         self._prefill_entries: dict[int, _Entry] = {}
+        #: Inbound KV grafts awaiting a slot/blocks, FIFO:
+        #: ``(entry, payload_dict, payload_bytes_len, recv_unix)`` —
+        #: fed by submit_import / adopt_migration (transport threads),
+        #: drained by the worker ahead of fresh admissions.
+        self._import_queue: collections.deque = collections.deque()
+        self._import_lock = threading.Lock()
+        #: Drain-evacuation targets: in-process peer ServingEngines the
+        #: worker exports every queued + in-flight session to when a
+        #: ``drain(evacuate_to=...)`` runs (round-robin).
+        self._evacuate_peers: list = []
+        self._evacuate_rr = 0
         self.scheduler = FifoScheduler(
             max_queue=max_queue, max_wait_s=max_wait_s, clock=clock
         )
@@ -334,19 +373,32 @@ class ServingEngine:
         self._thread.start()
         return self
 
-    def drain(self, timeout_s: float = 30.0) -> bool:
+    def drain(self, timeout_s: float = 30.0, evacuate_to=None) -> bool:
         """Graceful shutdown, phase 1: stop ADMITTING (new submits raise
         ``RuntimeError`` -> HTTP 503) but keep the worker running until
         every queued and in-flight request finishes — the SIGTERM path of
         ``bpe-tpu serve`` (preemption must not cancel work the engine can
         still complete).  Returns True when fully drained, False on
-        timeout (the caller's ``close()`` then cancels the stragglers)."""
+        timeout (the caller's ``close()`` then cancels the stragglers).
+
+        ``evacuate_to`` (ISSUE 15) turns drain into session *evacuation*:
+        a list of in-process peer ``ServingEngine`` replicas the worker
+        migrates every queued AND in-flight session to — mid-generation
+        slots are exported as KV payloads and grafted onto a peer, which
+        continues the generation bit-for-bit and completes the original
+        caller's handle — so draining a loaded replica finishes in
+        payload-transfer time instead of longest-generation time, with
+        zero failed requests and zero token divergence."""
+        if evacuate_to:
+            peers = [p for p in evacuate_to if p.accepting_imports()]
+            self._evacuate_peers = peers
         self._draining = True
         if self._telemetry is not None:
             self._telemetry.event(
                 "serve_drain",
                 queue_depth=self.scheduler.depth,
                 active_slots=self.engine.active_count,
+                evacuating=bool(self._evacuate_peers),
             )
         deadline = self._clock() + timeout_s
         while True:
@@ -392,6 +444,11 @@ class ServingEngine:
         for entry in self._admit_backlog:
             self._finish(entry, "cancelled")
         self._admit_backlog = []
+        with self._import_lock:
+            imports = [item[0] for item in self._import_queue]
+            self._import_queue.clear()
+        for entry in imports:
+            self._finish(entry, "cancelled")
         if self._telemetry is not None:
             self._telemetry.footer(
                 clean=self._worker_error is None,
@@ -421,6 +478,20 @@ class ServingEngine:
             raise RuntimeError(
                 "serving engine is draining (shutting down); not accepting "
                 "new requests"
+            )
+        if request.migrate and not self.paged:
+            raise ValueError(
+                "migrate-at-prefill needs a paged engine (the KV payload "
+                "is a block chain)"
+            )
+        if self.role == "prefill" and not request.migrate:
+            # A prefill-role replica never ticks: a plain generate would
+            # park in a slot forever.  503 (RuntimeError at the HTTP
+            # layer) so a misdirected client fails over, not a 400.
+            raise RuntimeError(
+                "prefill-role replica serves /kv/export only (finished "
+                "prefixes stream out as KV payloads; decode lives on "
+                "decode-role replicas)"
             )
         plen = len(request.prompt_ids)
         ctx = self.engine.config.context_length
@@ -487,11 +558,14 @@ class ServingEngine:
         deadline_s: float | None = None,
         session: str | None = None,
         request_id: str | None = None,
+        migrate: bool = False,
         timeout: float | None = None,
     ) -> Result:
         """Blocking one-call generation.  ``request_id`` adopts a
         caller-supplied trace id (the router's ``X-Request-Id``) so one id
-        stitches router hops, serve spans, and engine slot state."""
+        stitches router hops, serve spans, and engine slot state.
+        ``migrate=True`` is the /kv/export path: the result carries the
+        finished prefix as a KV payload instead of a full generation."""
         kwargs = {} if request_id is None else {"request_id": request_id}
         handle = self.submit(
             Request(
@@ -508,10 +582,169 @@ class ServingEngine:
                 stop_id=self.default_stop_id if stop_id is None else stop_id,
                 deadline_s=deadline_s,
                 session=session,
+                migrate=migrate,
                 **kwargs,
             )
         )
         return handle.result(timeout)
+
+    # ------------------------------------------------------- KV migration
+
+    def accepting_imports(self) -> bool:
+        """Whether this replica can graft KV payloads right now (paged,
+        not prefill-role, worker alive, not draining)."""
+        return (
+            self.paged
+            and self.role != "prefill"
+            and self._running
+            and not self._draining
+            and self._worker_error is None
+        )
+
+    def submit_import(self, payload_bytes: bytes) -> RequestHandle:
+        """Accept a serialized KV migration payload (the ``/kv/import``
+        body): validate it against this engine's geometry, register the
+        request, and queue the graft for the worker.  The handle resolves
+        with the COMPLETE generation — tokens emitted before the
+        migration (carried in the payload) plus everything decoded here.
+
+        Raises ``ValueError`` (bad payload / geometry mismatch -> 400),
+        ``QueueFullError`` (backpressure -> 503),
+        :class:`DuplicateRequestError`, or ``RuntimeError`` (not
+        accepting -> 503)."""
+        from bpe_transformer_tpu.serving.kvpool.migrate import (
+            payload_from_bytes,
+        )
+
+        if self._worker_error is not None:
+            raise RuntimeError(
+                "serving engine worker died"
+            ) from self._worker_error
+        if not self._running:
+            raise RuntimeError("serving engine is not running (use start())")
+        if self._draining:
+            raise RuntimeError("serving engine is draining; not accepting")
+        if not self.paged:
+            raise RuntimeError("KV import needs a paged engine")
+        if self.role == "prefill":
+            raise RuntimeError(
+                "prefill-role replica does not accept KV imports"
+            )
+        payload = payload_from_bytes(payload_bytes)
+        meta = payload["meta"]
+        # Full structural validation at the TRANSPORT: a corrupt payload
+        # must 400 here, never reach the worker thread.
+        self.engine.validate_import_payload(payload)
+        request = Request(
+            prompt_ids=tuple(int(t) for t in meta["prompt"]),
+            max_new_tokens=max(int(meta["max_new_tokens"]), 1),
+            temperature=float(meta["temperature"]),
+            seed=int(meta["seed"]),
+            stop_id=meta["stop_id"],
+            deadline_s=meta.get("deadline_s"),
+            session=meta.get("session"),
+            request_id=meta.get("request_id") or uuid.uuid4().hex,
+        )
+        entry = _Entry(request, self._clock())
+        self._entry_from_meta(entry, meta)
+        with self._entries_lock:
+            if request.request_id in self._entries:
+                raise DuplicateRequestError(
+                    f"request id {request.request_id!r} is already in "
+                    "flight on this replica"
+                )
+            self._entries[request.request_id] = entry
+        try:
+            # Capacity check + append under ONE lock hold: each queued
+            # item carries a whole decoded KV payload, so a racy check
+            # would let concurrent imports blow the memory bound the
+            # backpressure exists to enforce.
+            with self._import_lock:
+                if len(self._import_queue) >= self.scheduler.max_queue:
+                    raise QueueFullError(
+                        f"import queue full ({self.scheduler.max_queue})"
+                    )
+                self._import_queue.append(
+                    (entry, payload, len(payload_bytes), time.time())
+                )
+        except BaseException:
+            with self._entries_lock:
+                self._entries.pop(request.request_id, None)
+            raise
+        self.metrics.on_submit()
+        self.scheduler.notify()
+        return RequestHandle(self, entry)
+
+    def adopt_migration(self, entry: _Entry, payload) -> None:
+        """In-process drain evacuation, receiving side: adopt a peer's
+        live ``_Entry`` (its stream/done handles stay with the original
+        caller) and queue its KV payload for grafting.  Called from the
+        EVACUATING replica's worker thread.  ``payload`` is either the
+        serialized bytes or the already-parsed dict — queued grafts move
+        between peers without a pointless reserialize/reparse round
+        trip of multi-MB KV rows."""
+        from bpe_transformer_tpu.serving.kvpool.migrate import (
+            payload_from_bytes,
+            payload_nbytes,
+        )
+
+        if not self.accepting_imports():
+            raise RuntimeError("replica is not accepting imports")
+        if isinstance(payload, (bytes, bytearray)):
+            nbytes = len(payload)
+            payload = payload_from_bytes(payload)
+        else:
+            nbytes = payload_nbytes(payload)
+        self.engine.validate_import_payload(payload)
+        with self._entries_lock:
+            if entry.request.request_id in self._entries:
+                raise DuplicateRequestError(
+                    f"request id {entry.request.request_id!r} already in "
+                    "flight on the evacuation target"
+                )
+            self._entries[entry.request.request_id] = entry
+        with self._import_lock:
+            self._import_queue.append(
+                (entry, payload, nbytes, time.time())
+            )
+        self.scheduler.notify()
+
+    def adopt_entry(self, entry: _Entry) -> None:
+        """In-process drain evacuation for NOT-YET-ADMITTED requests: the
+        peer's queued entry re-enters this replica's scheduler whole (same
+        stream/done handles, same request id)."""
+        if not self.accepting_imports():
+            raise RuntimeError("replica is not accepting new requests")
+        with self._entries_lock:
+            if entry.request.request_id in self._entries:
+                raise DuplicateRequestError(
+                    f"request id {entry.request.request_id!r} already in "
+                    "flight on the evacuation target"
+                )
+            self._entries[entry.request.request_id] = entry
+        try:
+            self.scheduler.submit(
+                entry,
+                request_id=entry.request.request_id,
+                deadline_s=entry.request.deadline_s,
+            )
+        except BaseException:
+            with self._entries_lock:
+                self._entries.pop(entry.request.request_id, None)
+            raise
+        self.metrics.on_submit()
+
+    @staticmethod
+    def _entry_from_meta(entry: _Entry, meta: dict) -> None:
+        """Restore the serving-layer request state a payload carries:
+        tokens already emitted and the phase timings accrued before the
+        migration (so Result timings stay end-to-end)."""
+        entry.tokens = [int(t) for t in meta.get("emitted") or []]
+        entry.queue_wait_s = float(meta.get("queue_wait_s") or 0.0)
+        entry.prefill_s = float(meta.get("prefill_s") or 0.0)
+        entry.bucket = meta.get("bucket")
+        entry.shared_tokens = int(meta.get("shared_tokens") or 0)
+        entry.migrated_in = True
 
     def stream(self, request: Request) -> Iterator[int]:
         """Submit and yield token ids as they are generated."""
@@ -586,10 +819,14 @@ class ServingEngine:
         aggregate ``GET /metrics`` renders, reachable offline.  A paged
         engine adds the kvpool gauges (block occupancy, prefix-cache
         hit/miss counters, chunked-prefill queue depth)."""
+        with self._import_lock:
+            import_backlog = len(self._import_queue)
         stats = {
             "engine_kind": (
                 "spec" if self.spec else "paged" if self.paged else "dense"
             ),
+            "role": self.role,
+            "import_backlog": import_backlog,
             "slots": self.engine.n_slots,
             "active_slots": self.engine.active_count,
             "queue_depth": self.scheduler.depth,
@@ -622,12 +859,21 @@ class ServingEngine:
         events), per-slot state, queue depth, the recent-request trace
         ring (per-request phase timelines), and the last-error ring."""
         resources = sample_resources()
+        with self._import_lock:
+            import_backlog = len(self._import_queue)
         page = {
             "manifest": self.manifest,
             "uptime_s": round(self.metrics.uptime_s(), 3),
             "engine_kind": (
                 "spec" if self.spec else "paged" if self.paged else "dense"
             ),
+            # Disaggregated-fleet role (ISSUE 15): the router partitions
+            # the fleet off this field — prefill-role replicas take
+            # /kv/export only, decode-role replicas take imports.
+            "role": self.role,
+            "migrations_out": self.metrics.migrations_out,
+            "migrations_in": self.metrics.migrations_in,
+            "import_backlog": import_backlog,
             # The fleet router reads these to route around a replica that
             # is shutting down (PR-5 drain) or whose worker died, and to
             # weight by free capacity.  Load is reported as OCCUPANCY, not
@@ -643,7 +889,10 @@ class ServingEngine:
             "compiled_programs": self.engine.compiled_programs(),
             "compile_events": resources["compile_events"],
             "prefill_buckets": list(self.engine.buckets),
-            "queue_depth": self.scheduler.depth + len(self._admit_backlog),
+            "queue_depth": (
+                self.scheduler.depth + len(self._admit_backlog)
+                + import_backlog
+            ),
             "slots": self.engine.n_slots,
             "active_slots": self.engine.n_slots - self.engine.free_slots,
             "requests_finished": self._requests_finished,
@@ -761,6 +1010,11 @@ class ServingEngine:
             for entry in self._admit_backlog:
                 self._finish(entry, "error")
             self._admit_backlog = []
+            with self._import_lock:
+                dead_imports = [item[0] for item in self._import_queue]
+                self._import_queue.clear()
+            for entry in dead_imports:
+                self._finish(entry, "error")
             # Every other registered request must unblock too — queued ones
             # AND ones popped for admission when the step raised: their
             # callers are parked on done.wait() and nothing else will run
@@ -779,6 +1033,12 @@ class ServingEngine:
         prefill under the per-tick token budget (paged), then a decode
         tick.  Returns whether any work happened."""
         worked = False
+
+        # Drain evacuation (ISSUE 15): once draining with peers attached,
+        # every queued and in-flight session leaves as a KV payload (or a
+        # whole queue entry) before anything else runs this iteration.
+        if self._draining and self._evacuate_peers:
+            worked |= self._evacuate_step()
 
         # In-flight cancellations retire their slots before the next tick
         # — decoding slots, slots mid-chunked-prefill, and block-starved
@@ -816,6 +1076,11 @@ class ServingEngine:
                     kept.append(entry)
             self._admit_backlog = kept
 
+        # Inbound KV grafts land BEFORE fresh admissions: migrated work is
+        # the fleet's oldest (it already paid queue wait + prefill on its
+        # source replica).
+        worked |= self._advance_imports()
+
         # Admissions: block-starved parked entries retry FIRST, strictly
         # FIFO — while any is parked, newer submissions stay queued so a
         # big request cannot be starved by a stream of small ones.
@@ -824,7 +1089,15 @@ class ServingEngine:
                 break
             self._admit_backlog.pop(0)
             worked = True
-        n_free = 0 if self._admit_backlog else self.engine.free_slots
+        # Pending grafts gate fresh admissions exactly like a parked
+        # backlog: admitting newer work would consume the slots/blocks
+        # the migrated sessions wait for.
+        with self._import_lock:
+            imports_pending = bool(self._import_queue)
+        n_free = (
+            0 if (self._admit_backlog or imports_pending)
+            else self.engine.free_slots
+        )
         engine_idle = (
             self.engine.active_count == 0 and not self._prefill_entries
         )
@@ -933,6 +1206,256 @@ class ServingEngine:
             self._slot_entries[event.slot] = entry
         return True
 
+    def _advance_imports(self) -> bool:
+        """Graft queued KV payloads into the engine, FIFO.  A graft that
+        cannot land yet (no free slot, block-starved pool) stays queued
+        and retries as retirements free capacity — the import twin of the
+        parked-admission backlog."""
+        from bpe_transformer_tpu.serving.kvpool.blocks import (
+            NoFreeBlocksError,
+        )
+
+        worked = False
+        while True:
+            with self._import_lock:
+                if not self._import_queue:
+                    return worked
+                entry, payload, nbytes, recv_unix = self._import_queue[0]
+            if entry.cancel_requested:
+                with self._import_lock:
+                    self._import_queue.popleft()
+                self._finish(entry, "cancelled")
+                worked = True
+                continue
+            deadline = entry.request.deadline_s
+            if (
+                deadline is not None
+                and self._clock() >= entry.t_submit + deadline
+            ):
+                # The deadline contract follows the request through a
+                # migration: a graft parked past its budget expires like
+                # a queued admission would (t_submit = graft receipt).
+                with self._import_lock:
+                    self._import_queue.popleft()
+                self._finish(entry, "deadline")
+                worked = True
+                continue
+            if not self.engine.free_slots:
+                return worked
+            t0 = self._clock()
+            try:
+                slot = self.engine.import_slot(payload)
+            except NoFreeBlocksError:
+                return worked  # pool dry: retry as decode frees blocks
+            with self._import_lock:
+                self._import_queue.popleft()
+            import_s = self._clock() - t0
+            meta = payload["meta"]
+            entry.slot = slot
+            now = self._clock()
+            if meta.get("decoding"):
+                # Backdated by the decode seconds already accrued on the
+                # exporting replica: the final Result.decode_s (and its
+                # closing span) stays end-to-end across the migration.
+                entry.t_decode_start = now - float(
+                    meta.get("decode_s") or 0.0
+                )
+                self._slot_entries[slot] = entry
+            else:
+                entry.t_prefill_start = now
+                entry.programs_before = self.engine.compiled_programs()
+                self._prefill_entries[slot] = entry
+            self.metrics.on_migration("in", nbytes)
+            exported_unix = meta.get("exported_unix")
+            transfer_s = (
+                max(recv_unix - exported_unix, 0.0)
+                if isinstance(exported_unix, (int, float))
+                else None
+            )
+            export_s = meta.get("export_s")
+            total_s = import_s + (transfer_s or 0.0) + (export_s or 0.0)
+            self._span(
+                "migration_import", t0, import_s, entry.request
+            )
+            self.metrics.observe_phase("migration", total_s)
+            self._emit_migration(
+                direction="import",
+                request_id=entry.request.request_id,
+                bytes=nbytes,
+                blocks=int(meta["n_blocks"]),
+                export_s=export_s,
+                transfer_s=transfer_s,
+                import_s=round(import_s, 6),
+                total_s=round(total_s, 6),
+                decoding=bool(meta.get("decoding")),
+            )
+            worked = True
+
+    def _export_entry(self, entry: _Entry, slot: int) -> tuple[bytes, int]:
+        """Export ``slot`` (holding ``entry``'s generation) as payload
+        bytes, with the serving-layer continuation state — emitted tokens,
+        token history (the speculative importer's draft re-prefill input),
+        accrued phase timings — folded into the meta.  Releases the slot.
+        Returns ``(payload_bytes, n_blocks)``."""
+        from bpe_transformer_tpu.serving.kvpool.migrate import (
+            payload_to_bytes,
+        )
+
+        t0 = self._clock()
+        # Decode seconds accrued HERE ride the meta so the importer can
+        # backdate its decode clock — Result.decode_s and the total SLO
+        # histogram stay end-to-end across the migration.
+        decode_accrued = (
+            t0 - entry.t_decode_start
+            if slot in self._slot_entries or self.engine._active[slot]
+            else 0.0
+        )
+        payload = self.engine.export_slot(
+            slot,
+            {
+                "emitted": [int(t) for t in entry.tokens],
+                "history": [
+                    int(t) for t in entry.request.prompt_ids
+                ] + [int(t) for t in entry.tokens],
+                "queue_wait_s": round(entry.queue_wait_s, 6),
+                "prefill_s": round(entry.prefill_s, 6),
+                "decode_s": round(max(decode_accrued, 0.0), 6),
+                "bucket": entry.bucket,
+                "shared_tokens": entry.shared_tokens,
+                "deadline_s": entry.request.deadline_s,
+                "session": entry.request.session,
+                "exported_unix": time.time(),
+            },
+        )
+        self.engine.release(slot)
+        # The device-extract wall rides the meta so the IMPORT side's
+        # migration record carries the full export/transfer/import split
+        # (serialization + HTTP land in transfer_s via exported_unix).
+        payload["meta"]["export_s"] = round(self._clock() - t0, 6)
+        return payload_to_bytes(payload), int(payload["meta"]["n_blocks"])
+
+    def _complete_migration_export(self, entry: _Entry, slot: int) -> None:
+        """Prefill-role handoff: the finished prefix (first token already
+        sampled and delivered) leaves as a KV payload; the request
+        finishes here as ``"migrated"`` with the payload on its result."""
+        t0 = self._clock()
+        data, blocks = self._export_entry(entry, slot)
+        export_s = self._clock() - t0
+        self.metrics.on_migration("out", len(data))
+        self._span("migration_export", t0, export_s, entry.request)
+        self._emit_migration(
+            direction="export",
+            request_id=entry.request.request_id,
+            bytes=len(data),
+            blocks=blocks,
+            export_s=round(export_s, 6),
+        )
+        self._finish(entry, "migrated", kv_payload=data)
+
+    def _evacuate_step(self) -> bool:
+        """Move every queued + in-flight session to an evacuation peer
+        (round-robin): queued entries re-enter the peer's scheduler whole;
+        in-flight slots (decoding AND mid-prefill) export as KV payloads
+        the peer grafts and continues bit-for-bit.  The original callers'
+        handles complete from the peer — zero failed requests."""
+        peers = [p for p in self._evacuate_peers if p.accepting_imports()]
+        if not peers:
+            self._evacuate_peers = []
+            return False
+
+        def next_peer():
+            self._evacuate_rr += 1
+            return peers[self._evacuate_rr % len(peers)]
+
+        worked = False
+        # Not-yet-admitted work first (cheap: no KV moves) — the queue,
+        # then block-starved parked admissions and queued grafts.
+        pop = self.scheduler.pop_ready(self.scheduler.max_queue)
+        for qe in pop.cancelled:
+            self._finish(qe.item, "cancelled")
+        for qe in pop.expired:
+            self._finish(qe.item, "deadline")
+        moved_entries = list(self._admit_backlog)
+        self._admit_backlog = []
+        with self._import_lock:
+            moved_imports = list(self._import_queue)
+            self._import_queue.clear()
+        for qe in pop.admit:
+            moved_entries.append(qe.item)
+        for entry in moved_entries:
+            with self._entries_lock:
+                self._entries.pop(entry.request.request_id, None)
+            try:
+                next_peer().adopt_entry(entry)
+            except (RuntimeError, ValueError) as exc:
+                self.metrics.record_error(repr(exc), source="evacuate")
+                self._finish(entry, "error")
+            worked = True
+        for entry, payload, nbytes, _recv in moved_imports:
+            with self._entries_lock:
+                self._entries.pop(entry.request.request_id, None)
+            try:
+                # Already parsed: hand the dict over directly (the bytes
+                # codec is for the HTTP transport, not in-process moves).
+                next_peer().adopt_migration(entry, payload)
+            except (RuntimeError, ValueError) as exc:
+                self.metrics.record_error(repr(exc), source="evacuate")
+                self._finish(entry, "error")
+            worked = True
+
+        # In-flight sessions: export + graft.  The entry object itself
+        # moves — its stream/done handles keep serving the original
+        # caller from the peer's worker.
+        in_flight = list(self._prefill_entries.items()) + list(
+            self._slot_entries.items()
+        )
+        for slot, entry in in_flight:
+            self._prefill_entries.pop(slot, None)
+            self._slot_entries.pop(slot, None)
+            t0 = self._clock()
+            data, blocks = self._export_entry(entry, slot)
+            export_s = self._clock() - t0
+            with self._entries_lock:
+                self._entries.pop(entry.request.request_id, None)
+            entry.slot = None
+            self.metrics.on_migration("out", len(data))
+            self._span("migration_export", t0, export_s, entry.request)
+            self._emit_migration(
+                direction="evacuate",
+                request_id=entry.request.request_id,
+                bytes=len(data),
+                blocks=blocks,
+                export_s=round(export_s, 6),
+            )
+            try:
+                next_peer().adopt_migration(entry, data)
+            except (RuntimeError, ValueError) as exc:
+                self.metrics.record_error(repr(exc), source="evacuate")
+                self._finish(entry, "error")
+            worked = True
+        if worked and self._telemetry is not None:
+            self._telemetry.event(
+                "serve_evacuate",
+                sessions=len(in_flight),
+                queued=len(moved_entries) + len(moved_imports),
+                peers=len(peers),
+            )
+        return worked
+
+    def _emit_migration(self, **fields) -> None:
+        """One ``kind="migration"`` record (bytes, blocks, phase split) —
+        the telemetry spine's view of each KV move."""
+        if self._telemetry is None:
+            return
+        self._telemetry.emit(
+            {
+                "kind": "migration",
+                "t": round(self._clock() - self._t0, 6),
+                "time_unix": round(time.time(), 6),
+                **{k: v for k, v in fields.items() if v is not None},
+            }
+        )
+
     def _advance_prefills(self) -> bool:
         """Run pending prefill chunks (paged engine) under the per-tick
         token budget, oldest admission first.  A completed prefill
@@ -983,6 +1506,11 @@ class ServingEngine:
         entry.stream.put(event.token)
         if event.finished:
             self._finish(entry, event.finished)
+        elif entry.request.migrate:
+            # Disaggregated prefill handoff (ISSUE 15): the finished
+            # prefix (first token included) leaves as a KV payload
+            # instead of entering this replica's decode set.
+            self._complete_migration_export(entry, event.slot)
         else:
             self._slot_entries[event.slot] = entry
 
@@ -998,16 +1526,20 @@ class ServingEngine:
                 del self._slot_entries[event.slot]
                 self._finish(entry, event.finished)
 
-    def _finish(self, entry: _Entry, reason: str) -> None:
+    def _finish(
+        self, entry: _Entry, reason: str, kv_payload: bytes | None = None
+    ) -> None:
         if entry.done.is_set():
             return
         now = self._clock()
         decode_s = (
-            now - entry.t_decode_start if entry.slot is not None else 0.0
+            now - entry.t_decode_start
+            if entry.slot is not None and reason != "migrated"
+            else 0.0
         )
-        if entry.slot is not None:
+        if entry.slot is not None and reason != "migrated":
             self._span("decode", entry.t_decode_start, decode_s, entry.request)
-        elif reason in ("deadline", "cancelled"):
+        elif reason in ("deadline", "cancelled") and not entry.migrated_in:
             # Never admitted: the whole life was queue wait.
             entry.queue_wait_s = now - entry.t_submit
             self._span("queue_wait", entry.t_submit, entry.queue_wait_s,
@@ -1019,6 +1551,7 @@ class ServingEngine:
             queue_wait_s=entry.queue_wait_s,
             prefill_s=entry.prefill_s,
             decode_s=decode_s,
+            kv_payload=kv_payload,
         )
         self._requests_finished += 1
         self.metrics.on_finish(reason)
@@ -1242,6 +1775,19 @@ def make_http_server(
     * ``GET /statusz`` — JSON operator page: run manifest, uptime,
       compile counters, per-slot state, recent per-request phase
       timelines, last-error ring buffer.
+    * ``POST /kv/export`` (ISSUE 15) — a /generate-shaped body, served by
+      the chunk machine only: the finished prefix (first token sampled)
+      returns as a binary KV migration payload
+      (``application/octet-stream``) instead of being decoded here — the
+      disaggregated router moves it to a decode replica's ``/kv/import``.
+      When the first token already finishes the request (stop id, budget
+      1), the normal JSON result returns instead.
+    * ``POST /kv/import`` (ISSUE 15) — body is a ``/kv/export`` payload;
+      the replica grafts it and decodes to completion, answering with the
+      standard /generate JSON (token ids = tokens emitted before the
+      migration + everything decoded here; greedy and seeded sampling are
+      token-identical to an unmigrated run).  400 on a geometry/dtype
+      mismatch, 503 on backpressure.
 
     ``port=0`` binds an ephemeral port (tests); the caller owns
     ``serve_forever()`` / ``shutdown()``.
@@ -1296,9 +1842,21 @@ def make_http_server(
                 return self._reply(200, serving.statusz())
             return self._reply(404, {"error": "unknown path"})
 
+        def _reply_payload(self, data: bytes, request_id: str) -> None:
+            """A binary KV migration payload (/kv/export success)."""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("X-Request-Id", request_id)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_POST(self):  # noqa: N802 (stdlib API)
-            if self.path != "/generate":
+            if self.path == "/kv/import":
+                return self._kv_import()
+            if self.path not in ("/generate", "/kv/export"):
                 return self._reply(404, {"error": "unknown path"})
+            migrate = self.path == "/kv/export"
             # Trace-id adoption: an inbound X-Request-Id (minted by the
             # fleet router, or sent by a client directly) becomes THE
             # request_id tagging this request's serve/* spans and engine
@@ -1332,6 +1890,7 @@ def make_http_server(
                     deadline_s=body.get("deadline_s"),
                     session=body.get("session"),
                     request_id=trace_id,
+                    migrate=migrate,
                 )
             except (QueueFullError, DuplicateRequestError) as exc:
                 # Both are "this replica can't take THIS request right
@@ -1354,6 +1913,10 @@ def make_http_server(
                     503, {"error": str(exc), "request_id": trace_id},
                     request_id=trace_id,
                 )
+            if result.finish_reason == "migrated":
+                return self._reply_payload(
+                    result.kv_payload, result.request_id
+                )
             payload = {
                 "request_id": result.request_id,
                 "token_ids": list(result.token_ids),
@@ -1364,6 +1927,48 @@ def make_http_server(
                 ids = list(result.token_ids)
                 if result.finish_reason == "stop":
                     ids = ids[:-1]  # the stop token itself isn't prose
+                payload["completion"] = serving.tokenizer.decode(ids)
+            self._reply(200, payload, request_id=result.request_id)
+
+        def _kv_import(self):
+            """POST /kv/import: graft a KV payload, decode to completion,
+            answer with the standard generate JSON."""
+            trace_id = (self.headers.get("X-Request-Id") or "").strip()
+            trace_id = trace_id[:128] or None
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                data = self.rfile.read(length)
+                handle = serving.submit_import(data)
+                result = handle.result()
+            except (QueueFullError, DuplicateRequestError) as exc:
+                return self._reply(
+                    503, {"error": str(exc)}, request_id=trace_id
+                )
+            except (ValueError, TypeError, KeyError, IndexError) as exc:
+                # KeyError/IndexError: a JSON-valid but structurally
+                # corrupt payload header (missing meta keys, bogus array
+                # manifest) — the caller's bad payload, never a replica
+                # fault (a dropped connection here would make the router
+                # mark healthy decode replicas down and replay the same
+                # corrupt bytes across the pool).
+                return self._reply(
+                    400, {"error": f"bad payload: {exc!r}"},
+                    request_id=trace_id,
+                )
+            except RuntimeError as exc:
+                return self._reply(
+                    503, {"error": str(exc)}, request_id=trace_id
+                )
+            payload = {
+                "request_id": result.request_id,
+                "token_ids": list(result.token_ids),
+                "finish_reason": result.finish_reason,
+                "timings": result.timings(),
+            }
+            if serving.tokenizer is not None:
+                ids = list(result.token_ids)
+                if result.finish_reason == "stop":
+                    ids = ids[:-1]
                 payload["completion"] = serving.tokenizer.decode(ids)
             self._reply(200, payload, request_id=result.request_id)
 

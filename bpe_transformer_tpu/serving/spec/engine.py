@@ -360,6 +360,58 @@ class SpecEngine(PagedEngine):
         out.update(self.spec_gauges())
         return out
 
+    # ------------------------------------------------------------ migration
+
+    def export_slot(self, slot: int, extra_meta: dict | None = None) -> dict:
+        """Base payload + the slot's draft RNG key, so a speculative
+        importer's proposal chain continues where this replica's left
+        off (greedy migration is exact regardless — accepted tokens are
+        always the target argmax chain)."""
+        extra = dict(extra_meta or {})
+        if self._active[slot]:
+            extra.setdefault(
+                "draft_key", [int(k) for k in self._draft_keys[slot]]
+            )
+        return super().export_slot(slot, extra)
+
+    def import_slot(self, payload: dict) -> int:
+        """Graft + draft catch-up: the dense draft cache is NOT shipped
+        (a few percent of the target's bytes, but rebuildable) — the
+        draft re-prefills from the grafted prefix's token history
+        (``meta["history"]``: prompt + every emitted token), exactly the
+        catch-up a fresh admission's final chunk performs.  K/V at a
+        position is a pure function of the token prefix, so the draft's
+        proposals resume from equivalent state; greedy output stays
+        token-identical to the un-migrated generation by the acceptance
+        rule (the emitted chain is the target argmax chain either way).
+        """
+        meta = payload["meta"]
+        if meta.get("decoding") and meta.get("history") is None:
+            raise ValueError(
+                "speculative import needs meta['history'] (prompt + "
+                "emitted tokens) to re-prefill the draft cache"
+            )
+        slot = super().import_slot(payload)
+        if meta["decoding"]:
+            history = [int(t) for t in meta["history"]]
+            pos = int(meta["position"])
+            bucket = self._draft_bucket_for(pos)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :pos] = history[:pos]
+            self._draft_cache = self._draft_prefill_jit(
+                self.draft.params, self.draft.lm_head, self._draft_cache,
+                padded, np.int32(pos), np.int32(slot),
+            )
+            draft_key = meta.get("draft_key")
+            self._draft_keys[slot] = (
+                np.asarray(draft_key, np.uint32)
+                if draft_key is not None
+                else np.asarray(
+                    jax.random.PRNGKey(int(meta["seed"]) ^ 0x5BEC)
+                )
+            )
+        return slot
+
     # ------------------------------------------------------------ lifecycle
 
     def _draft_bucket_for(self, length: int) -> int:

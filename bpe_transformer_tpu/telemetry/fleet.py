@@ -210,6 +210,13 @@ class FleetAggregator:
                 "online": bool(page.get("worker_alive", True)),
                 "draining": bool(page.get("draining", False)),
                 "engine_kind": page.get("engine_kind"),
+                "role": page.get("role") or "both",
+                "migrations_out": samples.get(
+                    "bpe_tpu_migrations_out_total"
+                ),
+                "migrations_in": samples.get(
+                    "bpe_tpu_migrations_in_total"
+                ),
                 "queue_depth": int(page.get("queue_depth") or 0),
                 "slots": int(page.get("slots") or 0),
                 "active_slots": int(page.get("active_slots") or 0),
@@ -379,6 +386,30 @@ class FleetAggregator:
             "accept_rate": (
                 round(accepted / proposed, 4) if proposed else None
             ),
+            # Disaggregated-fleet shape + KV transport volume (ISSUE 15):
+            # role census and cumulative migration counts, so one fleet
+            # record answers "is the two-tier split carrying traffic".
+            # Counts stay explicit zeros while ANY replica answers — a
+            # prefill tier that died must read 0, not vanish (an
+            # absent-gauge alert can never fire).
+            "replicas_prefill": (
+                sum(1 for s in online if s.get("role") == "prefill")
+                if online else None
+            ),
+            "replicas_decode": (
+                sum(1 for s in online if s.get("role") == "decode")
+                if online else None
+            ),
+            "migrations_out": (
+                sum(int(s.get("migrations_out") or 0) for s in online)
+                if any(s.get("migrations_out") is not None for s in online)
+                else None
+            ),
+            "migrations_in": (
+                sum(int(s.get("migrations_in") or 0) for s in online)
+                if any(s.get("migrations_in") is not None for s in online)
+                else None
+            ),
             "compile_events": (
                 sum(s.get("compile_events") or 0 for s in online)
                 if any(s.get("compile_events") is not None for s in online)
@@ -484,6 +515,18 @@ class FleetAggregator:
         emit("accept_rate", "gauge",
              "Fleet speculative-decoding acceptance rate.",
              [({}, latest.get("accept_rate"))])
+        emit("replicas_prefill", "gauge",
+             "Online prefill-role replicas (disaggregated tier census).",
+             [({}, latest.get("replicas_prefill"))])
+        emit("replicas_decode", "gauge",
+             "Online decode-role replicas (disaggregated tier census).",
+             [({}, latest.get("replicas_decode"))])
+        emit("migrations_out_total", "counter",
+             "Fleet-summed sessions exported as KV payloads.",
+             [({}, latest.get("migrations_out"))])
+        emit("migrations_in_total", "counter",
+             "Fleet-summed sessions grafted from KV payloads.",
+             [({}, latest.get("migrations_in"))])
         emit("availability", "gauge",
              "Cumulative routed-request success fraction (router counters).",
              [({}, latest.get("availability"))])

@@ -85,6 +85,20 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
             for key in ("kv_pool_bytes", "kv_bytes_per_token"):
                 if record.get(key) is not None:
                     state[key] = record[key]
+        elif kind == "migration":
+            # KV-slot migration (ISSUE 15): count moves/bytes per
+            # direction — the kv panel's disaggregated-transport view.
+            direction = record.get("direction")
+            key = "kv_migrations_in" if direction == "import" else (
+                "kv_migrations_out"
+            )
+            state[key] = state.get(key, 0) + 1
+            state["kv_migration_bytes"] = (
+                state.get("kv_migration_bytes", 0)
+                + (record.get("bytes") or 0)
+            )
+            if record.get("total_s") is not None:
+                state["kv_migration_last_s"] = record["total_s"]
         elif kind == "spec":
             # Speculative-decoding snapshot (serving/spec/): acceptance
             # rate + emitted-per-verify-pass, the serve panel's spec view.
@@ -270,6 +284,9 @@ def fold_prometheus(samples: dict, prefix: str = "bpe_tpu") -> dict:
         "kv_prefix_hits": get("prefix_cache_hits_total"),
         "kv_prefix_misses": get("prefix_cache_misses_total"),
         "kv_prefill_pending_tokens": get("prefill_pending_tokens"),
+        # KV-migration counters (ISSUE 15; absent on pre-role replicas).
+        "kv_migrations_out": get("migrations_out_total"),
+        "kv_migrations_in": get("migrations_in_total"),
         # Speculative-decoding gauges (absent on non-spec replicas).
         "spec_k": get("spec_k"),
         "spec_accept_rate": get("spec_accept_rate"),
@@ -371,10 +388,14 @@ def render_frame(state: dict, source: str) -> str:
                 )
             )
 
-    if state.get("kv_blocks_total") is not None:
-        free = state.get("kv_blocks_free")
-        total = state["kv_blocks_total"]
-        parts = [f"blocks {_num(free)}/{_num(total)} free"]
+    if state.get("kv_blocks_total") is not None or state.get(
+        "kv_migrations_out"
+    ) or state.get("kv_migrations_in"):
+        parts = []
+        if state.get("kv_blocks_total") is not None:
+            free = state.get("kv_blocks_free")
+            total = state["kv_blocks_total"]
+            parts.append(f"blocks {_num(free)}/{_num(total)} free")
         if state.get("kv_blocks_shared"):
             parts.append(f"shared {_num(state['kv_blocks_shared'])}")
         hits, misses = (
@@ -394,6 +415,16 @@ def render_frame(state: dict, source: str) -> str:
             parts.append(f"pool {state['kv_pool_bytes'] / 2**20:.1f}M")
         if state.get("kv_bytes_per_token"):
             parts.append(f"{_num(state['kv_bytes_per_token'])}B/tok")
+        if state.get("kv_migrations_out") or state.get("kv_migrations_in"):
+            parts.append(
+                f"mig {_num(state.get('kv_migrations_out', 0))}out/"
+                f"{_num(state.get('kv_migrations_in', 0))}in"
+                + (
+                    f" {state['kv_migration_bytes'] / 2**20:.1f}M"
+                    if state.get("kv_migration_bytes")
+                    else ""
+                )
+            )
         lines.append("  kv     " + "  ".join(parts))
 
     if state.get("spec_k") is not None:

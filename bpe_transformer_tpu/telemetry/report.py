@@ -316,6 +316,52 @@ def summarize(records: list[dict]) -> dict:
             "kv_bytes_per_token": last.get("kv_bytes_per_token"),
         }
 
+    # KV migration records (kind="migration", ISSUE 15): the
+    # disaggregated fleet's transport — counts/bytes per direction, the
+    # export/transfer/import split, and the total-duration tail the
+    # migration_p99_s compare row gates.  When migrations are present the
+    # serving decode-phase p99 doubles as decode_p99_disagg: the decode
+    # latency of a run whose decode tier never paid a prompt-sized stall,
+    # gateable against a monolithic baseline.
+    migration_records = [r for r in records if r.get("kind") == "migration"]
+    migration_summary = None
+    if migration_records:
+        by_dir: dict[str, int] = {}
+        for r in migration_records:
+            d = str(r.get("direction"))
+            by_dir[d] = by_dir.get(d, 0) + 1
+        totals = [
+            r.get("total_s")
+            for r in migration_records
+            if isinstance(r.get("total_s"), (int, float))
+        ]
+        migration_summary = {
+            "n": len(migration_records),
+            "by_direction": by_dir,
+            "bytes_total": sum(
+                r.get("bytes") or 0 for r in migration_records
+            ),
+            "blocks_total": sum(
+                r.get("blocks") or 0 for r in migration_records
+            ),
+            "export_s": _stats(
+                [r.get("export_s") for r in migration_records]
+            ),
+            "transfer_s": _stats(
+                [r.get("transfer_s") for r in migration_records]
+            ),
+            "import_s": _stats(
+                [r.get("import_s") for r in migration_records]
+            ),
+            "p50_s": _pctl(totals, 0.50),
+            "p99_s": _pctl(totals, 0.99),
+            "decode_p99_s": (
+                ((serving or {}).get("phases") or {})
+                .get("decode", {})
+                .get("p99_s")
+            ),
+        }
+
     # Decode-tick roofline trajectory (kind="roofline", ISSUE 11): the
     # weight sweep is static per run (last sample wins — the compare
     # gate's serve_weight_bytes), the KV/activation terms track occupancy.
@@ -719,6 +765,7 @@ def summarize(records: list[dict]) -> dict:
         },
         "serving": serving,
         "kvpool": kvpool_summary,
+        "migration": migration_summary,
         "spec": spec_summary,
         "fleet": fleet_summary,
         "slo": slo_summary,
@@ -921,6 +968,34 @@ def render_report(records: list[dict]) -> str:
                     if per_tok is not None
                     else ""
                 )
+            )
+
+    mg = s.get("migration")
+    if mg:
+        lines.append(f"== kv migration ({mg['n']} moves) ==")
+        dirs = mg.get("by_direction") or {}
+        lines.append(
+            "  "
+            + "  ".join(
+                f"{d} {dirs[d]}" for d in ("export", "import", "evacuate")
+                if d in dirs
+            )
+            + f"  bytes {_fmt(mg['bytes_total'])}"
+            + f"  blocks {_fmt(mg['blocks_total'])}"
+        )
+        exp = mg.get("export_s") or {}
+        imp = mg.get("import_s") or {}
+        tra = mg.get("transfer_s") or {}
+        lines.append(
+            f"  export mean {_fmt(exp.get('mean'))}s"
+            f"  transfer mean {_fmt(tra.get('mean'))}s"
+            f"  import mean {_fmt(imp.get('mean'))}s"
+            f"  total p99 {_fmt(mg.get('p99_s'))}s"
+        )
+        if mg.get("decode_p99_s") is not None:
+            lines.append(
+                f"  disaggregated decode p99 {_fmt(mg['decode_p99_s'])}s"
+                "  (decode tier never pays a prompt-sized stall)"
             )
 
     rf = s.get("roofline")
@@ -1326,6 +1401,16 @@ COMPARE_METRICS: dict = {
     # this number, so it gates like a throughput regression.
     "serve_weight_bytes": (
         lambda s: (s.get("roofline") or {}).get("weight_bytes"), "lower"),
+    # Disaggregated-serving gates (kind="migration", ISSUE 15): the
+    # migration tail (a transport regression shows up here before it
+    # shows up in request p99) and the disaggregated decode p99 — the
+    # headline the two-tier split exists for; a stream whose migrated-run
+    # decode p99 grows back toward the monolithic baseline lost the
+    # prefill/decode isolation win.
+    "migration_p99_s": (
+        lambda s: (s.get("migration") or {}).get("p99_s"), "lower"),
+    "decode_p99_disagg": (
+        lambda s: (s.get("migration") or {}).get("decode_p99_s"), "lower"),
     # Speculative-decoding effectiveness (kind="spec"): a workload whose
     # draft acceptance falls — or whose emitted-tokens-per-verify-pass
     # sinks toward 1.0 — lost the tick-count win speculation pays for

@@ -134,6 +134,19 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
     "roofline": {
         "kind", "t", "weight_bytes", "kv_bytes", "flops",
     },
+    # KV-slot migration (serving/server.py, ISSUE 15): one record per KV
+    # move in the disaggregated fleet.  ``direction`` is ``export`` (a
+    # prefill-role replica streamed a finished prefix out), ``import`` (a
+    # decode replica grafted a payload), or ``evacuate`` (a draining
+    # replica exported an in-flight session to a peer).  ``bytes`` is the
+    # serialized payload size, ``blocks`` the KV blocks moved.  Import
+    # records additionally carry the phase split — optional ``export_s``
+    # (from the source's meta), ``transfer_s`` (export -> graft wall,
+    # wall-clock-derived), ``import_s`` (the graft itself), and their
+    # ``total_s`` (the compare gate's migration_p99_s evidence) — plus
+    # ``request_id`` so migration hops join the cross-stream request
+    # timeline next to the serve/migration_* spans.
+    "migration": {"kind", "t", "direction", "bytes", "blocks"},
     # Fleet sweep (telemetry/fleet.py, `bpe-tpu fleet`): one concurrent
     # poll of every replica's /statusz+/metrics (plus the router's
     # counters) merged into fleet-level gauges — online/draining counts,

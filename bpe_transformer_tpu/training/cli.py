@@ -399,6 +399,15 @@ def cmd_serve(args) -> int:
         print("serve: --kv-dtype int8 needs --paged (the int8 scale pools "
               "live in the block pool)", file=sys.stderr)
         return 2
+    if args.role != "both" and not args.paged:
+        print(f"serve: --role {args.role} needs --paged (KV migration "
+              "payloads are block chains)", file=sys.stderr)
+        return 2
+    if args.role == "prefill" and args.prompts_file:
+        print("serve: --role prefill cannot run offline batch mode (it "
+              "never decodes; prefixes stream out over /kv/export)",
+              file=sys.stderr)
+        return 2
     if args.decode_attention == "paged" and not args.paged:
         print("serve: --decode-attention paged needs --paged (the kernel "
               "reads through the block table)", file=sys.stderr)
@@ -481,6 +490,7 @@ def cmd_serve(args) -> int:
         fused_sampling=args.fused_sampling,
         speculate_k=args.speculate,
         draft_spec=draft_spec,
+        role=args.role,
     )
     try:
         with serving:
@@ -524,8 +534,9 @@ def cmd_serve(args) -> int:
             signal.signal(signal.SIGTERM, _sigterm)
             print(
                 f"serving on http://{host}:{port}  "
-                f"(slots={args.slots}, queue={args.max_queue}; "
-                "POST /generate, GET /healthz /metrics /statusz; "
+                f"(slots={args.slots}, queue={args.max_queue}, "
+                f"role={args.role}; POST /generate /kv/export /kv/import, "
+                "GET /healthz /metrics /statusz; "
                 "Ctrl-C/SIGTERM drains then stops)",
                 flush=True,
             )
@@ -565,6 +576,8 @@ def cmd_route(args) -> int:
         "--request-timeout", str(args.request_timeout),
         "--connect-timeout", str(args.connect_timeout),
     ]
+    if args.prefill_threshold is not None:
+        forwarded += ["--prefill-threshold", str(args.prefill_threshold)]
     if args.metrics_jsonl:
         forwarded += ["--metrics-jsonl", args.metrics_jsonl]
     return route_main(forwarded)
@@ -746,12 +759,25 @@ def cmd_warmup(args) -> int:
     from bpe_transformer_tpu.utils.compile_cache import enable_compile_cache
 
     if args.train:
-        if args.speculate or args.paged:
+        if args.speculate or args.paged or args.role != "both":
             print("warmup: --train warms the training-step programs; it "
                   "composes with serving flags in separate invocations, "
                   "not one", file=sys.stderr)
             return 2
         return _warmup_train(args)
+
+    # Role-scoped warmup (ISSUE 15): a disaggregated node must not pay
+    # compile time for programs it never runs — prefill replicas warm
+    # chunk buckets + export (no tick), decode replicas warm tick +
+    # import (no chunk ladder).
+    if args.role != "both" and not args.paged:
+        print(f"warmup: --role {args.role} needs --paged", file=sys.stderr)
+        return 2
+    if args.role == "prefill" and args.speculate:
+        print("warmup: --role prefill never ticks; speculation lives on "
+              "decode replicas (warm them with --role decode)",
+              file=sys.stderr)
+        return 2
 
     # Speculative-decoding fast-fail (PR 9 style): structural checks and
     # the jax-free DraftSpec parse before any model/compile work; the
@@ -859,7 +885,7 @@ def cmd_warmup(args) -> int:
                 # ladder rung, and its repeated dummy prompts would
                 # otherwise share a prefix and shrink later rungs' chunks
                 # into already-compiled programs.
-                factories.append(
+                factory = (
                     lambda kv_dtype=kv_dtype, weight_dtype=weight_dtype: cls(
                         params, model_config, slots=args.slots,
                         block_size=args.block_size,
@@ -870,6 +896,16 @@ def cmd_warmup(args) -> int:
                         fused_sampling=args.fused_sampling, **extra,
                     )
                 )
+                # Migration programs touch only the POOL (no weights), so
+                # a both-role warm runs them once per pool width — the
+                # later weight-width engines would only re-land identical
+                # cache entries.  Spec engines skip it here: their import
+                # path is `--role decode`'s job (it additionally warms
+                # the draft catch-up ladder).
+                factory.warm_migration = (
+                    not args.speculate and weight_dtype == weight_dtypes[0]
+                )
+                factories.append(factory)
     else:
         from bpe_transformer_tpu.serving import SlotPoolEngine
 
@@ -892,28 +928,84 @@ def cmd_warmup(args) -> int:
         engine = factory()
         if buckets is None:
             buckets = list(engine.buckets)
-        # Speculative engines walk the DRAFT prefill ladder (it runs to
-        # the full context; chunked prefill splits long rungs into the
-        # already-walked chunk buckets), so draft prefill + propose +
-        # verify all warm alongside the target chunk programs.  The
-        # max_new_tokens budget of 2 still exercises a full spec tick.
-        ladder = (
-            engine.draft_buckets if args.speculate else engine.buckets
-        )
-        for bucket in ladder:
-            plen = min(bucket, ctx - 2)
-            event = engine.admit(
-                [1] * plen, max_new_tokens=2, temperature=0.0
+        if args.role == "decode":
+            # Decode-role ladder: tick + the import copy program ONLY —
+            # grafts are synthesized host-side (zero KV rows; warmup
+            # cares about program shapes), so the chunk ladder never
+            # compiles.  Speculative engines import at every draft
+            # bucket position, warming the draft catch-up re-prefill
+            # ladder + propose + verify alongside.
+            from bpe_transformer_tpu.serving.kvpool.migrate import (
+                synthetic_decode_payload,
             )
-            while not event.finished:
-                events = engine.tick()
-                event = next(e for e in events if e.slot == event.slot)
+
+            positions = (
+                [min(b, ctx - 2) for b in engine.draft_buckets]
+                if args.speculate
+                else [min(engine.block_size, ctx - 2)]
+            )
+            for plen in positions:
+                slot = engine.import_slot(
+                    synthetic_decode_payload(
+                        model_config, block_size=engine.block_size,
+                        kv_dtype=engine.kv_dtype, prompt_len=plen,
+                        max_new_tokens=2,
+                    )
+                )
+                while engine._active[slot]:
+                    engine.tick()
+        else:
+            # Speculative engines walk the DRAFT prefill ladder (it runs
+            # to the full context; chunked prefill splits long rungs into
+            # the already-walked chunk buckets), so draft prefill +
+            # propose + verify all warm alongside the target chunk
+            # programs.  The max_new_tokens budget of 2 still exercises a
+            # full spec tick.
+            ladder = (
+                engine.draft_buckets if args.speculate else engine.buckets
+            )
+            for bucket in ladder:
+                plen = min(bucket, ctx - 2)
+                event = engine.admit(
+                    [1] * plen, max_new_tokens=2, temperature=0.0
+                )
+                if args.role == "prefill":
+                    # Prefill-role ladder: chunk buckets + the export
+                    # extract program; the tick NEVER compiles here.
+                    if not event.finished:
+                        engine.export_slot(event.slot)
+                        engine.release(event.slot)
+                    continue
+                while not event.finished:
+                    events = engine.tick()
+                    event = next(e for e in events if e.slot == event.slot)
+            if (
+                args.role == "both" and args.paged
+                and getattr(factory, "warm_migration", False)
+            ):
+                # A both-role replica may evacuate (export) and accept
+                # grafts (import): warm the migration pair too.
+                from bpe_transformer_tpu.serving.kvpool.migrate import (
+                    synthetic_decode_payload,
+                )
+
+                slot = engine.import_slot(
+                    synthetic_decode_payload(
+                        model_config, block_size=engine.block_size,
+                        kv_dtype=engine.kv_dtype,
+                        prompt_len=min(engine.block_size, ctx - 2),
+                        max_new_tokens=2,
+                    )
+                )
+                engine.export_slot(slot)
+                engine.release(slot)
         programs += engine.compiled_programs()
         del engine
 
     summary = {
         "programs_compiled": programs,
         "buckets": buckets,
+        "role": args.role,
         "engine": (
             "spec" if args.speculate else "paged" if args.paged else "dense"
         ),
@@ -1507,6 +1599,16 @@ def build_parser() -> argparse.ArgumentParser:
                    'geometry {"d_model", "num_layers", "num_heads", '
                    '"d_ff"[, "num_kv_heads", "seed"]}; the vocabulary '
                    "must match the target (validated up front)")
+    p.add_argument("--role", choices=("prefill", "decode", "both"),
+                   default="both",
+                   help="disaggregated-fleet role (with --paged): "
+                   "'prefill' runs the chunk machine and streams finished "
+                   "prefixes out over POST /kv/export instead of ticking; "
+                   "'decode' accepts KV grafts on POST /kv/import and "
+                   "runs pure decode ticks (fed only imports it never "
+                   "compiles a chunk program); 'both' (default) serves "
+                   "everything — pair with bpe-tpu route "
+                   "--prefill-threshold for two-tier scheduling")
     p.add_argument("--special-token", action="append", default=None,
                    help='repeatable; default: ["<|endoftext|>"]')
     p.set_defaults(fn=cmd_serve)
@@ -1533,6 +1635,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--connect-timeout", type=float, default=5.0,
                    help="seconds to wait for a replica's TCP connect "
                    "before failing over")
+    p.add_argument("--prefill-threshold", type=int, default=None,
+                   metavar="TOKENS",
+                   help="two-tier disaggregated scheduling: prompts of "
+                   ">= TOKENS prefill on a --role prefill replica and "
+                   "decode on the least-loaded decode replica via KV "
+                   "migration; shorter prompts bypass straight to decode "
+                   "nodes")
     p.add_argument("--metrics-jsonl", default=None,
                    help="write the router's trace stream (pick/hop/"
                    "request spans per proxied request) to this JSONL; "
@@ -1610,6 +1719,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused-sampling", action="store_true",
                    help="warm the fused sample-in-kernel tick programs "
                    "(serve --fused-sampling replicas)")
+    p.add_argument("--role", choices=("prefill", "decode", "both"),
+                   default="both",
+                   help="warm only this role's ladder (with --paged): "
+                   "'prefill' = chunk buckets + the export program, no "
+                   "tick; 'decode' = tick + the import copy program via "
+                   "synthetic grafts, no chunk ladder; 'both' (default) "
+                   "= everything incl. the migration pair — "
+                   "disaggregated nodes stop paying compile time for "
+                   "programs they never run")
     p.add_argument("--speculate", type=int, default=0, metavar="K",
                    help="warm the speculative-decoding programs (with "
                    "--paged + --draft-config): target chunk ladder + "

@@ -523,7 +523,10 @@ def test_warmup_cli_two_process_cache_hits(tmp_path):
     assert cold["weight_dtypes"] == ["act", "int8"]
     assert cold["fused_sampling"] is True
     assert cold["decode_attention"] == "paged"
-    assert cold["programs_compiled"] <= 4 * (len(cold["buckets"]) + 1)
+    # 4 ladders (kv x weight widths, buckets + tick each) + the both-role
+    # migration pair (inject + extract), warmed once per POOL width —
+    # weight width doesn't change the migration programs (ISSUE 15).
+    assert cold["programs_compiled"] <= 4 * (len(cold["buckets"]) + 1) + 4
     assert any(cache_dir.rglob("*")), "warmup wrote no cache entries"
     warm = run()
     assert warm["cache_hits"] > 0
@@ -834,11 +837,17 @@ def test_cli_serve_flag_validation():
     from bpe_transformer_tpu.training.cli import cmd_serve
 
     base = dict(prompts_file=None, output=None, compile_cache=None,
-                paged=False, speculate=0, draft_config=None)
+                paged=False, speculate=0, draft_config=None, role="both")
     args = argparse.Namespace(kv_dtype="int8", decode_attention=None, **base)
     assert cmd_serve(args) == 2
     args = argparse.Namespace(kv_dtype="act", decode_attention="paged",
                               **base)
+    assert cmd_serve(args) == 2
+    # Disaggregated roles are paged-engine knobs too (ISSUE 15).
+    args = argparse.Namespace(
+        kv_dtype="act", decode_attention=None,
+        **{**base, "role": "prefill"},
+    )
     assert cmd_serve(args) == 2
 
 
@@ -1082,6 +1091,353 @@ def test_allocator_no_leak_under_rewind_churn(setup):
             engine.release(slot)
     assert engine.allocator.free_count == usable
     assert engine.allocator.shared_count == 0
+
+
+# --------------------------------------- KV migration (ISSUE 15 tentpole)
+
+
+from bpe_transformer_tpu.serving.kvpool.migrate import (  # noqa: E402
+    payload_from_bytes,
+    payload_nbytes,
+    payload_to_bytes,
+    synthetic_decode_payload,
+)
+
+
+@pytest.fixture(scope="module")
+def migration_target(setup):
+    """A second engine, same geometry — the 'replica B' every migration
+    test grafts into (module-scoped: engines are the expensive resource)."""
+    params, _ = setup
+    return PagedEngine(params, CFG, slots=2, block_size=8, min_bucket=8)
+
+
+def _continue_on(engine, slot, event):
+    out = []
+    while not event.finished:
+        event = next(e for e in engine.tick() if e.slot == slot)
+        out.append(event.token)
+    return out
+
+
+def test_payload_codec_roundtrip_and_corruption():
+    """The wire format is self-describing and fails loudly: bytes round
+    trip exactly; bad magic, wrong version, and truncation raise."""
+    payload = synthetic_decode_payload(
+        CFG, block_size=8, kv_dtype="int8", prompt_len=9, max_new_tokens=3
+    )
+    data = payload_to_bytes(payload)
+    back = payload_from_bytes(data)
+    assert back["meta"] == payload["meta"]
+    for a, b in zip(payload["layers"], back["layers"]):
+        for name in a:
+            np.testing.assert_array_equal(a[name], b[name])
+    assert payload_nbytes(back) == payload_nbytes(payload)
+    with pytest.raises(ValueError, match="magic"):
+        payload_from_bytes(b"nonsense")
+    with pytest.raises(ValueError, match="version"):
+        payload_from_bytes(b"BPEKV999" + data[8:])
+    with pytest.raises(ValueError, match="truncated"):
+        payload_from_bytes(data[: len(data) - 64])
+
+
+def test_export_import_roundtrip_token_identical(
+    setup, dense_engine, paged_engine, migration_target
+):
+    """ACCEPTANCE (ISSUE 15): a generation prefixed + partially decoded on
+    replica A and continued on replica B is token-identical to the same
+    request served monolithically — greedy exact AND seeded sampling
+    exact (the RNG key rides the payload)."""
+    params, prompts = setup
+    src, dst = paged_engine, migration_target
+    for prompt, kn in (
+        (prompts[2], dict(temperature=0.0)),
+        (prompts[3], dict(temperature=0.9, top_k=7, top_p=0.8, seed=3)),
+    ):
+        ref = _run(dense_engine, prompt, max_new_tokens=8, **kn)
+        event = src.admit(prompt, max_new_tokens=8, **kn)
+        out = [event.token]
+        slot = event.slot
+        for _ in range(3):  # migrate MID-generation, not at a boundary
+            event = next(e for e in src.tick() if e.slot == slot)
+            out.append(event.token)
+        payload = payload_from_bytes(
+            payload_to_bytes(src.export_slot(slot))
+        )
+        src.release(slot)
+        slot_b = dst.import_slot(payload)
+        out += _continue_on(dst, slot_b, event)
+        assert out == ref, f"migration divergence for {kn}"
+
+
+def test_import_mid_prefill_frontier_resumes(setup, dense_engine):
+    """A payload exported MID-CHUNKED-PREFILL (frontier between chunks)
+    resumes on the importer — remaining chunks run there, then decode —
+    token-identical to the dense whole-prompt run."""
+    params, prompts = setup
+    src = PagedEngine(
+        params, CFG, slots=1, block_size=8, min_bucket=8, prefill_chunk=8
+    )
+    dst = PagedEngine(
+        params, CFG, slots=1, block_size=8, min_bucket=8, prefill_chunk=8
+    )
+    prompt = prompts[3] + [5, 6]  # 21 tokens = 3 chunks of 8
+    ref = _run(dense_engine, prompt, max_new_tokens=6, temperature=0.0)
+    slot = src.begin(prompt, max_new_tokens=6, temperature=0.0)
+    assert src.prefill_step(slot) is None  # one chunk in, frontier at 8
+    payload = src.export_slot(slot)
+    assert payload["meta"]["decoding"] is False
+    assert payload["meta"]["next_pos"] == 8
+    src.release(slot)
+    slot_b = dst.import_slot(payload_from_bytes(payload_to_bytes(payload)))
+    event = dst.prefill_step(slot_b)
+    while event is None:
+        event = dst.prefill_step(slot_b)
+    out = [event.token] + _continue_on(dst, slot_b, event)
+    assert out == ref
+
+
+def test_export_never_mutates_shared_radix_blocks(setup, paged_engine):
+    """ACCEPTANCE (satellite): exporting a slot whose chain includes
+    radix-shared blocks is strictly read-only — refcounts, the radix
+    index, and the shared blocks' pool rows are bitwise untouched."""
+    params, prompts = setup
+    engine = paged_engine
+    base = prompts[3]  # 19 tokens: 2 full blocks -> radix-indexed
+    _run(engine, base + [33, 34], max_new_tokens=4, temperature=0.0)
+    slot = engine.begin(base + [41, 42, 43], max_new_tokens=4,
+                        temperature=0.0)
+    assert engine.slot_shared_len(slot) == 16
+    shared_ids = engine._slots[slot].block_ids[:2]
+    refs_before = [engine.allocator.refcount(b) for b in shared_ids]
+    rows_before = [
+        np.asarray(engine._pool[0]["k"][b]).copy() for b in shared_ids
+    ]
+    nodes_before = len(engine.prefix_cache)
+    event = engine.prefill_step(slot)
+    while event is None:
+        event = engine.prefill_step(slot)
+    payload = engine.export_slot(slot)
+    # Only WRITTEN blocks ship (position 22 -> 3 of the 4-block chain).
+    written = -(-int(engine._positions[slot]) // engine.block_size)
+    assert payload["meta"]["n_blocks"] == written
+    assert written < len(engine._slots[slot].block_ids)
+    assert [engine.allocator.refcount(b) for b in shared_ids] == refs_before
+    assert len(engine.prefix_cache) >= nodes_before
+    for b, before in zip(shared_ids, rows_before):
+        np.testing.assert_array_equal(
+            np.asarray(engine._pool[0]["k"][b]), before
+        )
+    engine.release(slot)
+
+
+def test_export_import_int8_scales_survive_and_decode_stays_coherent(setup):
+    """ACCEPTANCE (satellite): int8 payloads carry the per-block-per-head
+    scale rows bitwise; the importing slot's continued decode
+    (rescale-on-grow against the imported scales) is token-identical to
+    the unmigrated int8 engine — at act width this also pins the paged
+    pool rows themselves round-tripping bitwise."""
+    params, prompts = setup
+    src = PagedEngine(params, CFG, slots=2, block_size=8, min_bucket=8,
+                      kv_dtype="int8")
+    dst = PagedEngine(params, CFG, slots=2, block_size=8, min_bucket=8,
+                      kv_dtype="int8")
+    mono = PagedEngine(params, CFG, slots=2, block_size=8, min_bucket=8,
+                       kv_dtype="int8")
+    for prompt, kn in (
+        (prompts[2], dict(temperature=0.0)),
+        (prompts[3], dict(temperature=0.9, top_k=7, top_p=0.8, seed=3)),
+    ):
+        ref = _run(mono, prompt, max_new_tokens=8, **kn)
+        event = src.admit(prompt, max_new_tokens=8, **kn)
+        out = [event.token]
+        slot = event.slot
+        # Decode past a block boundary so rescale-on-grow has happened.
+        for _ in range(3):
+            event = next(e for e in src.tick() if e.slot == slot)
+            out.append(event.token)
+        payload = src.export_slot(slot)
+        n_written = payload["meta"]["n_blocks"]
+        src_ids = list(src._slots[slot].block_ids)[:n_written]
+        slot_b = dst.import_slot(
+            payload_from_bytes(payload_to_bytes(payload))
+        )
+        # Written blocks (rows + scale rows) round-trip bitwise; the
+        # reservation tail is re-reserved locally, never shipped.
+        dst_ids = list(dst._slots[slot_b].block_ids)[:n_written]
+        for li in (0, len(src._pool) - 1):
+            for name in ("k", "v", "k_scale", "v_scale"):
+                np.testing.assert_array_equal(
+                    np.asarray(src._pool[li][name][np.asarray(src_ids)]),
+                    np.asarray(dst._pool[li][name][np.asarray(dst_ids)]),
+                    err_msg=f"layer {li} {name} rows diverged in transit",
+                )
+        src.release(slot)
+        out += _continue_on(dst, slot_b, event)
+        assert out == ref, f"int8 migration divergence for {kn}"
+
+
+def test_decode_role_import_path_compiles_tick_plus_inject_only(setup):
+    """ACCEPTANCE (compile bound): an engine fed ONLY synthetic grafts —
+    the decode-role replica's whole life — compiles exactly the tick +
+    the per-block inject program.  The chunk ladder never builds, at
+    both pool widths, and chain length never adds programs."""
+    params, _ = setup
+    for kv_dtype in (None, "int8"):
+        engine = PagedEngine(
+            params, CFG, slots=2, block_size=8, min_bucket=8,
+            kv_dtype=kv_dtype,
+        )
+        for plen in (5, 9, 17):  # 1-, 2-, and 3-block chains
+            slot = engine.import_slot(
+                synthetic_decode_payload(
+                    CFG, block_size=8, kv_dtype=engine.kv_dtype,
+                    prompt_len=plen, max_new_tokens=3,
+                )
+            )
+            while engine._active[slot]:
+                engine.tick()
+        breakdown = {
+            name: getattr(engine, name)._cache_size()
+            for name in ("_chunk_jit", "_tick_jit", "_copy_jit",
+                         "_extract_jit", "_inject_jit")
+        }
+        assert engine.compiled_programs() == 2, (
+            f"decode-role bound broken at kv_dtype={kv_dtype}: "
+            f"{engine.compiled_programs()} programs ({breakdown})"
+        )
+        assert engine._chunk_jit._cache_size() == 0
+
+
+def test_import_validation_and_block_exhaustion(setup, paged_engine):
+    """Geometry mismatches are refused before any block is allocated; a
+    dry pool raises NoFreeBlocksError and the retry lands cleanly once
+    blocks free (no leaked blocks/slots from the failed attempt)."""
+    params, _ = setup
+    engine = paged_engine
+    good = synthetic_decode_payload(
+        CFG, block_size=8, kv_dtype=engine.kv_dtype, prompt_len=9,
+        max_new_tokens=2,
+    )
+    bad = {"meta": dict(good["meta"], block_size=16), "layers": good["layers"]}
+    with pytest.raises(ValueError, match="block_size"):
+        engine.import_slot(bad)
+    bad = {"meta": dict(good["meta"], kv_dtype="int8"),
+           "layers": good["layers"]}
+    with pytest.raises(ValueError, match="kv_dtype"):
+        engine.import_slot(bad)
+    # Mid-prefill frontiers must be block-aligned on the importer.
+    bad = {"meta": dict(good["meta"], decoding=False, next_pos=5),
+           "layers": good["layers"]}
+    with pytest.raises(ValueError, match="block-aligned"):
+        engine.import_slot(bad)
+
+    small = PagedEngine(params, CFG, slots=2, block_size=8, num_blocks=4,
+                        min_bucket=8, prefix_cache=False)
+    hog = small.begin([1] * 9, max_new_tokens=5)  # takes 2 of 3 blocks
+    free_before = small.allocator.free_count
+    with pytest.raises(NoFreeBlocksError):
+        small.import_slot(good)  # needs 2 blocks, 1 free
+    assert small.allocator.free_count == free_before, "failed import leaked"
+    assert small.free_slots == 1
+    small.release(hog)
+    slot = small.import_slot(good)
+    assert small._active[slot]
+
+
+def test_spec_engine_migration_greedy_parity(setup):
+    """Speculative decoding composes with migration (ISSUE 15): the
+    importing SpecEngine re-prefills its draft cache from the grafted
+    prefix's token history, and greedy output stays token-identical to
+    the unmigrated paged run (greedy spec == greedy plain by the
+    acceptance rule)."""
+    from bpe_transformer_tpu.serving.spec.draft import DraftSpec
+    from bpe_transformer_tpu.serving.spec.engine import SpecEngine
+
+    params, prompts = setup
+    spec_kwargs = dict(
+        draft=DraftSpec(truncate_layers=1), speculate_k=2, slots=2,
+        block_size=8, min_bucket=8,
+    )
+    src = SpecEngine(params, CFG, **spec_kwargs)
+    dst = SpecEngine(params, CFG, **spec_kwargs)
+    plain = PagedEngine(params, CFG, slots=2, block_size=8, min_bucket=8)
+    prompt = prompts[3]
+    ref = _run(plain, prompt, max_new_tokens=10, temperature=0.0)
+
+    event = src.admit(prompt, max_new_tokens=10, temperature=0.0)
+    out = [event.token]
+    slot = event.slot
+    events = [e for e in src.tick() if e.slot == slot]  # one spec tick
+    out += [e.token for e in events]
+    event = events[-1]
+    assert not event.finished
+    payload = src.export_slot(
+        slot, {"history": list(prompt) + out}
+    )
+    src.release(slot)
+    # Without the history a speculative graft must refuse loudly.
+    headless = {"meta": {k: v for k, v in payload["meta"].items()
+                         if k != "history"},
+                "layers": payload["layers"]}
+    with pytest.raises(ValueError, match="history"):
+        dst.import_slot(headless)
+    slot_b = dst.import_slot(payload)
+    done = False
+    while not done:
+        for e in dst.tick():
+            if e.slot != slot_b:
+                continue
+            out.append(e.token)
+            done = bool(e.finished)
+    assert out == ref
+
+
+def test_migration_fixture_pins_report_and_compare_gate():
+    """The committed migration fixture (schema check #5's pinned wire
+    format) renders the report's kv-migration section and feeds the
+    migration_p99_s / decode_p99_disagg compare-gate rows (ISSUE 15)."""
+    from bpe_transformer_tpu.telemetry.report import (
+        extract_compare_metrics,
+        load_records,
+        render_report,
+        summarize,
+    )
+
+    records = load_records(
+        REPO / "tests" / "fixtures" / "migration_tiny.jsonl"
+    )
+    report = render_report(records)
+    assert "== kv migration (4 moves) ==" in report
+    assert "export 1  import 2  evacuate 1" in report
+    assert "total p99 0.044s" in report
+    assert "disaggregated decode p99 0.9s" in report
+
+    metrics = extract_compare_metrics(summarize(records))
+    assert metrics["migration_p99_s"] == (0.044, "lower")
+    assert metrics["decode_p99_disagg"] == (0.9, "lower")
+
+
+def test_monitor_folds_migration_records():
+    """`bpe-tpu monitor` folds kind="migration" records into the kv line
+    (satellite: migration counters on the monitor's kv view)."""
+    from bpe_transformer_tpu.telemetry.monitor import (
+        fold_records,
+        render_frame,
+    )
+
+    records = [
+        json.loads(ln)
+        for ln in (
+            REPO / "tests" / "fixtures" / "migration_tiny.jsonl"
+        ).read_text().splitlines()
+    ]
+    state = fold_records(records)
+    assert state["kv_migrations_out"] == 2  # export + evacuate
+    assert state["kv_migrations_in"] == 2
+    assert state["kv_migration_bytes"] == 147456 * 2 + 98304 * 2
+    frame = render_frame(state, "fixture")
+    assert "mig 2out/2in" in frame
 
 
 # -------------------------------------------------------- warmup --train

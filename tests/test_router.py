@@ -40,7 +40,7 @@ class _FakeReplica:
 
     def __init__(self, *, slots=4, active=0, queue=0, kv_free=None,
                  kv_total=None, draining=False, generate_code=200,
-                 generate_delay_s=0.0):
+                 generate_delay_s=0.0, role=None, export_code=200):
         self.statusz = {
             "worker_alive": True,
             "draining": draining,
@@ -48,6 +48,8 @@ class _FakeReplica:
             "slots": slots,
             "active_slots": active,
         }
+        if role is not None:
+            self.statusz["role"] = role
         if kv_total is not None:
             self.statusz["kvpool"] = {
                 "kv_blocks_free": kv_free,
@@ -55,7 +57,11 @@ class _FakeReplica:
             }
         self.generate_code = generate_code
         self.generate_delay_s = generate_delay_s
+        self.export_code = export_code
         self.requests_served = 0
+        self.exports_served = 0
+        self.imports_served = 0
+        self.import_bodies: list = []  # payload bytes /kv/import received
         self.seen_request_ids: list = []  # X-Request-Id headers received
         outer = self
 
@@ -81,7 +87,38 @@ class _FakeReplica:
                     self.headers.get("X-Request-Id")
                 )
                 length = int(self.headers.get("Content-Length", 0))
-                self.rfile.read(length)
+                data = self.rfile.read(length)
+                rid = self.headers.get("X-Request-Id") or "x"
+                if self.path == "/kv/export":
+                    # A prefill-role replica: the finished prefix leaves
+                    # as an opaque binary payload.
+                    if outer.export_code != 200:
+                        return self._reply(
+                            outer.export_code, {"error": "export refused"}
+                        )
+                    outer.exports_served += 1
+                    body = b"BPEKV-FAKE-PAYLOAD:" + rid.encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/octet-stream"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return None
+                if self.path == "/kv/import":
+                    outer.import_bodies.append(data)
+                    if outer.generate_code != 200:
+                        return self._reply(
+                            outer.generate_code, {"error": "import refused"}
+                        )
+                    outer.imports_served += 1
+                    return self._reply(
+                        200,
+                        {"token_ids": [7, 8, 9],
+                         "finish_reason": "length",
+                         "request_id": rid, "timings": {}},
+                    )
                 if outer.generate_delay_s:
                     time.sleep(outer.generate_delay_s)
                 if outer.generate_code != 200:
@@ -720,3 +757,114 @@ def test_router_metrics_jsonl_cli_writes_trace_stream(tmp_path):
     manifest = records[0]
     assert manifest["run_kind"] == "route"
     assert "devices" not in manifest  # host_manifest: no backend probe
+
+
+# ----------------------------- two-tier disaggregated routing (ISSUE 15)
+
+
+def test_router_partitions_fleet_by_role_and_threshold():
+    """Long prompts take the two-tier path (export on the prefill-role
+    replica, import on the decode pool); short prompts bypass straight to
+    decode-capable replicas; prefill-role replicas NEVER take a whole
+    /generate."""
+    prefill = _FakeReplica(slots=8, role="prefill")
+    decode = _FakeReplica(slots=2, role="decode")
+    try:
+        router = Router([prefill.url, decode.url], prefill_threshold=8)
+        router.poll_once()
+        roles = {r.url: r.role for r in router.replicas}
+        assert roles == {prefill.url: "prefill", decode.url: "decode"}
+        # The generate pool excludes the prefill-role replica even though
+        # its weight is higher.
+        assert [r.url for r in router.pick_order()] == [decode.url]
+        assert [r.url for r in router.pick_order(pool="prefill")] == [
+            prefill.url
+        ]
+
+        long_body = json.dumps(
+            {"prompt_ids": list(range(16)), "max_new_tokens": 2}
+        ).encode()
+        code, payload = router.handle_generate(long_body, trace_id="tt-1")
+        assert code == 200 and payload["token_ids"] == [7, 8, 9]
+        assert payload["replica"] == decode.url
+        assert prefill.exports_served == 1
+        assert decode.imports_served == 1
+        # The payload crossed the router opaquely, trace id intact.
+        assert decode.import_bodies[0] == b"BPEKV-FAKE-PAYLOAD:tt-1"
+        assert router.requests_migrated == 1
+
+        code, payload = router.handle_generate(_body())  # 3-token prompt
+        assert code == 200 and payload["token_ids"] == [1, 2]
+        assert decode.requests_served == 1
+        assert prefill.exports_served == 1, "short prompts bypass prefill"
+        page = router.statusz()
+        assert page["requests_migrated"] == 1
+        assert page["prefill_threshold"] == 8
+        assert any(r["role"] == "prefill" for r in page["replicas"])
+        assert "requests_migrated_total 1" in router.prometheus_metrics()
+        assert 'role="prefill"' in router.prometheus_metrics()
+    finally:
+        prefill.close()
+        decode.close()
+
+
+def test_router_two_tier_failover_and_degradation():
+    """A refused export fails over across the prefill pool and, when the
+    whole tier is out, degrades to single-tier decode routing (never an
+    error); a refused import fails over across the decode pool."""
+    # Export 503 on the only prefill replica -> the request is served
+    # whole by the decode replica.
+    sick_prefill = _FakeReplica(role="prefill", export_code=503)
+    decode = _FakeReplica(role="decode")
+    try:
+        router = Router(
+            [sick_prefill.url, decode.url], prefill_threshold=4
+        )
+        router.poll_once()
+        code, payload = router.handle_generate(
+            json.dumps({"prompt_ids": list(range(12))}).encode()
+        )
+        assert code == 200 and payload["token_ids"] == [1, 2]
+        assert decode.requests_served == 1
+        assert router.requests_migrated == 0
+        assert router.requests_failed == 0
+    finally:
+        sick_prefill.close()
+        decode.close()
+
+    # Import 503 on the best decode replica -> the payload replays on the
+    # next decode replica (grafts are deterministic; replay is safe).
+    prefill = _FakeReplica(role="prefill")
+    full = _FakeReplica(slots=8, role="decode", generate_code=503)
+    spare = _FakeReplica(slots=1, active=1, role="decode")
+    try:
+        router = Router(
+            [prefill.url, full.url, spare.url], prefill_threshold=4
+        )
+        router.poll_once()
+        code, payload = router.handle_generate(
+            json.dumps({"prompt_ids": list(range(12))}).encode()
+        )
+        assert code == 200 and payload["replica"] == spare.url
+        assert full.import_bodies and spare.imports_served == 1
+        assert router.requests_migrated == 1
+    finally:
+        prefill.close()
+        full.close()
+        spare.close()
+
+
+def test_router_without_threshold_ignores_roles_of_both():
+    """No threshold / no prefill tier: pre-ISSUE-15 behavior is intact —
+    role 'both' (or missing) replicas balance exactly as before."""
+    a = _FakeReplica(slots=4)           # no role field at all (old replica)
+    b = _FakeReplica(slots=4, role="both")
+    try:
+        router = Router([a.url, b.url])
+        router.poll_once()
+        assert {r.url for r in router.pick_order()} == {a.url, b.url}
+        code, _ = router.handle_generate(_body())
+        assert code == 200
+    finally:
+        a.close()
+        b.close()

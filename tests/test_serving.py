@@ -1171,3 +1171,253 @@ def test_duplicate_inflight_request_id_is_retryable_503(setup):
             server.shutdown()
             server.server_close()
             thread.join(timeout=10)
+
+
+# --------------------------------------- KV migration serving (ISSUE 15)
+
+
+def test_drain_evacuation_migrates_sessions_zero_failures(setup):
+    """ACCEPTANCE (ISSUE 15): two in-process replicas under load — drain
+    one mid-generation with evacuation peers and every session migrates:
+    zero failed/cancelled requests, and the tokens are identical to the
+    same requests served by an undisturbed replica (extends the PR 8
+    drain test from finish-in-place to finish-elsewhere)."""
+    from bpe_transformer_tpu.telemetry import Telemetry, validate_record
+
+    params, prompts = setup
+    records_a: list = []
+    records_b: list = []
+    kwargs = dict(slots=4, min_bucket=8, paged=True, block_size=8)
+    ref = {}
+    with ServingEngine(params, CFG, **kwargs) as mono:
+        for i, p in enumerate(prompts):
+            ref[i] = mono.generate(
+                p, max_new_tokens=20, temperature=0.8, seed=i
+            ).token_ids
+    a = ServingEngine(
+        params, CFG, telemetry=Telemetry(sink=records_a.append), **kwargs
+    )
+    b = ServingEngine(
+        params, CFG, telemetry=Telemetry(sink=records_b.append), **kwargs
+    )
+    with a, b:
+        handles = [
+            a.submit(
+                Request(prompt_ids=tuple(p), max_new_tokens=20,
+                        temperature=0.8, seed=i)
+            )
+            for i, p in enumerate(prompts)
+        ]
+        time.sleep(0.2)  # let generations get genuinely mid-flight
+        assert a.drain(timeout_s=120.0, evacuate_to=[b]), "drain timed out"
+        results = [h.result(timeout=120) for h in handles]
+        for i, result in enumerate(results):
+            assert result.finish_reason in ("stop", "length"), result
+            assert result.token_ids == ref[i], (
+                f"request {i} diverged after evacuation"
+            )
+        assert a.stats()["migrations_out"] + b.stats()["migrations_in"] > 0
+        # Evacuated sessions seed the peer: nothing remains on A.
+        assert a.engine.active_count == 0
+
+    evac = [r for r in records_a if r.get("kind") == "migration"]
+    grafts = [r for r in records_b if r.get("kind") == "migration"]
+    assert any(r["direction"] == "evacuate" for r in evac)
+    assert any(r["direction"] == "import" for r in grafts)
+    for record in evac + grafts:
+        assert validate_record(record) == [], record
+    assert any(
+        r.get("kind") == "span" and r.get("path") == "serve/migration_import"
+        for r in records_b
+    )
+
+
+def test_prefill_role_and_kv_http_endpoints(setup):
+    """ACCEPTANCE (ISSUE 15 tentpole, HTTP surface): POST /kv/export on a
+    prefill-role replica returns the finished prefix as a binary payload
+    (X-Request-Id echoed); POST /kv/import on a decode-role replica
+    grafts it and answers with the full generation, token-identical to
+    the monolithic run; plain /generate on the prefill replica is a 503;
+    the decode replica fed only imports stays within tick + inject."""
+    from bpe_transformer_tpu.telemetry.monitor import parse_prometheus
+
+    params, prompts = setup
+    prompt = prompts[3]
+    with ServingEngine(
+        params, CFG, slots=2, min_bucket=8, paged=True, block_size=8
+    ) as mono:
+        ref = mono.generate(
+            prompt, max_new_tokens=8, temperature=0.7, seed=9
+        ).token_ids
+
+    pre = ServingEngine(params, CFG, slots=2, min_bucket=8, paged=True,
+                        block_size=8, role="prefill")
+    dec = ServingEngine(params, CFG, slots=2, min_bucket=8, paged=True,
+                        block_size=8, role="decode")
+    servers, threads, ports = [], [], []
+    for s in (pre, dec):
+        s.start()
+        srv = make_http_server(s, port=0)
+        ports.append(srv.server_address[1])
+        th = threading.Thread(target=srv.serve_forever, daemon=True)
+        th.start()
+        servers.append(srv)
+        threads.append(th)
+    try:
+        body = json.dumps(
+            {"prompt_ids": prompt, "max_new_tokens": 8,
+             "temperature": 0.7, "seed": 9, "deadline_s": 90.0}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ports[0]}/kv/export", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "mig-trace-1"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["Content-Type"] == "application/octet-stream"
+            assert resp.headers["X-Request-Id"] == "mig-trace-1"
+            payload = resp.read()
+        assert payload.startswith(b"BPEKV")
+        from bpe_transformer_tpu.serving.kvpool.migrate import (
+            payload_from_bytes,
+        )
+
+        meta = payload_from_bytes(payload)["meta"]
+        # The serving contract rides the payload: the client's deadline
+        # survives the migration and the import side can report the full
+        # export/transfer/import split.
+        assert meta["deadline_s"] == 90.0
+        assert isinstance(meta["export_s"], float)
+        assert meta["emitted"], "the sampled first token rides the payload"
+
+        # The prefill replica refuses a plain generation: 503, failover.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ports[0]}/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected 503 from the prefill role")
+        except urllib.error.HTTPError as err:
+            assert err.code == 503
+            assert "prefill-role" in json.loads(err.read())["error"]
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ports[1]}/kv/import", data=payload,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        assert resp.headers["X-Request-Id"] == "mig-trace-1"
+        assert out["request_id"] == "mig-trace-1"
+        assert tuple(out["token_ids"]) == ref
+        assert out["finish_reason"] in ("stop", "length")
+
+        # Compile bound: the decode replica has served ONLY the graft —
+        # tick + inject, no chunk ladder (the acceptance assertion).
+        assert dec.engine.compiled_programs() <= 2
+        assert dec.stats()["role"] == "decode"
+        assert dec.stats()["migrations_in"] == 1
+        assert pre.stats()["migrations_out"] == 1
+        prom = parse_prometheus(pre.prometheus_metrics())
+        assert prom["bpe_tpu_migrations_out_total"] == 1
+        assert prom['bpe_tpu_replica_role{role="prefill"}'] == 1
+        assert pre.statusz()["role"] == "prefill"
+
+        # A corrupted payload is a 400 (geometry/format guard), not a 500.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ports[1]}/kv/import", data=payload[:40],
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as err:
+            assert err.code == 400
+    finally:
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+        for th in threads:
+            th.join(timeout=10)
+        pre.close()
+        dec.close()
+
+
+def test_role_validation_and_accepting_imports(setup):
+    """Role knob guards: non-both roles need the paged engine; migrate
+    requests need the paged engine; a prefill-role replica reports it
+    does not accept imports."""
+    params, _ = setup
+    with pytest.raises(ValueError, match="paged"):
+        ServingEngine(params, CFG, slots=1, role="decode")
+    with pytest.raises(ValueError, match="role"):
+        ServingEngine(params, CFG, slots=1, paged=True, role="exporter")
+    dense = ServingEngine(params, CFG, slots=1, min_bucket=8)
+    dense._running = True
+    with pytest.raises(ValueError, match="paged"):
+        dense.submit(
+            Request(prompt_ids=(1, 2), max_new_tokens=2, migrate=True)
+        )
+    pre = ServingEngine(params, CFG, slots=1, paged=True, block_size=8,
+                        role="prefill")
+    pre._running = True
+    assert not pre.accepting_imports()
+    with pytest.raises(RuntimeError, match="prefill-role"):
+        pre.submit(Request(prompt_ids=(1, 2), max_new_tokens=2))
+
+
+@pytest.mark.slow  # 870s tier-1 budget (PR 14): heavy two-replica E2E matrix — cheap tier-1 siblings above
+def test_drain_evacuation_heavy_matrix(setup):
+    """Full-matrix drain evacuation (slow; tier-1 siblings:
+    test_drain_evacuation_migrates_sessions_zero_failures + the kvpool
+    migration pins): int8 pool + chunked prefill + per-tick budget, more
+    load than slots, drain fired while some sessions are still
+    MID-CHUNKED-PREFILL — every request completes on the peer with
+    tokens identical to an undisturbed replica, across greedy and seeded
+    sampling."""
+    params, prompts = setup
+    rng = np.random.default_rng(7)
+    long_prompts = [
+        [int(t) for t in rng.integers(0, CFG.vocab_size, size=n)]
+        for n in (24, 26, 21, 25, 23)
+    ]
+    load = prompts + long_prompts  # 9 requests over 4 slots
+    kwargs = dict(
+        slots=4, min_bucket=8, paged=True, block_size=8, kv_dtype="int8",
+        prefill_chunk=8, prefill_token_budget=8, max_queue=32,
+    )
+    knobs = [
+        dict(temperature=0.0) if i % 2 else
+        dict(temperature=0.9, top_k=9, top_p=0.85)
+        for i in range(len(load))
+    ]
+    ref = {}
+    with ServingEngine(params, CFG, **kwargs) as mono:
+        for i, p in enumerate(load):
+            ref[i] = mono.generate(
+                p, max_new_tokens=6, seed=i, **knobs[i]
+            ).token_ids
+    a = ServingEngine(params, CFG, **kwargs)
+    b = ServingEngine(params, CFG, **kwargs)
+    with a, b:
+        handles = [
+            a.submit(
+                Request(prompt_ids=tuple(p), max_new_tokens=6, seed=i,
+                        **knobs[i])
+            )
+            for i, p in enumerate(load)
+        ]
+        # Fire the drain ASAP: with 9 requests, 8-token chunks, and an
+        # 8-token/tick budget, several prompts are mid-prefill or still
+        # queued when the evacuation sweep runs.
+        assert a.drain(timeout_s=180.0, evacuate_to=[b]), "drain timed out"
+        for i, handle in enumerate(handles):
+            result = handle.result(timeout=180)
+            assert result.finish_reason in ("stop", "length"), (i, result)
+            assert result.token_ids == ref[i], (
+                f"request {i} diverged after int8/chunked evacuation"
+            )
+        assert a.engine.active_count == 0
+        assert b.stats()["migrations_in"] + b.stats()["requests_submitted"] \
+            >= len(load)
