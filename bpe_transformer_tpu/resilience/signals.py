@@ -49,10 +49,16 @@ class GracefulShutdown:
 
     SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
-    def __init__(self):
+    def __init__(self, recorder=None):
         self._flag = threading.Event()
         self._prev: dict[int, object] = {}
         self.signum: int | None = None
+        #: Optional flight recorder (telemetry/flightrecorder.py): signal
+        #: receipt is the first event of every preemption timeline.  The
+        #: handler uses the non-blocking ``try_record`` — a signal
+        #: interrupting a thread mid-``record`` must not deadlock on the
+        #: recorder's non-reentrant lock.
+        self._recorder = recorder
 
     def install(self) -> bool:
         """Register the handlers; returns False (and stays inert) when not
@@ -84,6 +90,10 @@ class GracefulShutdown:
             )
         self.signum = signum
         self._flag.set()
+        if self._recorder is not None:
+            self._recorder.try_record(
+                "signal_received", signal=signal.Signals(signum).name
+            )
 
     @property
     def triggered(self) -> bool:

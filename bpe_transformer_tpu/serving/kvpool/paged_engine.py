@@ -187,6 +187,11 @@ class PagedEngine:
     the :meth:`admit` convenience that runs a whole prefill at once).
     """
 
+    #: Optional flight recorder (telemetry/flightrecorder.py), attached by
+    #: the serving engine: KV rewinds (speculative rejections, host-side
+    #: truncations) are pool decisions the incident ring should show.
+    recorder = None
+
     def __init__(
         self,
         params,
@@ -574,6 +579,18 @@ class PagedEngine:
                 self._tables[slot, idx] = fresh
                 cow = True
         info.shared_len = min(info.shared_len, new_len)
+        if self.recorder is not None:
+            # Coalesced per slot: spec verify passes rewind every tick —
+            # one ring entry per slot's run of rewinds, host-side only.
+            self.recorder.record(
+                "rewind",
+                coalesce=True,
+                request_id=info.request_id,
+                slot=slot,
+                new_len=new_len,
+                released=released or None,
+                cow=cow or None,
+            )
         return {"released": released, "cow": cow}
 
     # ------------------------------------------------------------ migration

@@ -70,6 +70,8 @@ import uuid
 import zlib
 from urllib.parse import urlsplit
 
+from bpe_transformer_tpu.telemetry.flightrecorder import FlightRecorder
+
 __all__ = ["ReplicaState", "Router", "make_router_http_server", "main"]
 
 
@@ -204,6 +206,11 @@ class Router:
         #: --metrics-jsonl`).  Emission is direct (no nesting stack):
         #: handler threads interleave, like serving/server._span.
         self._telemetry = telemetry
+        #: Always-on decision ring (telemetry/flightrecorder.py): every
+        #: pick/hop/request outcome the span path already computes is teed
+        #: in, sink or no sink — `bpe-tpu incident` sweeps it over
+        #: GET /debug/flightrecorder next to the replicas' rings.
+        self.flightrecorder = FlightRecorder("route", clock=clock)
         self._thread: threading.Thread | None = None
         self._running = False
 
@@ -211,6 +218,14 @@ class Router:
         """Emit one router-phase span tagged with the request's trace id.
         Spans carry absolute ``time_unix`` start stamps so cross-stream
         assembly (router + replica JSONLs) can order hops on one axis."""
+        # Tee into the decision ring BEFORE the sink guard: hop outcomes
+        # must be sweepable from a router run without --metrics-jsonl.
+        self.flightrecorder.record(
+            name,
+            request_id=trace_id,
+            dur_s=round(max(float(dur), 0.0), 6),
+            **{k: v for k, v in attrs.items() if v is not None},
+        )
         if self._telemetry is None:
             return
         dur = max(float(dur), 0.0)
@@ -808,7 +823,26 @@ class Router:
             "affinity_hit_rate": (
                 round(hits / sessions, 6) if sessions else None
             ),
+            "flightrecorder": self.flightrecorder.stats(),
         }
+
+    def blackbox_dump(self, trigger: str, force: bool = False) -> dict | None:
+        """Flush the router's decision ring as a ``kind="blackbox"`` record
+        with the fleet table attached; emitted to the telemetry stream when
+        a sink is attached, always retained for the /debug endpoints."""
+        with self._lock:
+            context = {
+                "replicas": [r.snapshot() for r in self.replicas],
+                "requests_routed": self.requests_routed,
+                "requests_retried": self.requests_retried,
+                "requests_failed": self.requests_failed,
+            }
+        dump = self.flightrecorder.blackbox(
+            trigger, context=context, force=force
+        )
+        if dump is not None and self._telemetry is not None:
+            self._telemetry.emit(dump)
+        return dump
 
     def prometheus_metrics(self, prefix: str = "bpe_tpu_router") -> str:
         with self._lock:
@@ -873,7 +907,9 @@ def make_router_http_server(
 ):
     """A `ThreadingHTTPServer` front for the router: ``POST /generate``
     (proxied with failover), ``GET /statusz`` (fleet table), ``GET
-    /metrics`` (Prometheus), ``GET /healthz``.  ``port=0`` binds an
+    /metrics`` (Prometheus), ``GET /healthz``, plus the forensics pair —
+    ``GET /debug/flightrecorder`` (the live decision ring) and ``POST
+    /debug/dump`` (force a black-box flush).  ``port=0`` binds an
     ephemeral port; the caller owns ``serve_forever()``/``shutdown()``."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -916,9 +952,14 @@ def make_router_http_server(
                 self.end_headers()
                 self.wfile.write(body)
                 return None
+            if path == "/debug/flightrecorder":
+                return self._reply(200, router.flightrecorder.debug_page())
             return self._reply(404, {"error": "unknown path"})
 
         def do_POST(self):  # noqa: N802 (stdlib API)
+            if self.path == "/debug/dump":
+                dump = router.blackbox_dump("manual", force=True)
+                return self._reply(200, dump)
             if self.path != "/generate":
                 return self._reply(404, {"error": "unknown path"})
             trace_id = (self.headers.get("X-Request-Id") or "").strip()

@@ -53,12 +53,16 @@ class PrefillBudget:
     next tick, the dense engine's behavior).
     """
 
-    def __init__(self, tokens_per_tick: int | None):
+    def __init__(self, tokens_per_tick: int | None, recorder=None):
         if tokens_per_tick is not None and tokens_per_tick < 1:
             raise ValueError(
                 f"tokens_per_tick must be >= 1 or None, got {tokens_per_tick}"
             )
         self.tokens_per_tick = tokens_per_tick
+        #: Optional flight recorder (telemetry/flightrecorder.py): budget
+        #: DENIALS are scheduling decisions worth forensics — a prefill
+        #: chunk deferred to the next tick explains a decode-p99 spike.
+        self._recorder = recorder
         self._spent = 0
 
     def start_tick(self) -> None:
@@ -67,7 +71,18 @@ class PrefillBudget:
     def admits(self, chunk_tokens: int) -> bool:
         if self.tokens_per_tick is None or self._spent == 0:
             return True
-        return self._spent + chunk_tokens <= self.tokens_per_tick
+        verdict = self._spent + chunk_tokens <= self.tokens_per_tick
+        if not verdict and self._recorder is not None:
+            # Coalesced: a long prompt defers every tick until it fits —
+            # one ring entry with a count, not one per tick.
+            self._recorder.record(
+                "budget_defer",
+                coalesce=True,
+                chunk_tokens=chunk_tokens,
+                spent=self._spent,
+                tokens_per_tick=self.tokens_per_tick,
+            )
+        return verdict
 
     def spend(self, chunk_tokens: int) -> None:
         self._spent += chunk_tokens

@@ -46,6 +46,7 @@ from bpe_transformer_tpu.telemetry.alerts import (
     AlertEngine,
     default_serving_rules,
 )
+from bpe_transformer_tpu.telemetry.flightrecorder import FlightRecorder
 from bpe_transformer_tpu.telemetry.resources import (
     install_compile_counter,
     sample_resources,
@@ -227,6 +228,7 @@ class ServingEngine:
         draft_spec=None,
         alert_rules=None,
         role: str = "both",
+        flightrecorder_capacity: int = 256,
     ):
         # Count XLA compiles (the engine's bucketed prefills included) into
         # the process-wide telemetry.resources counter before the first
@@ -295,11 +297,24 @@ class ServingEngine:
         #: stats/statusz/metrics surfaces grow the acceptance gauges and
         #: the engine-record cadence emits kind="spec" records.
         self.spec = bool(speculate_k)
+        #: Always-on decision ring (telemetry/flightrecorder.py): every
+        #: admit/park/reject/deadline/finish, migration, rewind, drain and
+        #: worker-error decision lands here as host-side bookkeeping (zero
+        #: device syncs — pinned by the fetch-count test), flushed as a
+        #: kind="blackbox" dump on alert/manual/worker-error triggers.
+        self.flightrecorder = FlightRecorder(
+            "serve", capacity=flightrecorder_capacity, clock=clock
+        )
+        if paged:
+            # Paged KV rewinds (speculative rejection rollbacks, partial
+            # chains) are pool decisions too: the engine tees them in.
+            self.engine.recorder = self.flightrecorder
         #: Chunked-prefill fairness (paged only): prefill tokens allowed
         #: between consecutive decode ticks (None = run chunks to
         #: completion, the dense engine's schedule).
         self._prefill_budget = PrefillBudget(
-            prefill_token_budget if paged else None
+            prefill_token_budget if paged else None,
+            recorder=self.flightrecorder,
         )
         #: Admissions parked on KV-block exhaustion (paged): retried in
         #: FIFO order before any newer queue pop, as decode retirements
@@ -393,6 +408,12 @@ class ServingEngine:
             peers = [p for p in evacuate_to if p.accepting_imports()]
             self._evacuate_peers = peers
         self._draining = True
+        self.flightrecorder.record(
+            "drain",
+            queue_depth=self.scheduler.depth,
+            active_slots=self.engine.active_count,
+            evacuating=bool(self._evacuate_peers),
+        )
         if self._telemetry is not None:
             self._telemetry.event(
                 "serve_drain",
@@ -541,6 +562,11 @@ class ServingEngine:
                 self._entries.pop(request.request_id, None)
             if isinstance(exc, QueueFullError):
                 self.metrics.on_reject()
+                self.flightrecorder.record(
+                    "reject",
+                    request_id=request.request_id,
+                    queue_depth=self.scheduler.depth,
+                )
             raise
         self.metrics.on_submit()
         return RequestHandle(self, entry)
@@ -907,6 +933,13 @@ class ServingEngine:
             # currently-firing rules with their evidence — what the fleet
             # aggregator folds and an operator's first question answered.
             "alerts": self._alerts.active(),
+            # Last-N firing/cleared transitions with timestamps: an alert
+            # that cleared five minutes ago is still the answer to "what
+            # happened?" — active() alone forgets it.
+            "alert_history": self._alerts.history(16),
+            # Decision-ring counters (GET /debug/flightrecorder holds the
+            # ring itself; the operator page just shows it is alive).
+            "flightrecorder": self.flightrecorder.stats(),
             "resources": resources,
             "last_errors": self.metrics.last_errors(),
         }
@@ -998,8 +1031,12 @@ class ServingEngine:
             self._worker_error = exc
             self._running = False
             self.metrics.record_error(repr(exc), source="worker")
+            self.flightrecorder.record("worker_error", error=repr(exc))
             if self._telemetry is not None:
                 self._telemetry.event("serve_worker_error", error=repr(exc))
+            # A dead worker is a terminal incident: flush the decision ring
+            # while the evidence is still warm (force past the cooldown).
+            self.blackbox_dump("worker_error", force=True)
             for slot in list(self._slot_entries):
                 entry = self._slot_entries.pop(slot)
                 self.engine.release(slot)
@@ -1121,7 +1158,19 @@ class ServingEngine:
         if self.engine.active_count:
             t0 = self._clock()
             events = self.engine.tick()
-            self._deliver(events, self._clock() - t0)
+            tick_s = self._clock() - t0
+            self._deliver(events, tick_s)
+            # Tick summary, coalesced: consecutive ticks merge into one
+            # ring entry (count + refreshed fields) so steady-state decode
+            # chatter cannot evict the rare decision events around it.
+            self.flightrecorder.record(
+                "tick",
+                coalesce=True,
+                n_events=len(events),
+                tick_s=round(tick_s, 6),
+                active_slots=self.engine.active_count,
+                queue_depth=self.scheduler.depth,
+            )
             worked = True
         self._maybe_emit_engine_record()
         return worked
@@ -1153,6 +1202,16 @@ class ServingEngine:
                     request_id=request.request_id,
                 )
             except NoFreeBlocksError:
+                # Coalesced: the backlog head retries every step while the
+                # pool stays dry — one ring entry per parked request, with
+                # a retry count, not one per retry.
+                self.flightrecorder.record(
+                    "park",
+                    coalesce=True,
+                    request_id=request.request_id,
+                    prompt_len=len(request.prompt_ids),
+                    backlog=len(self._admit_backlog),
+                )
                 return False
             entry.queue_wait_s = t0 - entry.t_submit
             self._span(
@@ -1164,6 +1223,14 @@ class ServingEngine:
             entry.t_prefill_start = t0
             entry.prefill_s = 0.0
             self._prefill_entries[slot] = entry
+            self.flightrecorder.record(
+                "admit",
+                request_id=request.request_id,
+                slot=slot,
+                prompt_len=len(request.prompt_ids),
+                queue_wait_s=round(entry.queue_wait_s, 6),
+                shared_tokens=entry.shared_tokens or None,
+            )
             return True
 
         entry.queue_wait_s = t0 - entry.t_submit
@@ -1197,6 +1264,14 @@ class ServingEngine:
         # the ttfb SLO histogram (never as a span — see metrics.phases).
         self.metrics.observe_phase(
             "ttfb", entry.queue_wait_s + entry.prefill_s
+        )
+        self.flightrecorder.record(
+            "admit",
+            request_id=request.request_id,
+            slot=event.slot,
+            prompt_len=len(request.prompt_ids),
+            bucket=entry.bucket,
+            queue_wait_s=round(entry.queue_wait_s, 6),
         )
         entry.tokens.append(event.token)
         entry.stream.put(event.token)
@@ -1445,6 +1520,12 @@ class ServingEngine:
     def _emit_migration(self, **fields) -> None:
         """One ``kind="migration"`` record (bytes, blocks, phase split) —
         the telemetry spine's view of each KV move."""
+        # Tee into the decision ring BEFORE the sink guard: the flight
+        # recorder must see every KV move even on a server run without
+        # --metrics-jsonl.
+        self.flightrecorder.record(
+            "migration", **{k: v for k, v in fields.items() if v is not None}
+        )
         if self._telemetry is None:
             return
         self._telemetry.emit(
@@ -1555,6 +1636,16 @@ class ServingEngine:
         )
         self._requests_finished += 1
         self.metrics.on_finish(reason)
+        # Deadline expiries are first-class incident evidence (the park ->
+        # deadline chain IS a block-exhaustion story); ordinary completions
+        # ride along as "finish" so the ring shows request turnover.
+        self.flightrecorder.record(
+            "deadline" if reason == "deadline" else "finish",
+            request_id=entry.request.request_id,
+            reason=reason if reason != "deadline" else None,
+            n_tokens=len(entry.tokens) or None,
+            slot=entry.slot,
+        )
         # Whole-request latency for the total SLO histogram (request-level
         # only — a total SPAN would double-count in the report's
         # per-request phase assembly).
@@ -1628,8 +1719,53 @@ class ServingEngine:
                 sample["spec_accept_rate"] = gauges.get("spec_accept_rate")
                 sample["spec_proposed"] = gauges.get("spec_proposed_tokens")
         for transition in self._alerts.feed(sample, round(t, 6)):
+            self.flightrecorder.record(
+                "alert",
+                rule=transition.get("rule"),
+                state=transition.get("state"),
+                severity=transition.get("severity"),
+            )
             if self._telemetry is not None:
                 self._telemetry.emit(transition)
+            if transition.get("state") == "firing":
+                # An alert edge is THE black-box trigger: flush the ring
+                # (with the alert itself as its newest entry) while the
+                # decisions that led here are still in it.  The recorder's
+                # cooldown de-dupes a storm of edges into one dump.
+                self.blackbox_dump(f"alert:{transition.get('rule')}")
+
+    def blackbox_dump(self, trigger: str, force: bool = False) -> dict | None:
+        """Flush the decision ring as a ``kind="blackbox"`` record with the
+        host-side operational context an incident needs (queue/slot/kvpool
+        state, active alerts + history tail) attached; emitted into the
+        telemetry stream when a sink is attached, always retained on the
+        recorder for ``GET /debug/flightrecorder``.  Returns the dump, or
+        None while the post-dump cooldown holds (``force=True`` bypasses —
+        the POST /debug/dump and terminal worker-error paths).
+
+        Everything gathered here is host-side bookkeeping (slot_states and
+        kvpool gauges are plain dict reads) — no device syncs, matching the
+        recording path's fetch-count contract."""
+        context: dict = {
+            "queue_depth": self.scheduler.depth + len(self._admit_backlog),
+            "active_slots": self.engine.active_count,
+            "draining": self._draining,
+            "requests_finished": self._requests_finished,
+            "slot_states": self.engine.slot_states(),
+            "alerts": self._alerts.active(),
+            "alert_history": self._alerts.history(16),
+        }
+        if self.paged:
+            context["kvpool"] = {
+                **self.engine.gauges(),
+                "admit_backlog": len(self._admit_backlog),
+            }
+        dump = self.flightrecorder.blackbox(
+            trigger, context=context, force=force
+        )
+        if dump is not None and self._telemetry is not None:
+            self._telemetry.emit(dump)
+        return dump
 
     def _maybe_emit_engine_record(self) -> None:
         now = self._clock()
@@ -1789,6 +1925,11 @@ def make_http_server(
       token-identical to an unmigrated run).  400 on a geometry/dtype
       mismatch, 503 on backpressure.
 
+    * ``GET /debug/flightrecorder`` — the live decision ring + retained
+      black-box dumps (``bpe-tpu incident`` sweeps this across the fleet).
+    * ``POST /debug/dump`` — force a black-box flush now; answers with
+      the ``kind="blackbox"`` dump.
+
     ``port=0`` binds an ephemeral port (tests); the caller owns
     ``serve_forever()`` / ``shutdown()``.
     """
@@ -1840,6 +1981,10 @@ def make_http_server(
                 )
             if path == "/statusz":
                 return self._reply(200, serving.statusz())
+            if path == "/debug/flightrecorder":
+                # The live decision ring + retained black-box dumps — what
+                # `bpe-tpu incident` sweeps across the fleet.
+                return self._reply(200, serving.flightrecorder.debug_page())
             return self._reply(404, {"error": "unknown path"})
 
         def _reply_payload(self, data: bytes, request_id: str) -> None:
@@ -1854,6 +1999,11 @@ def make_http_server(
         def do_POST(self):  # noqa: N802 (stdlib API)
             if self.path == "/kv/import":
                 return self._kv_import()
+            if self.path == "/debug/dump":
+                # Operator-initiated black-box flush: always dumps (force
+                # past the cooldown) and answers with the dump itself.
+                dump = serving.blackbox_dump("manual", force=True)
+                return self._reply(200, dump)
             if self.path not in ("/generate", "/kv/export"):
                 return self._reply(404, {"error": "unknown path"})
             migrate = self.path == "/kv/export"

@@ -31,6 +31,13 @@ Subsumes and extends the old ``utils.metrics`` / ``utils.profiling`` pair
 - `alerts` — the serving anomaly watchdog: edge-triggered rule-based
   detectors over engine/fleet gauges (``kind="alert"``), run inside
   every serving engine and the fleet aggregator;
+- `flightrecorder` — ``FlightRecorder``: the always-on bounded ring of
+  decision events (admit/park/reject, hops, budget deferrals, rollbacks)
+  every control-plane component keeps, flushed as ``kind="blackbox"``
+  dumps on alert/watchdog/preemption/manual triggers;
+- `incident` — the jax-free ``bpe-tpu incident`` postmortem bundler:
+  sweeps router + replica ``/debug/flightrecorder`` pages and writes one
+  wall-clock-ordered cross-replica bundle (``kind="incident"``);
 - `watchdog` — hung-step detection against the trailing median step time
   plus the "dump state + raise or skip" non-finite policy;
 - `timing` — ``StepTimer`` throughput/MFU windows, ``profile_trace``,
@@ -38,6 +45,7 @@ Subsumes and extends the old ``utils.metrics`` / ``utils.profiling`` pair
 - `report` — the jax-free ``bpe-tpu report`` summarizer.
 """
 
+from bpe_transformer_tpu.telemetry.flightrecorder import FlightRecorder
 from bpe_transformer_tpu.telemetry.manifest import git_sha, run_manifest
 from bpe_transformer_tpu.telemetry.report import nonfinite_fields
 from bpe_transformer_tpu.telemetry.resources import (
@@ -81,6 +89,7 @@ __getattr__ = lazy_attrs(
 )
 
 __all__ = [
+    "FlightRecorder",
     "MetricsLogger",
     "NonFiniteError",
     "RECORD_SCHEMAS",

@@ -317,9 +317,12 @@ class AlertEngine:
     read from many.)
     """
 
-    def __init__(self, rules=None):
+    def __init__(self, rules=None, history_limit: int = 64):
         self.rules = list(rules) if rules is not None else []
         self._firing: dict[str, dict] = {}
+        self._history: collections.deque[dict] = collections.deque(
+            maxlen=history_limit
+        )
         self._lock = threading.Lock()
 
     def feed(self, sample: dict, t: float) -> list[dict]:
@@ -368,6 +371,11 @@ class AlertEngine:
                             ),
                         }
                     )
+            # Persist every edge into the bounded history so /statusz and
+            # monitor can show the last N transitions after they clear —
+            # active() alone forgets an incident the moment it ends.
+            for transition in out:
+                self._history.append(dict(transition))
         return out
 
     def active(self) -> list[dict]:
@@ -377,3 +385,12 @@ class AlertEngine:
                 (dict(a) for a in self._firing.values()),
                 key=lambda a: a["since_t"],
             )
+
+    def history(self, n: int | None = None) -> list[dict]:
+        """The last ``n`` firing/cleared transitions (all retained ones when
+        ``n`` is None), oldest first, as copies."""
+        with self._lock:
+            items = list(self._history)
+        if n is not None:
+            items = items[-n:]
+        return [dict(item) for item in items]

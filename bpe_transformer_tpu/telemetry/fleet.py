@@ -481,6 +481,10 @@ class FleetAggregator:
             "fleet": latest,
             "replicas": per_replica,
             "alerts": active,
+            # Last few firing->cleared transitions (AlertEngine.history):
+            # a flap that cleared between polls still shows up here and on
+            # the monitor panel.
+            "alert_history": self.alerts.history(16),
             "slo": slo_rows,
         }
 

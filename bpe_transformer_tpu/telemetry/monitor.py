@@ -134,7 +134,10 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
                 state["slo_max_burn"] = max(finite)
         elif kind == "alert":
             # Watchdog transitions (telemetry/alerts.py): track the
-            # currently-firing set; every new firing is an anomaly.
+            # currently-firing set; every new firing is an anomaly.  The
+            # bounded history mirrors AlertEngine.history(): the panel
+            # shows the last few firing->cleared transitions, not just
+            # what is firing right now.
             firing = list(state.get("alerts_firing") or [])
             rule = record.get("rule")
             if record.get("state") == "firing":
@@ -145,6 +148,28 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
             elif record.get("state") == "cleared" and rule in firing:
                 firing.remove(rule)
             state["alerts_firing"] = firing
+            history = list(state.get("alert_history") or [])
+            history.append(
+                {
+                    "t": record.get("t"),
+                    "rule": rule,
+                    "state": record.get("state"),
+                    "active_s": record.get("active_s"),
+                }
+            )
+            state["alert_history"] = history[-8:]
+        elif kind == "blackbox":
+            # Flight-recorder dump (telemetry/flightrecorder.py): count
+            # it and show who flushed and why — a dump in the stream is
+            # the panel's cue that forensic evidence exists.
+            state["blackbox_dumps"] = state.get("blackbox_dumps", 0) + 1
+            trigger = record.get("trigger")
+            state["last_blackbox"] = (
+                f"{record.get('component', '?')}:{trigger}"
+            )
+            if trigger != "sweep" and trigger != "manual":
+                state["anomalies"] += 1
+                state["last_anomaly"] = f"blackbox {trigger}"
         elif kind == "resources":
             for key in ("host_rss_bytes", "live_buffer_bytes",
                         "hbm_bytes_in_use", "hbm_peak_bytes_in_use",
@@ -469,6 +494,31 @@ def render_frame(state: dict, source: str) -> str:
         lines.append(
             "  alert  FIRING: " + ", ".join(state["alerts_firing"])
         )
+    if state.get("alert_history"):
+        # Last few firing->cleared transitions (AlertEngine.history): the
+        # flap that cleared before the operator looked is still visible.
+        lines.append(
+            "  alert  history: "
+            + "  ".join(
+                f"t={_num(row.get('t'), 5)} {row.get('rule')} "
+                f"{row.get('state')}"
+                + (
+                    f" ({_num(row.get('active_s'), 3)}s)"
+                    if row.get("active_s") is not None
+                    else ""
+                )
+                for row in state["alert_history"][-4:]
+            )
+        )
+    if state.get("blackbox_dumps"):
+        lines.append(
+            f"  fdr    blackbox dumps {_num(state['blackbox_dumps'])}"
+            + (
+                f"  last {state['last_blackbox']}"
+                if state.get("last_blackbox")
+                else ""
+            )
+        )
 
     mem_parts = []
     if state.get("hbm_bytes_in_use") is not None:
@@ -659,6 +709,18 @@ class FleetSource:
         if firing:
             state["alerts_firing"] = firing
             state["last_anomaly"] = f"alert {firing[-1]}"
+        history = [
+            {
+                "t": row.get("t"),
+                "rule": row.get("rule"),
+                "state": row.get("state"),
+                "active_s": row.get("active_s"),
+            }
+            for row in page.get("alert_history") or []
+            if isinstance(row, dict)
+        ]
+        if history:
+            state["alert_history"] = history[-8:]
         burns = {}
         for row in page.get("slo") or []:
             if row.get("burn_rate") is not None:

@@ -478,6 +478,61 @@ def summarize(records: list[dict]) -> dict:
                 + ", ".join(sorted(still_firing))
             )
 
+    # Flight-recorder forensics (kind="blackbox" dumps from
+    # telemetry/flightrecorder.py triggers, kind="incident" bundles from
+    # bpe-tpu incident): how many black-box dumps the stream carries, who
+    # flushed them and why, and the incident sweep's cross-host shape.
+    blackbox_records = [r for r in records if r.get("kind") == "blackbox"]
+    incident_records = [r for r in records if r.get("kind") == "incident"]
+    incident_summary = None
+    if blackbox_records or incident_records:
+        by_component: dict[str, int] = {}
+        by_trigger: dict[str, int] = {}
+        for r in blackbox_records:
+            comp = str(r.get("component") or "?")
+            by_component[comp] = by_component.get(comp, 0) + 1
+            trig = str(r.get("trigger") or "?")
+            by_trigger[trig] = by_trigger.get(trig, 0) + 1
+        incident_summary = {
+            "dumps": len(blackbox_records),
+            "by_component": by_component,
+            "by_trigger": by_trigger,
+            "ring_events": sum(
+                len(r.get("events") or []) for r in blackbox_records
+            ),
+            "sweeps": len(incident_records),
+        }
+        # The LAST sweep describes the bundle being read (one incident
+        # bundle carries exactly one kind="incident" summary record).
+        if incident_records:
+            last = incident_records[-1]
+            hosts = last.get("hosts") or []
+            incident_summary["hosts"] = len(hosts)
+            incident_summary["hosts_online"] = sum(
+                1 for h in hosts if isinstance(h, dict) and h.get("online")
+            )
+            incident_summary["hosts_offline"] = [
+                str(h.get("url"))
+                for h in hosts
+                if isinstance(h, dict) and not h.get("online")
+            ]
+            timeline = last.get("timeline") or []
+            incident_summary["timeline_entries"] = len(timeline)
+            incident_summary["timeline_truncated"] = last.get(
+                "timeline_truncated"
+            )
+            incident_summary["request_id"] = last.get("request_id")
+            incident_summary["timeline_tail"] = timeline[-12:]
+            for h in incident_summary["hosts_offline"]:
+                anomalies.append(f"incident sweep: host {h} unreachable")
+        # A forced dump marks a terminal path (worker error, nonfinite
+        # raise, preemption) — surface those triggers as anomalies.
+        for trig, n in sorted(by_trigger.items()):
+            if trig.startswith("alert:") or trig in (
+                "watchdog_hang", "nonfinite", "worker_error", "preemption"
+            ):
+                anomalies.append(f"blackbox dump x{n}: trigger {trig}")
+
     # Speculative-decoding trajectory (kind="spec", serving/spec/): every
     # counter is cumulative, so the LAST sample is the run's verdict —
     # accept_rate tells whether the draft earns its keep,
@@ -770,6 +825,7 @@ def summarize(records: list[dict]) -> dict:
         "fleet": fleet_summary,
         "slo": slo_summary,
         "alerts": alerts_summary,
+        "incident": incident_summary,
         "roofline": roofline_summary,
         "resources": resource_summary,
         "attribution": attribution_summary,
@@ -1131,6 +1187,81 @@ def render_report(records: list[dict]) -> str:
                 )
             )
 
+    inc = s.get("incident")
+    if inc:
+        lines.append(
+            f"== incident ({inc['dumps']} blackbox dump(s), "
+            f"{inc['sweeps']} sweep(s)) =="
+        )
+        if inc["by_component"]:
+            lines.append(
+                "  dumps by component  "
+                + "  ".join(
+                    f"{comp}:{n}"
+                    for comp, n in sorted(inc["by_component"].items())
+                )
+            )
+        if inc["by_trigger"]:
+            lines.append(
+                "  dumps by trigger    "
+                + "  ".join(
+                    f"{trig}:{n}"
+                    for trig, n in sorted(inc["by_trigger"].items())
+                )
+            )
+        lines.append(f"  ring events dumped  {inc['ring_events']}")
+        if inc.get("hosts") is not None:
+            lines.append(
+                f"  sweep hosts         {inc['hosts_online']}/{inc['hosts']}"
+                " online"
+                + (
+                    " (unreachable: "
+                    + ", ".join(inc["hosts_offline"]) + ")"
+                    if inc.get("hosts_offline")
+                    else ""
+                )
+            )
+            lines.append(
+                "  timeline            "
+                f"{inc.get('timeline_entries', 0)} cross-host entries"
+                + (
+                    f" (+{inc['timeline_truncated']} truncated)"
+                    if inc.get("timeline_truncated")
+                    else ""
+                )
+                + (
+                    f", request {inc['request_id']}"
+                    if inc.get("request_id")
+                    else ""
+                )
+            )
+            for entry in inc.get("timeline_tail") or []:
+                # Absolute stamp at full sub-second precision: a forensics
+                # timeline collapses into mush under %g's 6 significant
+                # digits (every 2026 unix stamp prints as 1.78e+09).
+                unix = entry.get("time_unix")
+                lines.append(
+                    "    unix="
+                    + (
+                        f"{unix:.3f}"
+                        if isinstance(unix, (int, float))
+                        else "?"
+                    )
+                    + " "
+                    f"[{str(entry.get('component') or '?'):<5s}] "
+                    f"{str(entry.get('event')):<16s}"
+                    + (
+                        f" req={entry['request_id']}"
+                        if entry.get("request_id")
+                        else ""
+                    )
+                    + (
+                        f" x{entry['count']}"
+                        if entry.get("count")
+                        else ""
+                    )
+                )
+
     rs = s["resources"]
     if rs:
         lines.append(f"== resources ({rs['n']} samples) ==")
@@ -1426,6 +1557,14 @@ COMPARE_METRICS: dict = {
     # baseline's is failing its latency/availability objectives harder.
     "slo_max_burn_rate": (
         lambda s: (s.get("slo") or {}).get("max_burn_rate"), "lower"),
+    # Flight-recorder forensics coverage (kind="blackbox", ISSUE 16): an
+    # incident stream that stops carrying its black-box dumps — a trigger
+    # hook unwired, a ring silently disabled — has lost its evidence
+    # plane; "higher" because this row gates dump COVERAGE in forensics
+    # fixtures, not incident frequency in production streams (streams
+    # without dumps skip the row entirely).
+    "blackbox_dumps_total": (
+        lambda s: (s.get("incident") or {}).get("dumps"), "higher"),
     "fleet_tokens_per_sec_mean": (
         lambda s: ((s.get("fleet") or {}).get("tokens_per_sec", {})
                    or {}).get("mean"), "higher"),

@@ -175,6 +175,28 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
     # (with ``projected_dry_s``), accept_rate_collapse, compile_storm,
     # replica_flap.  ``severity`` is ``page`` | ``warn``.
     "alert": {"kind", "t", "rule", "state"},
+    # Flight-recorder black-box dump (telemetry/flightrecorder.py): the
+    # always-on decision ring of one ``component`` ("serve" | "route" |
+    # "train"), flushed on a ``trigger`` — ``alert:<rule>``, ``watchdog_hang``,
+    # ``nonfinite``, ``preemption``, ``manual`` (POST /debug/dump), or
+    # ``sweep`` (the incident tool snapshotting a live ring).  ``events`` is
+    # the ring contents oldest-first (each entry: ``event`` name, run-relative
+    # ``t``, absolute ``time_unix``, plus the decision's own fields);
+    # ``recorded``/``dropped`` are lifetime counters (dropped > 0 means the
+    # ring wrapped).  Host-side context rides along per component: queue
+    # depth, slot states, kvpool gauges, active alerts + history tail for
+    # serving; step/rollback state for training.
+    "blackbox": {
+        "kind", "t", "time_unix", "component", "trigger", "events",
+    },
+    # Incident postmortem bundle summary (telemetry/incident.py, `bpe-tpu
+    # incident`): one record per assembled bundle.  ``hosts`` is the per-host
+    # sweep outcome table (url, online, dumps collected); ``timeline`` is the
+    # merged cross-host event list, wall-clock-ordered by absolute
+    # ``time_unix`` (each entry stamped with its source ``host``), optionally
+    # filtered to one request id and capped (``timeline_truncated`` rides
+    # along when capped).
+    "incident": {"kind", "time_unix", "hosts", "timeline"},
     # Run trailer: record counts + clean verdict (spans.py Telemetry.footer).
     "footer": {"kind", "t", "record_counts"},
     # Step/val metrics (NO kind key): at least a step number plus one

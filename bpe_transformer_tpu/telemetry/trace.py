@@ -40,6 +40,9 @@ TRACE_ASSUMPTIONS: dict[str, set[str]] = {
     "attribution": {"kind", "t"},
     "kvpool": {"kind", "t"},
     "fleet": {"kind", "t"},
+    "alert": {"kind", "t", "rule", "state"},
+    "event": {"kind", "name", "t"},
+    "blackbox": {"kind", "t", "trigger"},
 }
 
 #: Counter series pulled from each periodic record kind.
@@ -248,6 +251,37 @@ def trace_events(records: list[dict]) -> list[dict]:
                         "args": series,
                     }
                 )
+        elif kind in ("alert", "event", "blackbox"):
+            # Point-in-time markers: alert edges, watchdog/NaN events, and
+            # black-box dump flushes land as process-scoped instants on the
+            # shared timeline, so an incident's trigger lines up visually
+            # with the span/counter lanes around it.
+            t = record.get("t")
+            if not isinstance(t, (int, float)):
+                continue
+            if kind == "alert":
+                name = f"alert:{record.get('rule')} {record.get('state')}"
+            elif kind == "blackbox":
+                name = f"blackbox:{record.get('trigger')}"
+            else:
+                name = str(record.get("name", "event"))
+            args = {
+                k: v
+                for k, v in record.items()
+                if k not in ("kind", "t", "events") and v is not None
+                and isinstance(v, (str, int, float, bool))
+            }
+            events.append(
+                {
+                    "ph": "i",
+                    "s": "p",
+                    "pid": _PID,
+                    "name": name,
+                    "cat": kind,
+                    "ts": round(t * 1e6, 1),
+                    **({"args": args} if args else {}),
+                }
+            )
         elif kind == "resources":
             t_unix = record.get("time_unix")
             if not isinstance(t_unix, (int, float)):

@@ -66,6 +66,7 @@ class Watchdog:
         poll_interval_s: float = 0.5,
         telemetry=None,
         on_hang=None,
+        recorder=None,
         clock=time.monotonic,
     ):
         """``factor``: multiple of the trailing median step time that counts
@@ -83,6 +84,11 @@ class Watchdog:
         self.poll_interval_s = poll_interval_s
         self._telemetry = telemetry
         self._on_hang = on_hang
+        #: Optional flight recorder (telemetry/flightrecorder.py): hang
+        #: trips and non-finite verdicts are decision events, and both
+        #: flush the ring as a black-box dump — a hang's dump may be the
+        #: last evidence out before the operator kills the process.
+        self._recorder = recorder
         self._clock = clock
         self._step_times: deque[float] = deque(maxlen=history_window)
         self._last_beat = clock()
@@ -154,6 +160,15 @@ class Watchdog:
             self._tripped_this_gap = True
             self.hang_events += 1
             silent_s = now - self._last_beat
+        if self._recorder is not None:
+            self._recorder.record(
+                "watchdog_hang",
+                silent_s=round(silent_s, 3),
+                timeout_s=round(timeout, 3),
+            )
+            dump = self._recorder.blackbox("watchdog_hang")
+            if dump is not None and self._telemetry is not None:
+                self._telemetry.emit(dump)
         if self._telemetry is not None:
             self._telemetry.event(
                 "watchdog_hang",
@@ -210,6 +225,20 @@ class Watchdog:
         # raised error name it — "NaN in params/layers.3.ffn.w1", not just
         # "loss is NaN".
         path = record.get("nonfinite_path")
+        if self._recorder is not None:
+            self._recorder.record(
+                "nonfinite",
+                step=record.get("step"),
+                policy=self.policy,
+                path=path,
+            )
+            # Dump BEFORE the "raise" policy tears the loop down — forced:
+            # a terminal path must never lose its dump to the cooldown.
+            dump = self._recorder.blackbox(
+                "nonfinite", force=self.policy == "raise"
+            )
+            if dump is not None and self._telemetry is not None:
+                self._telemetry.emit(dump)
         if self._telemetry is not None:
             self._telemetry.event(
                 "nonfinite",

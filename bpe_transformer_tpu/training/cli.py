@@ -458,7 +458,9 @@ def cmd_serve(args) -> int:
     if tokenizer.special_tokens:
         stop_id = tokenizer.encode(tokenizer.special_tokens[0])[0]
 
-    logger = MetricsLogger(jsonl_path=args.metrics_jsonl)
+    logger = MetricsLogger(
+        jsonl_path=args.metrics_jsonl, max_bytes=args.metrics_max_bytes
+    )
     telemetry = Telemetry(sink=logger.log) if args.metrics_jsonl else None
     # Built unconditionally: /statusz serves the manifest even when no
     # metrics JSONL is being written.
@@ -491,6 +493,7 @@ def cmd_serve(args) -> int:
         speculate_k=args.speculate,
         draft_spec=draft_spec,
         role=args.role,
+        flightrecorder_capacity=args.flightrecorder_capacity,
     )
     try:
         with serving:
@@ -610,6 +613,28 @@ def cmd_fleet(args) -> int:
     if args.once:
         forwarded.append("--once")
     return fleet_main(forwarded)
+
+
+def cmd_incident(args) -> int:
+    # Jax-free postmortem bundler (telemetry/incident.py): sweep every
+    # host's flight-recorder page concurrently, correlate the dumps by
+    # absolute time_unix (and X-Request-Id with --request), and write one
+    # bundle with a wall-clock-ordered cross-replica timeline.
+    from bpe_transformer_tpu.telemetry.incident import main as incident_main
+
+    forwarded = []
+    for replica in args.replica:
+        forwarded += ["--replica", replica]
+    if args.router:
+        forwarded += ["--router", args.router]
+    forwarded += [
+        "--timeout", str(args.timeout),
+        "--timeline-cap", str(args.timeline_cap),
+        "--out", args.out,
+    ]
+    if args.request:
+        forwarded += ["--request", args.request]
+    return incident_main(forwarded)
 
 
 def _warmup_train(args) -> int:
@@ -1523,6 +1548,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-jsonl", default=None,
                    help="append serving telemetry (request spans, engine "
                    "records) to this file; summarize with bpe-tpu report")
+    p.add_argument("--metrics-max-bytes", type=int, default=None,
+                   metavar="BYTES",
+                   help="size-based JSONL rotation: when the live file "
+                   "would exceed BYTES the writer renames it to .1/.2/... "
+                   "(never splitting a record), re-stamps the run manifest "
+                   "onto the new segment, and keeps the newest 4 rotated "
+                   "segments (older ones are GC'd); default: no rotation")
+    p.add_argument("--flightrecorder-capacity", type=int, default=256,
+                   metavar="EVENTS",
+                   help="flight-recorder ring size: the last N scheduling "
+                   "decisions (admit/park/reject/deadline/migration/tick) "
+                   "kept host-side for GET /debug/flightrecorder and "
+                   "triggered kind=blackbox dumps; memory is capped at N "
+                   "events regardless of uptime")
     p.add_argument("--drain-timeout", type=float, default=30.0,
                    metavar="SECONDS",
                    help="on Ctrl-C/SIGTERM: stop accepting, then wait up "
@@ -1678,6 +1717,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--once", action="store_true",
                    help="one sweep, print the fleet record, exit")
     p.set_defaults(fn=cmd_fleet)
+
+    p = sub.add_parser(
+        "incident",
+        help="postmortem bundler: sweep router + replica flight recorders "
+        "(GET /debug/flightrecorder) into one JSONL bundle with a "
+        "wall-clock-ordered cross-replica timeline; jax-free — "
+        "summarize with bpe-tpu report",
+    )
+    p.add_argument("--replica", action="append", required=True,
+                   metavar="HOST:PORT",
+                   help="replica base URL (repeatable)")
+    p.add_argument("--router", default=None, metavar="HOST:PORT",
+                   help="router base URL (its per-hop ring joins the "
+                   "timeline)")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-host sweep timeout in seconds (hosts are "
+                   "swept concurrently: a dead host costs one timeout)")
+    p.add_argument("--request", default=None, metavar="REQUEST_ID",
+                   help="narrow the timeline to one X-Request-Id "
+                   "(cross-host request correlation)")
+    p.add_argument("--timeline-cap", type=int, default=2000,
+                   help="max merged timeline entries; overflow is counted "
+                   "as timeline_truncated, never dropped silently")
+    p.add_argument("--out", default="incident.jsonl",
+                   help="bundle path (kind=blackbox dumps + one "
+                   "kind=incident summary)")
+    p.set_defaults(fn=cmd_incident)
 
     p = sub.add_parser(
         "warmup",
@@ -1875,7 +1941,7 @@ def main(argv: list[str] | None = None) -> int:
         # the config itself.  The fleet router and aggregator are jax-free
         # too: they front replicas from a box with no accelerator runtime.
         command in ("report", "monitor", "verify-checkpoint", "route",
-                    "fleet")
+                    "fleet", "incident")
         or "--supervise" in raw_argv
     )
     if platforms and not jax_free:

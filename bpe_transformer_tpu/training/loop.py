@@ -33,6 +33,7 @@ from bpe_transformer_tpu.training.train_step import (
     make_train_step,
 )
 from bpe_transformer_tpu.telemetry import (
+    FlightRecorder,
     MetricsLogger,
     StepTimer,
     Telemetry,
@@ -722,6 +723,12 @@ def train(
             extra={"start_iteration": start_iteration, "n_chips": n_chips},
         )
     )
+    #: Always-on decision ring (telemetry/flightrecorder.py): rollback,
+    #: preemption, and watchdog transitions land here as host-side
+    #: bookkeeping (zero extra device syncs — pinned by the fetch-count
+    #: test), flushed as a kind="blackbox" dump on watchdog NaN/hang and
+    #: at the preemption epilogue.
+    recorder = FlightRecorder("train")
     wd = None
     if loop.watchdog:
         wd = Watchdog(
@@ -729,6 +736,7 @@ def train(
             steps_per_beat=loop.log_every,
             policy=loop.watchdog_policy,
             telemetry=telemetry,
+            recorder=recorder,
         )
         wd.start()
 
@@ -746,7 +754,7 @@ def train(
     #: step boundary (emergency checkpoint + kind="preemption" record +
     #: distinct exit code downstream).  install() is a no-op off the main
     #: thread — the flag then simply never trips.
-    stop = GracefulShutdown()
+    stop = GracefulShutdown(recorder=recorder)
     stop.install()
     preempted: str | None = None
     rollback_budget = (
@@ -1007,6 +1015,18 @@ def train(
                     # the offending tensor path, not just "loss is NaN".
                     record["nonfinite_path"] = dyn_flat["first_nonfinite"]
                 history.append(record)
+                # The decision ring's heartbeat: values already on the host
+                # from the fetch above (zero extra syncs — the fetch-count
+                # test pins this), coalesced so steady-state logging holds
+                # ONE ring slot and a preemption/NaN dump still shows the
+                # last healthy step alongside the failure events.
+                recorder.record(
+                    "step",
+                    coalesce=True,
+                    step=iteration,
+                    loss=last_loss,
+                    step_wall_s=round(step_wall_s, 6),
+                )
                 # Through the narrator, not sinks.log directly: emit() holds
                 # the telemetry lock (the watchdog thread writes hang events
                 # through the same JSONL handle) and counts the record for
@@ -1162,6 +1182,13 @@ def train(
                                     params, mesh, loop.parallel
                                 )
                         timer.exclude(handle.end())
+                        recorder.record(
+                            "rollback",
+                            step=detect_step,
+                            restored_step=restored,
+                            rollbacks=rollbacks,
+                            nonfinite_path=nonfinite_path,
+                        )
                         batch_salt += 1
                         # Prefetched batches were sampled with the OLD salt
                         # (and for the replayed window): drop them.
@@ -1256,6 +1283,23 @@ def train(
                         else {}
                     ),
                 }
+            )
+            # SIGTERM epilogue black-box: the decision ring (signal
+            # receipt, rollbacks, watchdog transitions) leaves with the
+            # stream before the slice vanishes.  Forced: a terminal path
+            # never loses its dump to the cooldown.
+            recorder.record(
+                "preemption",
+                step=iteration,
+                signal=preempted,
+                checkpoint=str(emergency) if emergency else None,
+            )
+            telemetry.emit(
+                recorder.blackbox(
+                    "preemption",
+                    context={"step": iteration, "signal": preempted},
+                    force=True,
+                )
             )
             log_fn(
                 f"preempted by {preempted} at step {iteration}"

@@ -1,0 +1,650 @@
+"""Flight recorder & incident forensics (ISSUE 16): the bounded decision
+ring, triggered black-box dumps, size-based JSONL rotation, alert history,
+and the `bpe-tpu incident` cross-replica postmortem bundler.
+
+The correctness bar: recording is pure host-side bookkeeping (the
+fetch-count test pins ZERO extra device syncs on the serving tick and the
+training step with the ring enabled), dumps carry the parked/rejected
+decisions that explain an alert, and the incident bundle's timeline is
+wall-clock-ordered across hosts.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from bpe_transformer_tpu.models import ModelConfig, TS_TEST_CONFIG, init_params
+from bpe_transformer_tpu.serving import Request, ServingEngine, make_http_server
+from bpe_transformer_tpu.telemetry import (
+    FlightRecorder,
+    MetricsLogger,
+    Telemetry,
+    validate_record,
+)
+from bpe_transformer_tpu.telemetry.alerts import (
+    AlertEngine,
+    BlockExhaustionRule,
+    QueueGrowthRule,
+)
+from bpe_transformer_tpu.telemetry.incident import main as incident_main
+from bpe_transformer_tpu.telemetry.report import (
+    extract_compare_metrics,
+    load_records,
+    render_report,
+    summarize,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+CFG = dataclasses.replace(TS_TEST_CONFIG, vocab_size=128, context_length=32)
+
+TINY_TRAIN = ModelConfig(
+    vocab_size=128,
+    context_length=16,
+    d_model=32,
+    num_layers=2,
+    num_heads=2,
+    d_ff=64,
+)
+TRAIN_HP = dict(
+    max_learning_rate=1e-3,
+    min_learning_rate=1e-4,
+    warmup_iters=2,
+    cosine_cycle_iters=50,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    rng = np.random.default_rng(0)
+    prompts = [
+        [int(t) for t in rng.integers(0, CFG.vocab_size, size=n)]
+        for n in (3, 7, 12, 19)
+    ]
+    return params, prompts
+
+
+# ----------------------------------------------------------------- the ring
+
+
+def test_ring_bounds_coalesces_and_snapshots_are_copies():
+    """Capacity is a hard memory cap (evictions counted, never an error),
+    coalesce=True merges consecutive same-event/same-request chatter into
+    one slot, and snapshot() hands out copies the caller can't corrupt."""
+    clock = iter(float(i) for i in range(1000))
+    rec = FlightRecorder("serve", capacity=4, clock=lambda: next(clock))
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder("serve", capacity=0)
+
+    for i in range(6):
+        rec.record("admit", request_id=f"r{i}", slot=i, none_field=None)
+    assert rec.recorded == 6 and rec.dropped == 2
+    events = rec.snapshot()
+    assert [e["request_id"] for e in events] == ["r2", "r3", "r4", "r5"]
+    assert all("none_field" not in e for e in events)  # nulls stripped
+    assert all(e["time_unix"] > 0 for e in events)  # absolute stamps ride
+
+    # Coalescing: 5 consecutive ticks occupy ONE slot with a count and the
+    # first occurrence's run-relative timestamp preserved.
+    for i in range(5):
+        rec.record("tick", coalesce=True, n_events=i)
+    events = rec.snapshot()
+    assert [e["event"] for e in events] == ["admit", "admit", "admit", "tick"]
+    tick = events[-1]
+    assert tick["count"] == 5 and tick["n_events"] == 4
+    assert tick["first_t"] < tick["t"]
+    # A different request_id breaks the merge — per-request park retries
+    # coalesce per request, not across requests.
+    rec.record("park", coalesce=True, request_id="a")
+    rec.record("park", coalesce=True, request_id="b")
+    assert [e.get("request_id") for e in rec.snapshot()[-2:]] == ["a", "b"]
+
+    # Snapshot copies: mutating the caller's view never touches the ring.
+    rec.snapshot()[-1]["request_id"] = "corrupted"
+    assert rec.snapshot()[-1]["request_id"] == "b"
+
+    # try_record (the signal-handler path) appends without blocking; held
+    # lock -> False and the event is dropped rather than deadlocking.
+    assert rec.try_record("signal_received", signal="SIGTERM") is True
+    assert rec.snapshot()[-1]["signal"] == "SIGTERM"
+    with rec._lock:
+        assert rec.try_record("signal_received") is False
+
+    stats = rec.stats()
+    assert stats["size"] == 4 and stats["capacity"] == 4
+    assert stats["recorded"] == rec.recorded
+
+
+def test_blackbox_cooldown_dedupes_storms_and_force_bypasses():
+    """One incident, one dump: inside the cooldown blackbox() returns None
+    (an alert storm re-firing every sample must not flood the stream);
+    force=True (manual POST, terminal paths) always dumps.  Retained dumps
+    are a bounded deque; context keys never clobber dump fields."""
+    t = [0.0]
+    rec = FlightRecorder(
+        "serve", capacity=8, clock=lambda: t[0], dump_cooldown_s=30.0,
+        max_dumps=2,
+    )
+    rec.record("park", request_id="r1", backlog=1)
+    dump = rec.blackbox(
+        "alert:block_exhaustion",
+        context={"queue_depth": 9, "trigger": "IGNORED", "kvpool": {"x": 1}},
+    )
+    assert dump["kind"] == "blackbox" and dump["component"] == "serve"
+    assert dump["trigger"] == "alert:block_exhaustion"  # context can't clobber
+    assert dump["queue_depth"] == 9 and dump["kvpool"] == {"x": 1}
+    assert [e["event"] for e in dump["events"]] == ["park"]
+    assert validate_record(dump) == []
+
+    t[0] = 10.0  # inside the 30s cooldown
+    assert rec.blackbox("alert:block_exhaustion") is None
+    forced = rec.blackbox("manual", force=True)
+    assert forced is not None and forced["trigger"] == "manual"
+    t[0] = 50.0  # 40s past the forced dump: cooldown expired again
+    assert rec.blackbox("watchdog_hang") is not None
+
+    dumps = rec.dumps()  # max_dumps=2: oldest dump evicted
+    assert [d["trigger"] for d in dumps] == ["manual", "watchdog_hang"]
+    assert rec.stats()["dumps"] == 2
+    page = rec.debug_page()
+    assert page["component"] == "serve" and len(page["dumps"]) == 2
+    assert [e["event"] for e in page["events"]] == ["park"]
+
+
+# ------------------------------------------------------- satellite: rotation
+
+
+def test_metrics_logger_rotates_restamps_manifest_and_gcs_segments(tmp_path):
+    """Size-based JSONL rotation: segments cut at record boundaries only
+    (every line in every segment parses), the run manifest is re-stamped
+    as the head of each new segment, and GC keeps the newest
+    keep_segments — stranded segments from earlier runs included."""
+    path = tmp_path / "metrics.jsonl"
+    # A stranded segment from a previous run: GC must claim it too.
+    (tmp_path / "metrics.jsonl.1").write_text(
+        json.dumps({"kind": "manifest", "run_kind": "old"}) + "\n"
+    )
+    manifest = {"kind": "manifest", "run_kind": "serve", "host": "t"}
+    logger = MetricsLogger(jsonl_path=path, max_bytes=200, keep_segments=2)
+    logger.log(manifest)
+    for i in range(30):
+        logger.log({"kind": "event", "name": "tick", "t": float(i), "i": i})
+    logger.close()
+
+    segments = sorted(
+        tmp_path.glob("metrics.jsonl.*"),
+        key=lambda p: int(p.name.rsplit(".", 1)[1]),
+    )
+    assert 1 <= len(segments) <= 2, "GC must keep at most keep_segments"
+    indices = [int(p.name.rsplit(".", 1)[1]) for p in segments]
+    assert 1 not in indices, "stranded segment from the old run must be GC'd"
+
+    seen: list[int] = []
+    for segment in segments + [path]:
+        lines = segment.read_text().splitlines()
+        records = [json.loads(line) for line in lines]  # no torn records
+        assert len(lines) >= 1
+        # Every rotated-into segment leads with the re-stamped manifest, so
+        # report's manifest resolution works on any retained segment alone.
+        assert records[0]["kind"] == "manifest"
+        assert records[0]["run_kind"] == "serve"
+        seen.extend(r["i"] for r in records if r.get("kind") == "event")
+    # Retained segments hold a contiguous, ordered tail of the stream.
+    assert seen == sorted(seen) and seen[-1] == 29
+
+    with pytest.raises(ValueError, match="max_bytes"):
+        MetricsLogger(jsonl_path=tmp_path / "x.jsonl", max_bytes=0)
+
+
+# -------------------------------------------------- satellite: alert history
+
+
+def test_alert_engine_history_keeps_bounded_transitions():
+    """AlertEngine retains the last N firing/cleared edges after they
+    clear — active() alone forgets an incident the moment it ends."""
+    engine = AlertEngine(
+        [QueueGrowthRule(window=3, min_depth=4)], history_limit=4
+    )
+    t = 0.0
+    for depth in (0, 4, 9):  # monotone growth to >= min_depth: fires
+        engine.feed({"queue_depth": depth}, t)
+        t += 1.0
+    assert [a["rule"] for a in engine.active()] == ["queue_growth"]
+    for depth in (9, 9, 9, 0):  # growth stops: clears
+        engine.feed({"queue_depth": depth}, t)
+        t += 1.0
+    assert engine.active() == []
+
+    history = engine.history()
+    assert [(h["rule"], h["state"]) for h in history] == [
+        ("queue_growth", "firing"),
+        ("queue_growth", "cleared"),
+    ]
+    assert history[1]["active_s"] > 0
+    assert engine.history(1)[0]["state"] == "cleared"
+
+    # Bounded: 3 more fire/clear cycles overflow the 4-entry deque.
+    for _ in range(3):
+        for depth in (0, 4, 9, 9, 9, 9, 0):
+            engine.feed({"queue_depth": depth}, t)
+            t += 1.0
+    assert len(engine.history()) == 4
+    # History copies: callers can't corrupt the retained transitions.
+    engine.history()[-1]["rule"] = "corrupted"
+    assert engine.history()[-1]["rule"] == "queue_growth"
+
+
+# ------------------------------------------- e2e: exhaustion -> dump -> ring
+
+
+@pytest.mark.serving
+def test_block_exhaustion_alert_flushes_blackbox_with_parked_admissions(
+    setup,
+):
+    """ACCEPTANCE (offline, deterministic): a paged engine driven to KV
+    block exhaustion parks the second admission, the block_exhaustion
+    alert fires on the free==0 gauge sample, and the triggered
+    kind="blackbox" dump's ring contains that parked admission — the
+    forensic chain the flight recorder exists for."""
+    params, prompts = setup
+    records = []
+    telemetry = Telemetry(sink=records.append)
+    serving = ServingEngine(
+        params, CFG, slots=2, min_bucket=8, paged=True, block_size=8,
+        num_kv_blocks=5, prefix_cache=False, telemetry=telemetry,
+        engine_record_every_s=0.0,
+        # Pin the rule set: a compile-storm edge from this test's own cold
+        # XLA programs must not race the exhaustion dump into the cooldown.
+        alert_rules=[BlockExhaustionRule()],
+    )
+    serving._running = True  # drive the worker loop by hand
+    h1 = serving.submit(
+        Request(
+            prompt_ids=tuple(prompts[2]), max_new_tokens=16, temperature=0.0,
+        )
+    )
+    h2 = serving.submit(
+        Request(
+            prompt_ids=tuple(prompts[3]), max_new_tokens=4, temperature=0.0,
+        )
+    )
+    # First step: h1's begin() reserves its worst-case chain — all 4
+    # usable blocks — so h2 parks in the same step and the end-of-step
+    # gauge sample sees free==0 with the park already in the ring.
+    for _ in range(300):
+        serving._step()
+        if h1._entry.done.is_set() and h2._entry.done.is_set():
+            break
+    serving._step()  # one more gauge sample so the alert clears
+    assert h1.result(timeout=5).finish_reason == "length"
+    assert h2.result(timeout=5).finish_reason == "length"
+
+    dumps = [r for r in records if r.get("kind") == "blackbox"]
+    assert dumps, "block exhaustion fired no blackbox dump"
+    dump = dumps[0]
+    assert validate_record(dump) == []
+    assert dump["component"] == "serve"
+    assert dump["trigger"] == "alert:block_exhaustion"
+    # The ring inside the dump holds the parked admission (and the alert
+    # edge itself as one of its newest entries).
+    ring_events = {e["event"] for e in dump["events"]}
+    assert "park" in ring_events and "alert" in ring_events
+    parked = [e for e in dump["events"] if e["event"] == "park"]
+    assert parked[0]["request_id"] == h2.request_id
+    # Host-side context rides the dump: kvpool gauges + backlog + alerts.
+    assert dump["kvpool"]["admit_backlog"] >= 1
+    assert dump["kvpool"]["kv_blocks_free"] == 0
+    assert any(a["rule"] == "block_exhaustion" for a in dump["alerts"])
+
+    # The kind="alert" transitions reached the stream and the engine's
+    # bounded history (fired, then cleared once retirement freed blocks).
+    states = [
+        (r["rule"], r["state"]) for r in records if r.get("kind") == "alert"
+    ]
+    assert ("block_exhaustion", "firing") in states
+    assert ("block_exhaustion", "cleared") in states
+    history = serving._alerts.history(2)
+    assert (history[-1]["rule"], history[-1]["state"]) == (
+        "block_exhaustion",
+        "cleared",
+    )
+
+    # The live surfaces agree: statusz counters + the debug page retain
+    # the dump after the incident cleared.
+    assert serving.statusz()["flightrecorder"]["dumps"] >= 1
+    debug = serving.flightrecorder.debug_page()
+    assert any(
+        d["trigger"] == "alert:block_exhaustion" for d in debug["dumps"]
+    )
+    assert {"admit", "finish"} <= {e["event"] for e in debug["events"]}
+    serving._running = False
+    serving.close()
+
+
+# ------------------------------------------------- e2e: the incident bundle
+
+
+def _stub_recorder_server(page: dict):
+    """A jax-free in-process 'replica': serves a canned flight-recorder
+    page — deterministic time_unix stamps for the ordering pin."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            body = json.dumps(page).encode("utf-8")
+            code = 200 if self.path == "/debug/flightrecorder" else 404
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    return HTTPServer(("127.0.0.1", 0), Handler)
+
+
+@pytest.mark.serving
+def test_incident_sweep_orders_cross_replica_timeline_by_wall_clock(
+    setup, tmp_path
+):
+    """ACCEPTANCE: `bpe-tpu incident` against two in-process replicas (a
+    live ServingEngine and a canned-ring peer) + one dead host: concurrent
+    sweep (the dead host costs at most one timeout), every retained dump
+    re-stamped with its source host, a synthesized trigger="sweep" dump
+    per live ring, and ONE kind="incident" record whose merged timeline is
+    ordered by absolute time_unix across hosts — the canned peer's
+    early/late events deterministically sandwich every live event."""
+    params, prompts = setup
+    now = time.time()
+    peer_page = {
+        "component": "route",
+        "capacity": 256,
+        "recorded": 2,
+        "dropped": 0,
+        "events": [
+            {"event": "pick", "t": 0.1, "time_unix": round(now - 1e4, 6),
+             "request_id": "req-early"},
+            {"event": "hop", "t": 9.0, "time_unix": round(now + 1e4, 6),
+             "request_id": "req-late"},
+        ],
+        "dumps": [
+            {"kind": "blackbox", "t": 5.0,
+             "time_unix": round(now - 5e3, 6), "component": "route",
+             "trigger": "manual", "events": []},
+        ],
+    }
+    serving = ServingEngine(params, CFG, slots=1, min_bucket=8)
+    out = tmp_path / "incident.jsonl"
+    with serving:
+        serving.generate(prompts[0], max_new_tokens=3, temperature=0.0)
+        server = make_http_server(serving, port=0)
+        peer = _stub_recorder_server(peer_page)
+        for srv in (server, peer):
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+        live_url = f"127.0.0.1:{server.server_address[1]}"
+        peer_url = f"127.0.0.1:{peer.server_address[1]}"
+        dead_url = "127.0.0.1:1"  # nothing listens on port 1
+        try:
+            # POST /debug/dump: the manual-trigger endpoint answers with
+            # the dump it forced, and the recorder retains it.
+            req = urllib.request.Request(
+                f"http://{live_url}/debug/dump", data=b"", method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                forced = json.loads(resp.read())
+            assert forced["kind"] == "blackbox"
+            assert forced["trigger"] == "manual"
+            t0 = time.monotonic()
+            rc = incident_main(
+                ["--replica", live_url, "--replica", peer_url,
+                 "--replica", dead_url, "--timeout", "1.5",
+                 "--out", str(out)]
+            )
+            # Concurrent sweep: 3 hosts, one dead — well under 2 timeouts.
+            assert time.monotonic() - t0 < 3.0
+        finally:
+            server.shutdown()
+            peer.shutdown()
+    assert rc == 0  # at least one host answered
+
+    bundle = load_records(out)
+    assert bundle[0]["kind"] == "manifest"
+    assert bundle[0]["run_kind"] == "incident"
+    incident = bundle[-1]
+    assert incident["kind"] == "incident"
+    assert validate_record(incident) == []
+
+    # Host table: live + peer online, the dead host one error row.
+    rows = {row["url"]: row for row in incident["hosts"]}
+    assert rows[f"http://{live_url}"]["online"] is True
+    assert rows[f"http://{peer_url}"]["online"] is True
+    assert rows[f"http://{dead_url}"]["online"] is False
+    assert rows[f"http://{dead_url}"]["error"]
+
+    # Every retained dump re-emitted with its source host, plus one
+    # synthesized trigger="sweep" dump per live ring.
+    dumps = [r for r in bundle if r.get("kind") == "blackbox"]
+    assert all(validate_record(d) == [] for d in dumps)
+    by_host_trigger = {(d["host"], d["trigger"]) for d in dumps}
+    assert (f"http://{live_url}", "manual") in by_host_trigger
+    assert (f"http://{live_url}", "sweep") in by_host_trigger
+    assert (f"http://{peer_url}", "manual") in by_host_trigger
+    assert (f"http://{peer_url}", "sweep") in by_host_trigger
+
+    # THE ordering pin: the merged timeline is sorted by absolute
+    # time_unix, so the canned peer's -10000s/+10000s events bracket every
+    # event the live replica recorded — cross-replica wall-clock order,
+    # not per-host concatenation.
+    timeline = incident["timeline"]
+    stamps = [e["time_unix"] for e in timeline]
+    assert stamps == sorted(stamps)
+    assert timeline[0]["request_id"] == "req-early"
+    assert timeline[0]["host"] == f"http://{peer_url}"
+    assert timeline[-1]["request_id"] == "req-late"
+    live_entries = [e for e in timeline if e["host"] == f"http://{live_url}"]
+    assert {"admit", "finish"} <= {e["event"] for e in live_entries}
+    assert all(e["component"] == "serve" for e in live_entries)
+
+    # The bundle is a report-readable stream: the == incident == section
+    # renders and the dead host surfaces as an anomaly.
+    assert "== incident (" in render_report(bundle)
+    summary = summarize(bundle)
+    assert summary["incident"]["hosts_online"] == 2
+    assert summary["incident"]["hosts_offline"] == [f"http://{dead_url}"]
+    assert any("unreachable" in a for a in summary["anomalies"])
+
+
+@pytest.mark.slow  # two live replicas + HTTP sweep: full matrix only
+@pytest.mark.serving
+def test_incident_sweep_two_live_replicas(setup, tmp_path):
+    """Heavy variant: two REAL ServingEngine replicas behind HTTP, both
+    forced to dump, swept into one bundle — both hosts online, both
+    replicas' dumps present, timeline stamps non-decreasing."""
+    params, prompts = setup
+    out = tmp_path / "incident.jsonl"
+    a = ServingEngine(params, CFG, slots=1, min_bucket=8)
+    b = ServingEngine(params, CFG, slots=1, min_bucket=8)
+    with a, b:
+        a.generate(prompts[0], max_new_tokens=3, temperature=0.0)
+        b.generate(prompts[1], max_new_tokens=3, temperature=0.0)
+        a.blackbox_dump("manual", force=True)
+        b.blackbox_dump("manual", force=True)
+        servers = [make_http_server(e, port=0) for e in (a, b)]
+        for srv in servers:
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+        urls = [f"127.0.0.1:{s.server_address[1]}" for s in servers]
+        try:
+            rc = incident_main(
+                ["--replica", urls[0], "--replica", urls[1],
+                 "--timeout", "10", "--out", str(out)]
+            )
+        finally:
+            for srv in servers:
+                srv.shutdown()
+    assert rc == 0
+
+    bundle = load_records(out)
+    incident = bundle[-1]
+    assert incident["kind"] == "incident"
+    assert {row["url"] for row in incident["hosts"]} == {
+        f"http://{u}" for u in urls
+    }
+    assert all(row["online"] for row in incident["hosts"])
+    dump_hosts = {r["host"] for r in bundle if r.get("kind") == "blackbox"}
+    assert dump_hosts == {f"http://{u}" for u in urls}
+    stamps = [e["time_unix"] for e in incident["timeline"]]
+    assert stamps == sorted(stamps)
+    hosts_in_timeline = {e["host"] for e in incident["timeline"]}
+    assert hosts_in_timeline == {f"http://{u}" for u in urls}
+
+
+# -------------------------------------------- report: fixture + compare gate
+
+
+def test_report_renders_incident_section_from_committed_fixture():
+    """The committed forensics fixture (tests/fixtures/blackbox_tiny.jsonl,
+    also the schema checker's coverage anchor for kind=blackbox/incident)
+    summarizes into the == incident == section and feeds the
+    blackbox_dumps_total compare-gate row."""
+    fixture = REPO / "tests" / "fixtures" / "blackbox_tiny.jsonl"
+    records = load_records(fixture)
+    for record in records:
+        assert validate_record(record) == []
+
+    summary = summarize(records)
+    inc = summary["incident"]
+    assert inc["dumps"] == 2
+    assert inc["by_component"] == {"serve": 1, "route": 1}
+    assert inc["by_trigger"] == {"alert:block_exhaustion": 1, "sweep": 1}
+    assert inc["sweeps"] == 1 and inc["hosts"] == 2
+    assert inc["timeline_entries"] == 3
+    # Alert/terminal triggers surface as anomalies (sweeps do not), and
+    # the unreachable host from the sweep's host table is called out.
+    assert any("alert:block_exhaustion" in a for a in summary["anomalies"])
+    assert any("unreachable" in a for a in summary["anomalies"])
+
+    text = render_report(records)
+    assert "== incident (2 blackbox dump(s), 1 sweep(s)) ==" in text
+    assert "serve:1" in text and "alert:block_exhaustion:1" in text
+
+    gates = extract_compare_metrics(summary)
+    assert gates["blackbox_dumps_total"] == (2.0, "higher")
+    # Streams without forensics records skip the row (never a false gate).
+    assert "blackbox_dumps_total" not in extract_compare_metrics(
+        summarize([{"step": 1, "loss": 2.0}])
+    )
+
+
+# ----------------------------------------------- the fetch-count acceptance
+
+
+@pytest.mark.serving
+def test_recording_adds_zero_device_fetches_on_tick_and_train_step(
+    setup, monkeypatch, tmp_path
+):
+    """ACCEPTANCE (the PR 4/6 fetch-count pattern): with the flight
+    recorder recording normally vs record() no-op'd, the number of
+    jax.device_get / jax.block_until_ready calls is IDENTICAL on both the
+    serving tick path and the training step path — recording is host-side
+    bookkeeping, never a device sync — and the normal runs actually
+    recorded events into their rings."""
+    from bpe_transformer_tpu.training import LoopConfig, TrainHParams, train
+
+    params, prompts = setup
+    counts = {"device_get": 0, "block_until_ready": 0}
+    real_get, real_block = jax.device_get, jax.block_until_ready
+
+    def counting_get(x):
+        counts["device_get"] += 1
+        return real_get(x)
+
+    def counting_block(x):
+        counts["block_until_ready"] += 1
+        return real_block(x)
+
+    def serve_once():
+        serving = ServingEngine(params, CFG, slots=1, min_bucket=8)
+        serving._running = True
+        h = serving.submit(
+            Request(
+                prompt_ids=tuple(prompts[0]), max_new_tokens=4,
+                temperature=0.0,
+            )
+        )
+        for _ in range(50):
+            serving._step()
+            if h._entry.done.is_set():
+                break
+        assert h.result(timeout=5).finish_reason == "length"
+        recorded = serving.flightrecorder.recorded
+        serving._running = False
+        serving.close()
+        return recorded
+
+    text = b"the quick brown fox. " * 2000
+    data = np.frombuffer(text, dtype=np.uint8).astype(np.uint16)
+
+    def train_once(tag):
+        loop = LoopConfig(
+            steps=4, batch_size=8, log_every=2, eval_every=100,
+            checkpoint_every=100,
+            metrics_jsonl=str(tmp_path / f"t_{tag}.jsonl"),
+        )
+        train(
+            TINY_TRAIN, TrainHParams(**TRAIN_HP), loop, data,
+            log_fn=lambda *_: None,
+        )
+
+    def measure(fn):
+        counts["device_get"] = counts["block_until_ready"] = 0
+        monkeypatch.setattr(jax, "device_get", counting_get)
+        monkeypatch.setattr(jax, "block_until_ready", counting_block)
+        try:
+            result = fn()
+        finally:
+            monkeypatch.setattr(jax, "device_get", real_get)
+            monkeypatch.setattr(jax, "block_until_ready", real_block)
+        return result, dict(counts)
+
+    # Warm every jit cache once so compile-time fetches can't skew the
+    # counted runs (run-order independence).
+    serve_once()
+    train_once("warm")
+
+    train_recorded = {"n": 0}
+    real_record = FlightRecorder.record
+
+    def observing_record(self, event, coalesce=False, **fields):
+        if self.component == "train":
+            train_recorded["n"] += 1
+        return real_record(self, event, coalesce=coalesce, **fields)
+
+    # Recording ON (normal wiring, instrumented only to observe the
+    # training loop's internal ring).
+    monkeypatch.setattr(FlightRecorder, "record", observing_record)
+    serve_recorded, counts_serve_on = measure(serve_once)
+    _, counts_train_on = measure(lambda: train_once("on"))
+    assert serve_recorded > 0, "serving tick recorded nothing"
+    assert train_recorded["n"] > 0, "training step recorded nothing"
+
+    # Recording OFF: record() is a pure no-op.
+    monkeypatch.setattr(
+        FlightRecorder, "record", lambda self, event, **fields: None
+    )
+    _, counts_serve_off = measure(serve_once)
+    _, counts_train_off = measure(lambda: train_once("off"))
+    monkeypatch.setattr(FlightRecorder, "record", real_record)
+
+    assert counts_serve_on == counts_serve_off  # zero extra serving syncs
+    assert counts_train_on == counts_train_off  # zero extra training syncs
