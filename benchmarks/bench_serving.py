@@ -729,6 +729,348 @@ def run_restart(args) -> dict:
     }
 
 
+#: Diurnal ramp (``--controller``/``--controller-static``): arrival-rate
+#: factor on --qps and long-prompt fraction per phase — overnight lull,
+#: a long-prompt-heavy peak, then a cooldown.  The shifting mix is what
+#: drives the controller's threshold retune; the rate ramp is what
+#: drives elastic scale-up.
+RAMP_PHASES = (
+    ("night", 0.5, 0.10),
+    ("peak", 2.0, 0.40),
+    ("cool", 1.0, 0.20),
+)
+
+
+def _free_port() -> int:
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def run_controller_ramp(args) -> dict:
+    """Diurnal-ramp fleet bench (ISSUE 20): the same Poisson ramp with a
+    shifting prompt mix served by a real subprocess fleet — one
+    always-on role=both replica, one prefill-tier replica, and one
+    ELASTIC slot — either supervised by the closed-loop controller
+    (``--controller``: retune + rebalance + scale-up actually fire) or
+    left static (``--controller-static``: the fixed fleet the controller
+    row is judged against).  ``--chaos`` additionally SIGKILLs the
+    always-on replica mid-decode and blackholes its first ``/kv/import``
+    (``BT_FAULTS``), so the row shows what the spawner-respawn +
+    suspect-probe + retry-with-idempotency-key stack recovers.
+
+    The parent stays jax-free on CPU (router, aggregator, and controller
+    are all pure-stdlib); replicas own the chip.  One JSON row."""
+    import dataclasses
+    import os
+    import pickle
+    import shutil
+    import tempfile
+    import threading
+
+    child_jax_platforms = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"  # parent: params init only
+
+    import jax as _jax
+
+    import bpe_transformer_tpu.models as models
+    from bpe_transformer_tpu.checkpointing import save_checkpoint
+    from bpe_transformer_tpu.models import init_params
+    from bpe_transformer_tpu.serving.controller import (
+        FleetController,
+        ReplicaSpawner,
+    )
+    from bpe_transformer_tpu.serving.router import (
+        Router,
+        make_router_http_server,
+    )
+    from bpe_transformer_tpu.telemetry.fleet import (
+        FleetAggregator,
+        make_fleet_http_server,
+    )
+
+    managed = bool(args.controller)
+    config = getattr(models, CONFIGS[args.config])
+    new_tokens = min(args.new_tokens, 16)
+    n_requests = args.requests or 48
+    base_qps = args.qps or 4.0
+    if args.prompt_mix:
+        short, long_, _ = _parse_prompt_mix(args.prompt_mix)
+    else:
+        short, long_ = 12, 160
+    initial_threshold = args.prefill_threshold or 96
+
+    workdir = Path(tempfile.mkdtemp(prefix="bpe_ramp_"))
+    servers: list = []
+    spawner = None
+    router = None
+    fleet = None
+    stop = threading.Event()
+    try:
+        ckpt = workdir / "model.ckpt"
+        save_checkpoint(
+            ckpt,
+            params=init_params(_jax.random.PRNGKey(0), config),
+            extra={"model_config": dataclasses.asdict(config)},
+        )
+        tok_dir = workdir / "tok"
+        tok_dir.mkdir()
+        with open(tok_dir / "vocab.pkl", "wb") as f:
+            pickle.dump({i: bytes([i]) for i in range(256)}, f)
+        with open(tok_dir / "merges.pkl", "wb") as f:
+            pickle.dump([], f)
+        cache_dir = workdir / "xla_cache"
+        repo_root = str(Path(__file__).resolve().parent.parent)
+
+        env_prefix = ["env", f"PYTHONPATH={repo_root}"] + (
+            [f"JAX_PLATFORMS={child_jax_platforms}"]
+            if child_jax_platforms is not None
+            else ["-u", "JAX_PLATFORMS"]
+        )
+
+        def serve_argv(port, role, extra_env=(), extra=()):
+            return (
+                env_prefix + list(extra_env) + [
+                    sys.executable, "-m",
+                    "bpe_transformer_tpu.training.cli", "serve",
+                    "--checkpoint", str(ckpt),
+                    "--tokenizer-dir", str(tok_dir),
+                    "--port", str(port),
+                    "--slots", "4",
+                    "--max-new-tokens", str(new_tokens),
+                    "--compile-cache", str(cache_dir),
+                    "--paged", "--block-size", str(args.block_size),
+                    "--role", role,
+                ] + list(extra)
+            )
+
+        port_a, port_p, port_e = _free_port(), _free_port(), _free_port()
+        url_a = f"http://127.0.0.1:{port_a}"
+        url_p = f"http://127.0.0.1:{port_p}"
+        url_e = f"http://127.0.0.1:{port_e}"
+
+        chaos_env = ()
+        if args.chaos:
+            fault_dir = workdir / "faults_a"
+            fault_dir.mkdir()
+            # Fires once each (once_dir survives the respawn): the
+            # always-on replica dies mid-decode and swallows its first
+            # /kv/import; the spawner respawns it, the router probes it
+            # back in, and the relay's idempotency-keyed retry lands.
+            chaos_env = ("BT_FAULTS=" + json.dumps({
+                "kill_at_decode_tick": 24,
+                "http_blackhole": True,
+                "http_fault_path": "/kv/import",
+                "once_dir": str(fault_dir),
+            }),)
+
+        spawner = ReplicaSpawner([
+            (url_a, serve_argv(port_a, "both", extra_env=chaos_env)),
+            (url_p, serve_argv(
+                port_p, "prefill", extra=("--evacuate-to", url_a),
+            )),
+            (url_e, serve_argv(
+                port_e, "both", extra=("--evacuate-to", url_a),
+            )),
+        ])
+        spawner.spawn()  # always-on decode-capable replica
+        spawner.spawn()  # prefill tier; third slot stays elastic
+
+        router = Router(
+            [url_a, url_p, url_e],
+            poll_interval_s=0.5, poll_timeout_s=2.0,
+            connect_timeout_s=2.0, request_timeout_s=600.0,
+            prefill_threshold=initial_threshold, suspect_after=3,
+            probe_backoff_s=0.5, probe_backoff_max_s=4.0,
+        )
+        router.start()
+        router_port = _free_port()
+        router_httpd = make_router_http_server(
+            router, port=router_port
+        )
+        servers.append(router_httpd)
+        fleet = FleetAggregator(
+            [url_a, url_p, url_e],
+            router_url=f"http://127.0.0.1:{router_port}",
+            poll_interval_s=1.0, poll_timeout_s=2.0,
+        )
+        fleet_port = _free_port()
+        fleet_httpd = make_fleet_http_server(fleet, port=fleet_port)
+        servers.append(fleet_httpd)
+        for httpd in servers:
+            threading.Thread(
+                target=httpd.serve_forever, daemon=True
+            ).start()
+
+        controller = None
+        if managed:
+            controller = FleetController(
+                f"http://127.0.0.1:{fleet_port}",
+                router_url=f"http://127.0.0.1:{router_port}",
+                spawner=spawner,
+                poll_timeout_s=2.0, evidence_max_age_s=15.0,
+                cooldown_s=10.0, action_timeout_s=120.0,
+                scale_sustain_s=4.0, scale_down_idle_s=1e9,
+                retune_min_samples=12, rebalance_min_gap=4,
+            )
+
+            def ctl_loop():
+                while not stop.is_set():
+                    try:
+                        controller.run_once()
+                    except Exception:  # noqa: BLE001 — keep ticking
+                        pass
+                    stop.wait(1.0)
+
+            threading.Thread(target=ctl_loop, daemon=True).start()
+
+        # Wait for the two always-on replicas to come up (compile-cached
+        # spawns after the first pass are fast; a cold first pass pays
+        # the ladder once here, outside the timed ramp).
+        deadline = time.perf_counter() + 1200
+        while time.perf_counter() < deadline:
+            router.poll_once()
+            if sum(r.available for r in router.replicas) >= 2:
+                break
+            time.sleep(1.0)
+        else:
+            raise RuntimeError("always-on replicas never came up")
+        fleet.start()
+
+        # Build the ramp: per-phase Poisson arrivals on a shared clock,
+        # each request tagged with its phase.
+        rng = np.random.default_rng(0)
+        per_phase = max(n_requests // len(RAMP_PHASES), 1)
+        schedule = []  # (arrival_s, phase_idx, prompt)
+        t_cursor = 0.0
+        for idx, (_, qps_factor, long_frac) in enumerate(RAMP_PHASES):
+            prompts, _flags = _prompts_mix(
+                rng, config, n_requests=per_phase,
+                new_tokens=new_tokens, short=short, long_=long_,
+                frac=long_frac,
+            )
+            gaps = rng.exponential(
+                1.0 / (base_qps * qps_factor), size=per_phase
+            )
+            for prompt, gap in zip(prompts, gaps):
+                t_cursor += float(gap)
+                schedule.append((t_cursor, idx, prompt))
+
+        lat: list = [None] * len(schedule)
+        codes: list = [None] * len(schedule)
+
+        def serve_one(i, t0):
+            arrival, _, prompt = schedule[i]
+            delay = arrival - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            body = json.dumps({
+                "prompt_ids": prompt, "max_new_tokens": new_tokens,
+                "temperature": 1.0, "top_k": 50, "seed": i,
+            }).encode()
+            t_s = time.perf_counter()
+            try:
+                code, _payload = router.handle_generate(body)
+            except Exception:  # noqa: BLE001 — the row reports it
+                code = 599
+            codes[i] = code
+            if code == 200:
+                lat[i] = time.perf_counter() - t_s
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=serve_one, args=(i, t0), daemon=True)
+            for i in range(len(schedule))
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=1900)
+        wall = time.perf_counter() - t0
+
+        phases_out = []
+        for idx, (name, qps_factor, long_frac) in enumerate(RAMP_PHASES):
+            sel = [i for i, (_, p, _pr) in enumerate(schedule) if p == idx]
+            ok = [lat[i] for i in sel if lat[i] is not None]
+            phases_out.append({
+                "phase": name,
+                "qps": round(base_qps * qps_factor, 3),
+                "long_frac": long_frac,
+                "requests": len(sel),
+                "failed": sum(1 for i in sel if codes[i] != 200),
+                "latency_p50_s": (
+                    round(_pctl(ok, 0.50), 4) if ok else None
+                ),
+                "latency_p99_s": (
+                    round(_pctl(ok, 0.99), 4) if ok else None
+                ),
+            })
+        done = [v for v in lat if v is not None]
+
+        router_page = router.statusz()
+        ctl_fields = {}
+        if controller is not None:
+            stop.set()
+            ctl_page = controller.statusz()
+            by_action: dict = {}
+            for rec in ctl_page.get("recent") or []:
+                if rec.get("outcome") == "ok":
+                    key = rec["action"]
+                    by_action[key] = by_action.get(key, 0) + 1
+            ctl_fields = {
+                "controller_actions_ok": ctl_page["actions_ok"],
+                "controller_actions_failed": ctl_page["actions_failed"],
+                "controller_holds": ctl_page["holds"],
+                "controller_breaker": ctl_page["breaker"],
+                "scale_ups": by_action.get("scale_up", 0),
+                "retunes": by_action.get("retune", 0),
+                "rebalances": by_action.get("rebalance", 0),
+            }
+        row = {
+            "mode": "controller" if managed else "static",
+            "chaos": bool(args.chaos),
+            "wall_s": round(wall, 3),
+            "requests": len(schedule),
+            "completed": len(done),
+            "failed": len(schedule) - len(done),
+            "latency_p50_s": (
+                round(_pctl(done, 0.50), 4) if done else None
+            ),
+            "latency_p99_s": (
+                round(_pctl(done, 0.99), 4) if done else None
+            ),
+            "phases": phases_out,
+            "prefill_threshold_initial": initial_threshold,
+            "prefill_threshold_final": router_page.get(
+                "prefill_threshold"
+            ),
+            "threshold_updates": router_page.get("threshold_updates"),
+            "replicas_suspected": router_page.get("suspected_total"),
+            "suspect_probes": router_page.get("probes_total"),
+            "suspect_recoveries": router_page.get("recoveries_total"),
+            "respawns": sum(
+                s["restarts"] for s in spawner.snapshot()
+            ),
+            **ctl_fields,
+        }
+    finally:
+        stop.set()
+        if fleet is not None:
+            fleet.close()
+        if router is not None:
+            router.close()
+        for httpd in servers:
+            httpd.shutdown()
+        if spawner is not None:
+            spawner.stop_all(timeout_s=60.0)
+        shutil.rmtree(workdir, ignore_errors=True)
+    return row
+
+
 def main() -> int:
     require_accelerator(Path(__file__).stem)
     parser = argparse.ArgumentParser()
@@ -807,6 +1149,23 @@ def main() -> int:
                         help="(with --disagg) prompt-token threshold for "
                         "the two-tier path (default: midpoint of the "
                         "prompt mix)")
+    parser.add_argument("--controller", action="store_true",
+                        help="diurnal-ramp fleet mode (ISSUE 20): a "
+                        "subprocess fleet (always-on + prefill-tier + "
+                        "one elastic slot) under the closed-loop "
+                        "controller — retune/rebalance/scale-up fire "
+                        "against the shifting mix and rate ramp; one "
+                        "row with per-phase p50/p99 + action counts")
+    parser.add_argument("--controller-static", action="store_true",
+                        help="the same diurnal ramp WITHOUT the "
+                        "controller — the static-fleet baseline the "
+                        "--controller row is judged against")
+    parser.add_argument("--chaos", action="store_true",
+                        help="(with --controller) BT_FAULTS chaos: "
+                        "SIGKILL the always-on replica mid-decode and "
+                        "blackhole its first /kv/import — the row shows "
+                        "what respawn + suspect-probe + idempotent "
+                        "retry recover")
     parser.add_argument("--restart", action="store_true",
                         help="restart-to-traffic mode: time a replica "
                         "from spawn to first token through the router "
@@ -826,10 +1185,31 @@ def main() -> int:
     if args.disagg and not args.prompt_mix:
         print("--disagg needs --prompt-mix", file=sys.stderr)
         return 2
-    if args.prompt_mix and (args.qps is None or not args.paged):
+    if args.prompt_mix and not (args.controller or args.controller_static) \
+            and (args.qps is None or not args.paged):
         print("--prompt-mix needs --qps (open loop) and --paged "
               "(KV migration lives in the block pool)", file=sys.stderr)
         return 2
+
+    if args.chaos and not args.controller:
+        print("--chaos needs --controller", file=sys.stderr)
+        return 2
+    if args.controller and args.controller_static:
+        print("--controller and --controller-static are exclusive",
+              file=sys.stderr)
+        return 2
+    if args.controller or args.controller_static:
+        cell = run_controller_ramp(args)
+        print(json.dumps(
+            {
+                "metric": f"controller_ramp ({args.config}, "
+                f"mode={cell['mode']}"
+                + (", chaos" if cell["chaos"] else "") + ")",
+                **cell,
+                "platform": "subprocess",
+            }
+        ), flush=True)
+        return 0
 
     if args.restart:
         cell = run_restart(args)

@@ -372,6 +372,27 @@ run_job restart_traffic 1800 "$CAP/restart.jsonl" \
   python benchmarks/bench_serving.py --config tinystories-4l --restart \
   --paged --block-size 16 --decode-attention paged
 
+# Self-healing fleet control plane (ISSUE 20): the diurnal ramp (rate
+# ramp + shifting long-prompt mix) served by a real subprocess fleet —
+# static baseline first (fixed threshold, no elastic slot spawned), then
+# the controller-managed run (threshold retunes follow the mix, the
+# sustained queue-growth alert spawns the elastic replica, hot sessions
+# rebalance over the wire), then the chaos variant (the always-on
+# replica SIGKILLed mid-decode + its first /kv/import blackholed): the
+# row's failed/respawns/suspect_recoveries fields show what the
+# respawn + suspect-probe + idempotent-retry stack recovered.  The
+# parent is CPU-pinned jax-free (platform "subprocess"); replicas own
+# the chip sequentially with the shared compile cache.
+run_job controller_ramp_static 1800 "$CAP/controller_ramp.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --controller-static --requests 48 --qps 4
+run_job controller_ramp 1800 "$CAP/controller_ramp.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --controller --requests 48 --qps 4
+run_job fleet_chaos 1800 "$CAP/controller_ramp.jsonl" \
+  python benchmarks/bench_serving.py --config tinystories-4l \
+  --controller --chaos --requests 48 --qps 4
+
 # Dynamics-introspection overhead (PR 4): the headline config with the
 # in-graph telemetry.dynamics stats compiled into the step (per-layer
 # norms, update ratios, activation taps), captured to its own file
@@ -882,6 +903,80 @@ print("  ".join(parts))
 PY
 )
   [ -n "$DISAGG_LINE" ] && log "disaggregated-serving self-report: $DISAGG_LINE"
+fi
+# Controller-ramp self-report (jax-free, CPU-only): newest row per
+# (mode, chaos) — the controller-managed ramp vs the static fleet on
+# peak-phase p99 (elastic capacity + retune are supposed to move it),
+# the action counts proving the loop actually acted, and the chaos
+# row's recovery evidence (failed / respawns / suspect recoveries).
+if [ -s "$CAP/controller_ramp.jsonl" ]; then
+  CTRL_LINE=$(env JAX_PLATFORMS=cpu python - "$CAP/controller_ramp.jsonl" <<'PY'
+import json, sys
+
+rows = {}
+for ln in open(sys.argv[1]):
+    ln = ln.strip()
+    if not ln:
+        continue
+    try:
+        r = json.loads(ln)
+    except json.JSONDecodeError:
+        continue
+    if str(r.get("metric", "")).startswith("controller_ramp"):
+        rows[(r.get("mode"), bool(r.get("chaos")))] = r  # newest wins
+managed = rows.get(("controller", False))
+static = rows.get(("static", False))
+chaos = rows.get(("controller", True))
+if managed is None and chaos is None:
+    sys.exit(0)
+
+
+def num(v, d=4):
+    return f"{v:,.{d}g}" if isinstance(v, (int, float)) else "n/a"
+
+
+def peak(r):
+    for ph in r.get("phases") or []:
+        if ph.get("phase") == "peak":
+            return ph.get("latency_p99_s")
+    return None
+
+
+parts = []
+if managed is not None:
+    parts.append(
+        f"peak p99 {num(peak(managed))}s"
+        + (f" (static {num(peak(static))}s)" if static else "")
+    )
+    parts.append(
+        f"actions ok/failed {managed.get('controller_actions_ok')}"
+        f"/{managed.get('controller_actions_failed')}"
+        f" (scale_up {managed.get('scale_ups')}, retune "
+        f"{managed.get('retunes')}, rebalance "
+        f"{managed.get('rebalances')})"
+    )
+    parts.append(
+        f"threshold {managed.get('prefill_threshold_initial')}"
+        f"->{managed.get('prefill_threshold_final')}"
+    )
+    mp, sp = peak(managed), peak(static) if static else None
+    if isinstance(mp, (int, float)) and isinstance(sp, (int, float)) \
+            and mp >= sp:
+        parts.append("WARNING: controller peak p99 NOT below static")
+    if managed.get("controller_breaker") == "tripped":
+        parts.append("WARNING: controller breaker tripped during ramp")
+if chaos is not None:
+    parts.append(
+        f"chaos: failed {chaos.get('failed')}, respawns "
+        f"{chaos.get('respawns')}, suspect recoveries "
+        f"{chaos.get('suspect_recoveries')}"
+    )
+    if chaos.get("failed"):
+        parts.append("WARNING: chaos ramp dropped requests")
+print("  ".join(parts))
+PY
+)
+  [ -n "$CTRL_LINE" ] && log "controller-ramp self-report: $CTRL_LINE"
 fi
 # Restart-to-traffic self-report (jax-free, CPU-only): the newest restart
 # row's cold vs warmed spawn->first-token seconds — ROADMAP item 5's

@@ -19,6 +19,20 @@ recovery path end-to-end:
   a named file (dense ``.ckpt``, a shard ``.npy``, a manifest) so the
   integrity/fallback path sees real damage.
 
+Serving-addressable faults (ISSUE 20) extend the same plan to the fleet
+chaos harness — per-replica via each replica process's own ``BT_FAULTS``:
+
+* **kill at decode tick K** — ``SIGKILL`` mid-decode from the serving
+  worker loop: the dying-replica case the controller + supervisor must
+  absorb with zero failed requests;
+* **HTTP delay / blackhole** — matching request paths (substring, e.g.
+  ``/kv/import``) sleep for ``http_delay_s`` or drop the connection
+  without a response: the slow/partitioned-peer case the migration
+  retry + idempotency machinery must survive;
+* **payload corruption** — the exported migration payload is truncated
+  or bit-flipped in flight (``corrupt_payload``): the importer's CRC
+  must 400 it, never graft it.
+
 Faults fire ONCE.  In-process that is an instance flag; across supervisor
 respawns (same env, fresh process) set ``once_dir`` and the firing leaves a
 marker file the next process honors — so "kill at step 6" means the FIRST
@@ -36,6 +50,7 @@ import dataclasses
 import json
 import os
 import signal
+import time
 from pathlib import Path
 
 
@@ -47,6 +62,20 @@ class FaultPlan:
     kill_at_step: int | None = None
     preempt_at_step: int | None = None
     fail_read_at_step: int | None = None
+    # ---- serving faults (ISSUE 20 fleet chaos) ----
+    #: SIGKILL self on the Nth serving decode tick (mid-decode death).
+    kill_at_decode_tick: int | None = None
+    #: Sleep this long before handling an HTTP request whose path contains
+    #: ``http_fault_path`` (slow peer / WAN latency).
+    http_delay_s: float | None = None
+    #: Drop the connection (no response) for a request whose path contains
+    #: ``http_fault_path`` — fires once, so a retry gets through.
+    http_blackhole: bool = False
+    #: Substring matched against the request path for the two HTTP faults.
+    http_fault_path: str = "/kv/import"
+    #: Damage exported migration payload bytes in flight:
+    #: ``"truncate"`` or ``"flip"`` (fires once).
+    corrupt_payload: str | None = None
     #: Directory for cross-process fire-once markers (supervisor respawns).
     once_dir: str | None = None
 
@@ -95,6 +124,10 @@ class FaultInjector:
         self._fired.add(fault)
         return True
 
+    def _fire_once(self, fault: str) -> bool:
+        """Fire-once for faults with no step axis (HTTP, payload)."""
+        return self._should_fire(fault, 0, 0)
+
     # ----------------------------------------------------------------- hooks
 
     def at_step(self, step: int) -> None:
@@ -107,6 +140,52 @@ class FaultInjector:
             os.kill(os.getpid(), signal.SIGTERM)
         if self._should_fire("kill", self.plan.kill_at_step, step):
             os.kill(os.getpid(), signal.SIGKILL)
+
+    def at_decode_tick(self, tick: int) -> None:
+        """Called by the serving worker loop once per decode tick:
+        SIGKILL-mid-decode (the marker is written before the kill, so the
+        supervisor's respawn survives the same tick)."""
+        if self.plan is None:
+            return
+        if self._should_fire(
+            "kill_decode", self.plan.kill_at_decode_tick, tick
+        ):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_http_request(self, path: str) -> str | None:
+        """Called by HTTP handlers before dispatch.  Returns ``"blackhole"``
+        when the handler must drop the connection without responding;
+        otherwise sleeps any planned delay inline and returns ``None``.
+        Both fire once (marker-backed), so a retried request gets through —
+        which is exactly what the migration retry path is tested on."""
+        if self.plan is None or self.plan.http_fault_path not in path:
+            return None
+        if self.plan.http_blackhole and self._fire_once("http_blackhole"):
+            return "blackhole"
+        if self.plan.http_delay_s and self._fire_once("http_delay"):
+            time.sleep(self.plan.http_delay_s)
+        return None
+
+    def on_export_payload(self, data: bytes) -> bytes:
+        """Called on exported migration payload bytes before they leave the
+        process: truncate or bit-flip in flight (fires once).  The flip
+        lands in the trailing quarter — the array section — so it is the
+        case only the v2 CRC catches."""
+        if self.plan is None or not self.plan.corrupt_payload:
+            return data
+        if not self._fire_once("corrupt_payload"):
+            return data
+        mode = self.plan.corrupt_payload
+        if mode == "truncate":
+            return data[: max(len(data) // 2, 16)]
+        if mode == "flip":
+            if not data:
+                return data
+            buf = bytearray(data)
+            pos = (len(buf) * 3) // 4
+            buf[pos] ^= 0xFF
+            return bytes(buf)
+        raise ValueError(f"unknown corrupt_payload mode {mode!r}")
 
     def on_batch_read(self, step: int) -> None:
         """Called before each batch sample; raises the planned read error."""

@@ -61,6 +61,7 @@ front-end box with no accelerator runtime, like ``bpe-tpu monitor``.
 
 from __future__ import annotations
 
+import collections
 import http.client
 import json
 import threading
@@ -82,7 +83,7 @@ class ReplicaState:
         "url", "healthy", "draining", "queue_depth", "active_slots",
         "slots", "kv_blocks_free", "kv_blocks_total", "last_error",
         "last_poll_t", "consecutive_failures", "routed", "retried_away",
-        "role",
+        "role", "suspect", "next_probe_t", "probe_backoff_s",
     )
 
     def __init__(self, url: str):
@@ -103,10 +104,19 @@ class ReplicaState:
         self.consecutive_failures = 0
         self.routed = 0
         self.retried_away = 0
+        #: Suspect replicas (ISSUE 20): after ``suspect_after`` consecutive
+        #: connect failures the replica is quarantined — excluded from
+        #: routing AND from the regular poll sweep, probed only when the
+        #: exponential backoff deadline (``next_probe_t``) passes.  A live
+        #: request never pays a connect timeout against a host the fleet
+        #: already knows is gone; a successful probe clears the flag.
+        self.suspect = False
+        self.next_probe_t: float | None = None
+        self.probe_backoff_s = 0.0
 
     @property
     def available(self) -> bool:
-        return self.healthy and not self.draining
+        return self.healthy and not self.draining and not self.suspect
 
     def weight(self) -> float:
         """Free-capacity score (higher = more headroom): free slots are
@@ -137,6 +147,8 @@ class ReplicaState:
             "routed": self.routed,
             "retried_away": self.retried_away,
             "consecutive_failures": self.consecutive_failures,
+            "suspect": self.suspect,
+            "probe_backoff_s": round(self.probe_backoff_s, 3),
             "last_error": self.last_error,
         }
 
@@ -155,6 +167,10 @@ class Router:
         request_timeout_s: float = 600.0,
         connect_timeout_s: float = 5.0,
         prefill_threshold: int | None = None,
+        suspect_after: int = 3,
+        probe_backoff_s: float = 1.0,
+        probe_backoff_max_s: float = 30.0,
+        prompt_mix_window: int = 256,
         clock=time.monotonic,
         telemetry=None,
     ):
@@ -180,6 +196,23 @@ class Router:
         #: fleet with no available prefill-role replica (the threshold
         #: degrades to normal balancing, never to an error).
         self.prefill_threshold = prefill_threshold
+        #: Suspect quarantine (ISSUE 20): consecutive connect failures
+        #: before a replica is suspected, and the probe backoff that
+        #: replaces the regular poll while it is (doubles per failed
+        #: probe, capped).
+        self.suspect_after = max(int(suspect_after), 1)
+        self.probe_backoff_s = probe_backoff_s
+        self.probe_backoff_max_s = probe_backoff_max_s
+        self.suspected_total = 0
+        self.probes_total = 0
+        self.recoveries_total = 0
+        #: Live prompt-mix window (ISSUE 20): recent prompt token counts,
+        #: so the fleet controller can retune --prefill-threshold to the
+        #: traffic actually arriving instead of a provisioning-time guess.
+        self._prompt_mix: collections.deque = collections.deque(
+            maxlen=max(int(prompt_mix_window), 1)
+        )
+        self.threshold_updates = 0
         self._clock = clock
         self._t0 = clock()
         self._lock = threading.Lock()
@@ -282,12 +315,29 @@ class Router:
     def poll_once(self) -> None:
         """Refresh every replica's health from its ``/statusz``.  Replicas
         are polled CONCURRENTLY: one blackholed host must cost one poll
-        timeout, not delay the whole fleet's health refresh by N of them."""
+        timeout, not delay the whole fleet's health refresh by N of them.
+
+        SUSPECT replicas (>= ``suspect_after`` consecutive connect
+        failures) are skipped until their exponential-backoff probe
+        deadline passes — a dead host costs one connect timeout per
+        probe window, not one per poll interval."""
+        now = self._clock()
+        due = []
+        with self._lock:
+            for replica in self.replicas:
+                if replica.suspect:
+                    if (
+                        replica.next_probe_t is not None
+                        and now < replica.next_probe_t
+                    ):
+                        continue
+                    self.probes_total += 1
+                due.append(replica)
         threads = [
             threading.Thread(
                 target=self._poll_replica, args=(replica,), daemon=True
             )
-            for replica in self.replicas
+            for replica in due
         ]
         for thread in threads:
             thread.start()
@@ -314,6 +364,16 @@ class Router:
             replica.kv_blocks_free = kvpool.get("kv_blocks_free")
             replica.kv_blocks_total = kvpool.get("kv_blocks_total")
             replica.consecutive_failures = 0
+            if replica.suspect:
+                # Recovery: a successful probe clears the quarantine and
+                # the replica rejoins routing on the next pick.
+                replica.suspect = False
+                replica.next_probe_t = None
+                replica.probe_backoff_s = 0.0
+                self.recoveries_total += 1
+                self.flightrecorder.record(
+                    "suspect_cleared", replica=replica.url
+                )
             replica.last_poll_t = self._clock()
             errors = page.get("last_errors") or []
             replica.last_error = (
@@ -328,6 +388,24 @@ class Router:
             replica.consecutive_failures += 1
             replica.last_error = error
             replica.last_poll_t = self._clock()
+            if replica.consecutive_failures < self.suspect_after:
+                return
+            # Quarantine (ISSUE 20): enough consecutive connect failures
+            # that live requests must stop paying the connect timeout.
+            # Each failed probe doubles the next probe's deadline, capped.
+            if not replica.suspect:
+                replica.suspect = True
+                replica.probe_backoff_s = self.probe_backoff_s
+                self.suspected_total += 1
+                self.flightrecorder.record(
+                    "suspect_marked", replica=replica.url,
+                    failures=replica.consecutive_failures,
+                )
+            else:
+                replica.probe_backoff_s = min(
+                    replica.probe_backoff_s * 2.0, self.probe_backoff_max_s
+                )
+            replica.next_probe_t = self._clock() + replica.probe_backoff_s
 
     # -------------------------------------------------------------- routing
 
@@ -505,15 +583,14 @@ class Router:
         self, body: bytes, trace_id: str, route: dict
     ) -> tuple[int, dict]:
         session = None
-        # The router treats the body as opaque bytes; only a request that
-        # can actually carry a session key pays the JSON parse (long
-        # sessionless prompt_ids bodies stay zero-parse on the proxy
-        # path) — unless the two-tier threshold is armed, which needs the
-        # prompt length.
+        # The body is parsed once for everything the router reads out of
+        # it: the sticky session key, the two-tier threshold's prompt
+        # length, and the live prompt-mix window the fleet controller
+        # retunes the threshold from (ISSUE 20 — the mix must be observed
+        # even while the threshold is unarmed, or the controller has no
+        # evidence to arm it with).
         parsed = None
-        if body and (
-            b'"session"' in body or self.prefill_threshold is not None
-        ):
+        if body:
             try:
                 parsed = json.loads(body)
                 if isinstance(parsed, dict):
@@ -522,6 +599,11 @@ class Router:
                     parsed = None
             except ValueError:
                 pass  # the replica will 400 it; routing just goes unsticky
+        if parsed is not None:
+            n_prompt = self._prompt_tokens(parsed)
+            if n_prompt > 0:
+                with self._lock:
+                    self._prompt_mix.append(n_prompt)
         # Two-tier dispatch (ISSUE 15): a long prompt with a live prefill
         # tier prefills there and decodes on the least-loaded decode
         # node; everything else (short prompts, no prefill tier, no
@@ -793,6 +875,50 @@ class Router:
 
     # ------------------------------------------------------------- surface
 
+    def set_prefill_threshold(self, threshold: int | None) -> int | None:
+        """Retune the two-tier split at runtime (``POST /admin/threshold``
+        — the fleet controller's tier-retuning actuator).  ``None``
+        disables two-tier routing; returns the new value."""
+        if threshold is not None:
+            threshold = int(threshold)
+            if threshold < 1:
+                raise ValueError("prefill_threshold must be >= 1 (or null)")
+        with self._lock:
+            old = self.prefill_threshold
+            self.prefill_threshold = threshold
+            self.threshold_updates += 1
+        self.flightrecorder.record(
+            "threshold_set", old=old, new=threshold
+        )
+        return threshold
+
+    def prompt_mix_summary(self) -> dict:
+        """Percentile summary of the recent prompt-length window — the
+        evidence the controller's tier-retuning rule reads."""
+        with self._lock:
+            window = sorted(self._prompt_mix)
+            threshold = self.prefill_threshold
+        if not window:
+            return {"count": 0}
+        n = len(window)
+
+        def pct(p: float) -> int:
+            return window[min(int(p * (n - 1) + 0.5), n - 1)]
+
+        return {
+            "count": n,
+            "mean": round(sum(window) / n, 1),
+            "p25": pct(0.25),
+            "p50": pct(0.50),
+            "p75": pct(0.75),
+            "p90": pct(0.90),
+            "max": window[-1],
+            "long_frac": (
+                round(sum(1 for x in window if x >= threshold) / n, 4)
+                if threshold is not None else None
+            ),
+        }
+
     def statusz(self) -> dict:
         with self._lock:
             replicas = [r.snapshot() for r in self.replicas]
@@ -804,11 +930,24 @@ class Router:
             client_errors = self.requests_client_errors
             sessions, hits = self.session_requests, self.affinity_hits
             migrated = self.requests_migrated
+            suspected, probes, recoveries = (
+                self.suspected_total, self.probes_total,
+                self.recoveries_total,
+            )
+            threshold_updates = self.threshold_updates
         return {
             "uptime_s": round(self._clock() - self._t0, 3),
             "replicas": replicas,
             "available": sum(1 for r in replicas if r["available"]),
             "prefill_threshold": self.prefill_threshold,
+            "prompt_mix": self.prompt_mix_summary(),
+            "threshold_updates": threshold_updates,
+            # Suspect quarantine (ISSUE 20): lifetime mark/probe/recover
+            # counters plus the live count of quarantined replicas.
+            "suspect": sum(1 for r in replicas if r["suspect"]),
+            "suspected_total": suspected,
+            "probes_total": probes,
+            "recoveries_total": recoveries,
             "requests_routed": routed,
             "requests_retried": retried,
             "requests_failed": failed,
@@ -895,6 +1034,19 @@ class Router:
               for r in replicas])
         emit("replica_draining", "gauge", "Replica draining (rolling restart).",
              [({"replica": r["url"]}, int(r["draining"])) for r in replicas])
+        emit("replica_suspect", "gauge",
+             "Replica quarantined after consecutive connect failures "
+             "(probed on exponential backoff).",
+             [({"replica": r["url"]}, int(r["suspect"])) for r in replicas])
+        emit("replicas_suspected_total", "counter",
+             "Replicas marked suspect over the router's lifetime.",
+             [({}, self.suspected_total)])
+        emit("suspect_probes_total", "counter",
+             "Backoff probes sent to suspect replicas.",
+             [({}, self.probes_total)])
+        emit("suspect_recoveries_total", "counter",
+             "Suspect replicas cleared by a successful probe.",
+             [({}, self.recoveries_total)])
         emit("replica_weight", "gauge", "Free-capacity routing weight.",
              [({"replica": r["url"]}, r["weight"]) for r in replicas])
         emit("replica_routed_total", "counter", "Requests routed per replica.",
@@ -960,6 +1112,20 @@ def make_router_http_server(
             if self.path == "/debug/dump":
                 dump = router.blackbox_dump("manual", force=True)
                 return self._reply(200, dump)
+            if self.path == "/admin/threshold":
+                # Runtime tier retuning (ISSUE 20): the fleet controller
+                # adjusts the two-tier split to the live prompt mix.
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                    new = router.set_prefill_threshold(
+                        body.get("prefill_threshold")
+                    )
+                except (ValueError, TypeError) as exc:
+                    return self._reply(400, {"error": str(exc)})
+                return self._reply(200, {"prefill_threshold": new})
             if self.path != "/generate":
                 return self._reply(404, {"error": "unknown path"})
             trace_id = (self.headers.get("X-Request-Id") or "").strip()
@@ -1004,7 +1170,13 @@ def main(argv: list[str] | None = None) -> int:
                         "(/kv/export) and decode on the least-loaded "
                         "decode replica (/kv/import); shorter prompts "
                         "bypass straight to decode nodes (default: "
-                        "single-tier routing)")
+                        "single-tier routing); retunable at runtime via "
+                        "POST /admin/threshold")
+    parser.add_argument("--suspect-after", type=int, default=3,
+                        metavar="N",
+                        help="consecutive connect failures before a "
+                        "replica is quarantined as suspect and probed on "
+                        "exponential backoff instead of every poll")
     parser.add_argument("--metrics-jsonl", default=None,
                         help="write the router's trace stream (pick/hop/"
                         "request spans per proxied request, manifest + "
@@ -1029,6 +1201,7 @@ def main(argv: list[str] | None = None) -> int:
         request_timeout_s=args.request_timeout,
         connect_timeout_s=args.connect_timeout,
         prefill_threshold=args.prefill_threshold,
+        suspect_after=args.suspect_after,
         telemetry=telemetry,
     )
     server = make_router_http_server(router, host=args.host, port=args.port)

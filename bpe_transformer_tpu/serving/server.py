@@ -35,7 +35,11 @@ from typing import Iterator
 
 import numpy as np
 
+from bpe_transformer_tpu.resilience.faults import FaultInjector
 from bpe_transformer_tpu.serving.engine import SlotPoolEngine, TickEvent
+from bpe_transformer_tpu.serving.kvpool.migrate import (
+    supported_codecs as _supported_codecs,
+)
 from bpe_transformer_tpu.serving.metrics import ServingMetrics, render_prometheus
 from bpe_transformer_tpu.serving.scheduler import (
     FifoScheduler,
@@ -100,6 +104,12 @@ class Request:
     #: ``"migrated"``).  The ``/kv/export`` endpoint sets this; needs a
     #: paged engine.
     migrate: bool = False
+    #: ``migrate`` only: comma list of wire codecs the IMPORTER accepts
+    #: (the ``X-KV-Accept`` header on ``/kv/export``) — the export picks
+    #: the best locally available one (``migrate.negotiate_codec``).
+    #: None = no negotiation happened -> raw, so a pre-negotiation peer
+    #: is never handed a frame it cannot open.
+    kv_accept: str | None = None
     request_id: str = dataclasses.field(
         default_factory=lambda: uuid.uuid4().hex
     )
@@ -333,6 +343,37 @@ class ServingEngine:
         #: ``drain(evacuate_to=...)`` runs (round-robin).
         self._evacuate_peers: list = []
         self._evacuate_rr = 0
+        #: Over-the-wire drain-evacuation targets (ISSUE 20): peer base
+        #: URLs — queued requests replay as seeded ``/generate`` calls,
+        #: in-flight slots export + relay to a peer's ``/kv/import``; the
+        #: relay thread completes the original caller's handle with the
+        #: peer's tokens (token-identical: same KV, same RNG state).
+        self._evacuate_urls: list[str] = []
+        #: Controller-initiated hot rebalancing (``POST /admin/evacuate``):
+        #: pending ``(target_url, max_sessions, done_event, out_dict)``
+        #: requests the worker consumes at the top of each step.
+        self._rebalance_queue: collections.deque = collections.deque()
+        self._relays_ok = 0
+        self._relays_failed = 0
+        self._rebalanced_out = 0
+        #: Bounded retry policy for one payload relay (per-attempt HTTP
+        #: timeout, exponential backoff between attempts).
+        self.relay_attempts = 4
+        self.relay_timeout_s = 600.0
+        self.relay_backoff_s = 0.2
+        #: Wire codec for migration payload exports (v2 frames): a comma
+        #: list negotiated against the importer (``negotiate_codec``).
+        #: zlib is stdlib, so every same-version peer decodes it.
+        self.export_codec = "zstd,zlib"
+        #: Fleet chaos harness (ISSUE 20): per-replica BT_FAULTS plan —
+        #: no-op (cheap comparisons) unless the env var is set.
+        self.faults = FaultInjector.from_env()
+        self._decode_ticks = 0
+        #: Replay-once idempotency on imports: key -> _Entry, so a retried
+        #: ``/kv/import`` (response lost, connection dropped mid-reply)
+        #: attaches to the original graft instead of double-grafting.
+        self._idem_keys: collections.OrderedDict = collections.OrderedDict()
+        self._idem_lock = threading.Lock()
         self.scheduler = FifoScheduler(
             max_queue=max_queue, max_wait_s=max_wait_s, clock=clock
         )
@@ -388,7 +429,9 @@ class ServingEngine:
         self._thread.start()
         return self
 
-    def drain(self, timeout_s: float = 30.0, evacuate_to=None) -> bool:
+    def drain(
+        self, timeout_s: float = 30.0, evacuate_to=None, evacuate_urls=None,
+    ) -> bool:
         """Graceful shutdown, phase 1: stop ADMITTING (new submits raise
         ``RuntimeError`` -> HTTP 503) but keep the worker running until
         every queued and in-flight request finishes — the SIGTERM path of
@@ -403,23 +446,33 @@ class ServingEngine:
         continues the generation bit-for-bit and completes the original
         caller's handle — so draining a loaded replica finishes in
         payload-transfer time instead of longest-generation time, with
-        zero failed requests and zero token divergence."""
+        zero failed requests and zero token divergence.
+
+        ``evacuate_urls`` (ISSUE 20) is the cross-process form: peer base
+        URLs.  Queued (never-admitted) requests replay on a peer as
+        seeded ``/generate`` calls; in-flight sessions export and relay
+        to a peer's ``/kv/import`` with an idempotency key + bounded
+        retries — the relay thread completes the original caller's
+        handle with the peer's returned tokens, so the caller's open
+        connection never notices the replica it was talking to left."""
         if evacuate_to:
             peers = [p for p in evacuate_to if p.accepting_imports()]
             self._evacuate_peers = peers
+        if evacuate_urls:
+            self._evacuate_urls = [u.rstrip("/") for u in evacuate_urls]
         self._draining = True
         self.flightrecorder.record(
             "drain",
             queue_depth=self.scheduler.depth,
             active_slots=self.engine.active_count,
-            evacuating=bool(self._evacuate_peers),
+            evacuating=bool(self._evacuate_peers or self._evacuate_urls),
         )
         if self._telemetry is not None:
             self._telemetry.event(
                 "serve_drain",
                 queue_depth=self.scheduler.depth,
                 active_slots=self.engine.active_count,
-                evacuating=bool(self._evacuate_peers),
+                evacuating=bool(self._evacuate_peers or self._evacuate_urls),
             )
         deadline = self._clock() + timeout_s
         while True:
@@ -585,6 +638,7 @@ class ServingEngine:
         session: str | None = None,
         request_id: str | None = None,
         migrate: bool = False,
+        kv_accept: str | None = None,
         timeout: float | None = None,
     ) -> Result:
         """Blocking one-call generation.  ``request_id`` adopts a
@@ -609,6 +663,7 @@ class ServingEngine:
                 deadline_s=deadline_s,
                 session=session,
                 migrate=migrate,
+                kv_accept=kv_accept,
                 **kwargs,
             )
         )
@@ -627,12 +682,24 @@ class ServingEngine:
             and self._worker_error is None
         )
 
-    def submit_import(self, payload_bytes: bytes) -> RequestHandle:
+    def submit_import(
+        self,
+        payload_bytes: bytes,
+        *,
+        idempotency_key: str | None = None,
+    ) -> RequestHandle:
         """Accept a serialized KV migration payload (the ``/kv/import``
         body): validate it against this engine's geometry, register the
         request, and queue the graft for the worker.  The handle resolves
         with the COMPLETE generation — tokens emitted before the
         migration (carried in the payload) plus everything decoded here.
+
+        ``idempotency_key`` (ISSUE 20, the ``X-Idempotency-Key`` header)
+        makes the graft exactly-once under retries: a repeated key —
+        whether the original graft is queued, decoding, or already
+        finished — attaches to the original entry and resolves with ITS
+        result instead of grafting a second copy.  The sender keeps one
+        key per exported payload across every retry of that transfer.
 
         Raises ``ValueError`` (bad payload / geometry mismatch -> 400),
         ``QueueFullError`` (backpressure -> 503),
@@ -656,6 +723,11 @@ class ServingEngine:
             raise RuntimeError(
                 "prefill-role replica does not accept KV imports"
             )
+        if idempotency_key:
+            with self._idem_lock:
+                known = self._idem_keys.get(idempotency_key)
+            if known is not None:
+                return RequestHandle(self, known)
         payload = payload_from_bytes(payload_bytes)
         meta = payload["meta"]
         # Full structural validation at the TRANSPORT: a corrupt payload
@@ -673,29 +745,52 @@ class ServingEngine:
         )
         entry = _Entry(request, self._clock())
         self._entry_from_meta(entry, meta)
-        with self._entries_lock:
-            if request.request_id in self._entries:
-                raise DuplicateRequestError(
-                    f"request id {request.request_id!r} is already in "
-                    "flight on this replica"
-                )
-            self._entries[request.request_id] = entry
+        if idempotency_key:
+            # Claim-or-attach under one lock: a concurrent duplicate that
+            # raced past the cheap pre-parse check attaches to whichever
+            # entry claimed first — the graft below runs exactly once per
+            # key.  The claim survives the entry finishing (bounded LRU),
+            # so a retry whose original already completed gets the cached
+            # result instead of a second graft.
+            with self._idem_lock:
+                known = self._idem_keys.get(idempotency_key)
+                if known is not None:
+                    return RequestHandle(self, known)
+                self._idem_keys[idempotency_key] = entry
+                while len(self._idem_keys) > 4096:
+                    self._idem_keys.popitem(last=False)
         try:
-            # Capacity check + append under ONE lock hold: each queued
-            # item carries a whole decoded KV payload, so a racy check
-            # would let concurrent imports blow the memory bound the
-            # backpressure exists to enforce.
-            with self._import_lock:
-                if len(self._import_queue) >= self.scheduler.max_queue:
-                    raise QueueFullError(
-                        f"import queue full ({self.scheduler.max_queue})"
-                    )
-                self._import_queue.append(
-                    (entry, payload, len(payload_bytes), time.time())
-                )
-        except BaseException:
             with self._entries_lock:
-                self._entries.pop(request.request_id, None)
+                if request.request_id in self._entries:
+                    raise DuplicateRequestError(
+                        f"request id {request.request_id!r} is already in "
+                        "flight on this replica"
+                    )
+                self._entries[request.request_id] = entry
+            try:
+                # Capacity check + append under ONE lock hold: each queued
+                # item carries a whole decoded KV payload, so a racy check
+                # would let concurrent imports blow the memory bound the
+                # backpressure exists to enforce.
+                with self._import_lock:
+                    if len(self._import_queue) >= self.scheduler.max_queue:
+                        raise QueueFullError(
+                            f"import queue full ({self.scheduler.max_queue})"
+                        )
+                    self._import_queue.append(
+                        (entry, payload, len(payload_bytes), time.time())
+                    )
+            except BaseException:
+                with self._entries_lock:
+                    self._entries.pop(request.request_id, None)
+                raise
+        except BaseException:
+            if idempotency_key:
+                # A failed graft must not poison the key: the sender's
+                # retry (same key) deserves a fresh attempt.
+                with self._idem_lock:
+                    if self._idem_keys.get(idempotency_key) is entry:
+                        del self._idem_keys[idempotency_key]
             raise
         self.metrics.on_submit()
         self.scheduler.notify()
@@ -900,6 +995,14 @@ class ServingEngine:
             "migrations_out": self.metrics.migrations_out,
             "migrations_in": self.metrics.migrations_in,
             "import_backlog": import_backlog,
+            # Wire codecs this replica can DECODE (v2 payloads), best
+            # first — what a migration sender negotiates against.
+            "kv_accept": ",".join(_supported_codecs()),
+            # Over-the-wire session moves (ISSUE 20): relayed out OK /
+            # failed after retries, and controller-initiated rebalances.
+            "relays_ok": self._relays_ok,
+            "relays_failed": self._relays_failed,
+            "rebalanced_out": self._rebalanced_out,
             # The fleet router reads these to route around a replica that
             # is shutting down (PR-5 drain) or whose worker died, and to
             # weight by free capacity.  Load is reported as OCCUPANCY, not
@@ -1074,8 +1177,13 @@ class ServingEngine:
         # Drain evacuation (ISSUE 15): once draining with peers attached,
         # every queued and in-flight session leaves as a KV payload (or a
         # whole queue entry) before anything else runs this iteration.
-        if self._draining and self._evacuate_peers:
+        if self._draining and (self._evacuate_peers or self._evacuate_urls):
             worked |= self._evacuate_step()
+
+        # Controller-initiated hot rebalancing (ISSUE 20): export victim
+        # sessions and relay them to the requested peer without draining.
+        if self._rebalance_queue:
+            worked |= self._rebalance_step()
 
         # In-flight cancellations retire their slots before the next tick
         # — decoding slots, slots mid-chunked-prefill, and block-starved
@@ -1156,6 +1264,11 @@ class ServingEngine:
         worked |= self._advance_prefills()
 
         if self.engine.active_count:
+            # Chaos hook: SIGKILL-mid-decode fires here, between slots
+            # holding live KV and the tick that would advance them — the
+            # worst instant a replica can die.
+            self._decode_ticks += 1
+            self.faults.at_decode_tick(self._decode_ticks)
             t0 = self._clock()
             events = self.engine.tick()
             tick_s = self._clock() - t0
@@ -1366,7 +1479,9 @@ class ServingEngine:
             )
             worked = True
 
-    def _export_entry(self, entry: _Entry, slot: int) -> tuple[bytes, int]:
+    def _export_entry(
+        self, entry: _Entry, slot: int, codec: str = "raw"
+    ) -> tuple[bytes, int]:
         """Export ``slot`` (holding ``entry``'s generation) as payload
         bytes, with the serving-layer continuation state — emitted tokens,
         token history (the speculative importer's draft re-prefill input),
@@ -1407,14 +1522,25 @@ class ServingEngine:
         # migration record carries the full export/transfer/import split
         # (serialization + HTTP land in transfer_s via exported_unix).
         payload["meta"]["export_s"] = round(self._clock() - t0, 6)
-        return payload_to_bytes(payload), int(payload["meta"]["n_blocks"])
+        # Chaos hook: truncate/bit-flip the bytes in flight (fires once) —
+        # the importer's CRC/length checks must 400 the graft.
+        data = self.faults.on_export_payload(
+            payload_to_bytes(payload, codec=codec)
+        )
+        return data, int(payload["meta"]["n_blocks"])
 
     def _complete_migration_export(self, entry: _Entry, slot: int) -> None:
         """Prefill-role handoff: the finished prefix (first token already
         sampled and delivered) leaves as a KV payload; the request
         finishes here as ``"migrated"`` with the payload on its result."""
+        from bpe_transformer_tpu.serving.kvpool.migrate import (
+            negotiate_codec,
+        )
+
         t0 = self._clock()
-        data, blocks = self._export_entry(entry, slot)
+        data, blocks = self._export_entry(
+            entry, slot, codec=negotiate_codec(entry.request.kv_accept)
+        )
         export_s = self._clock() - t0
         self.metrics.on_migration("out", len(data))
         self._span("migration_export", t0, export_s, entry.request)
@@ -1432,11 +1558,27 @@ class ServingEngine:
         (round-robin): queued entries re-enter the peer's scheduler whole;
         in-flight slots (decoding AND mid-prefill) export as KV payloads
         the peer grafts and continues bit-for-bit.  The original callers'
-        handles complete from the peer — zero failed requests."""
+        handles complete from the peer — zero failed requests.
+
+        Peers are either in-process ``ServingEngine`` objects (entries
+        move whole, payload dicts skip the bytes codec) or — when only
+        ``_evacuate_urls`` is set — remote replicas: queued requests
+        replay as seeded ``/generate`` calls and exported sessions relay
+        to ``/kv/import`` from background threads (the worker must not
+        block on a peer's decode), each under one idempotency key across
+        its bounded retries."""
+        from bpe_transformer_tpu.serving.kvpool.migrate import (
+            negotiate_codec,
+            payload_to_bytes,
+        )
+
         peers = [p for p in self._evacuate_peers if p.accepting_imports()]
-        if not peers:
+        urls = list(self._evacuate_urls)
+        if not peers and not urls:
             self._evacuate_peers = []
             return False
+        wire = not peers
+        wire_codec = negotiate_codec(self.export_codec)
 
         def next_peer():
             self._evacuate_rr += 1
@@ -1458,6 +1600,14 @@ class ServingEngine:
         for qe in pop.admit:
             moved_entries.append(qe.item)
         for entry in moved_entries:
+            if wire:
+                # Nothing emitted yet: a seeded /generate replay on the
+                # peer is token-identical.  The entry stays registered
+                # until the relay thread finishes it (drain waits on the
+                # registry).
+                self._relay_entry_thread(entry, None, urls, "evacuate")
+                worked = True
+                continue
             with self._entries_lock:
                 self._entries.pop(entry.request.request_id, None)
             try:
@@ -1467,6 +1617,11 @@ class ServingEngine:
                 self._finish(entry, "error")
             worked = True
         for entry, payload, nbytes, _recv in moved_imports:
+            if wire:
+                data = payload_to_bytes(payload, codec=wire_codec)
+                self._relay_entry_thread(entry, data, urls, "evacuate")
+                worked = True
+                continue
             with self._entries_lock:
                 self._entries.pop(entry.request.request_id, None)
             try:
@@ -1480,7 +1635,8 @@ class ServingEngine:
 
         # In-flight sessions: export + graft.  The entry object itself
         # moves — its stream/done handles keep serving the original
-        # caller from the peer's worker.
+        # caller from the peer's worker (in-process) or complete with the
+        # peer's returned tokens (over the wire).
         in_flight = list(self._prefill_entries.items()) + list(
             self._slot_entries.items()
         )
@@ -1488,10 +1644,10 @@ class ServingEngine:
             self._prefill_entries.pop(slot, None)
             self._slot_entries.pop(slot, None)
             t0 = self._clock()
-            data, blocks = self._export_entry(entry, slot)
+            data, blocks = self._export_entry(
+                entry, slot, codec=wire_codec if wire else "raw"
+            )
             export_s = self._clock() - t0
-            with self._entries_lock:
-                self._entries.pop(entry.request.request_id, None)
             entry.slot = None
             self.metrics.on_migration("out", len(data))
             self._span("migration_export", t0, export_s, entry.request)
@@ -1502,6 +1658,12 @@ class ServingEngine:
                 blocks=blocks,
                 export_s=round(export_s, 6),
             )
+            if wire:
+                self._relay_entry_thread(entry, data, urls, "evacuate")
+                worked = True
+                continue
+            with self._entries_lock:
+                self._entries.pop(entry.request.request_id, None)
             try:
                 next_peer().adopt_migration(entry, data)
             except (RuntimeError, ValueError) as exc:
@@ -1513,8 +1675,197 @@ class ServingEngine:
                 "serve_evacuate",
                 sessions=len(in_flight),
                 queued=len(moved_entries) + len(moved_imports),
-                peers=len(peers),
+                peers=len(peers) or len(urls),
+                wire=wire,
             )
+        return worked
+
+    # ------------------------------------- over-the-wire relay (ISSUE 20)
+
+    def _relay_entry_thread(self, entry, data, urls, direction) -> None:
+        threading.Thread(
+            target=self._relay_entry,
+            args=(entry, data, urls, direction),
+            name="kv-relay",
+            daemon=True,
+        ).start()
+
+    def _relay_entry(self, entry, data, urls, direction) -> None:
+        """Move one session to a peer over HTTP and complete the original
+        caller's handle with the peer's result.  ``data=None`` replays a
+        never-admitted request as a seeded ``/generate`` (token-identical:
+        nothing was emitted yet); otherwise ``data`` is an exported KV
+        payload POSTed to ``/kv/import`` under ONE idempotency key held
+        across every retry — the receiver grafts exactly once even when a
+        response is lost mid-reply.  Connect/read failures rotate to the
+        next peer URL with exponential backoff; a 400 is permanent (the
+        payload itself is bad — retrying the same bytes cannot help)."""
+        import urllib.error
+        import urllib.request
+
+        idem_key = uuid.uuid4().hex
+        rid = entry.request.request_id
+        t0 = self._clock()
+        result = None
+        last_exc: Exception | None = None
+        for attempt in range(self.relay_attempts):
+            url = urls[attempt % len(urls)]
+            try:
+                if data is None:
+                    req = entry.request
+                    body = json.dumps(
+                        {
+                            "prompt_ids": list(req.prompt_ids),
+                            "max_new_tokens": req.max_new_tokens,
+                            "temperature": req.temperature,
+                            "top_k": req.top_k,
+                            "top_p": req.top_p,
+                            "seed": req.seed,
+                            "stop_id": req.stop_id,
+                            "deadline_s": req.deadline_s,
+                            "session": req.session,
+                        }
+                    ).encode("utf-8")
+                    http_req = urllib.request.Request(
+                        url + "/generate",
+                        data=body,
+                        headers={
+                            "Content-Type": "application/json",
+                            "X-Request-Id": rid,
+                        },
+                    )
+                else:
+                    http_req = urllib.request.Request(
+                        url + "/kv/import",
+                        data=data,
+                        headers={
+                            "Content-Type": "application/octet-stream",
+                            "X-Request-Id": rid,
+                            "X-Idempotency-Key": idem_key,
+                        },
+                    )
+                with urllib.request.urlopen(
+                    http_req, timeout=self.relay_timeout_s
+                ) as resp:
+                    result = json.loads(resp.read())
+                break
+            except urllib.error.HTTPError as exc:
+                last_exc = exc
+                if exc.code == 400:
+                    break
+            except (OSError, ValueError) as exc:
+                last_exc = exc
+            if attempt + 1 < self.relay_attempts:
+                time.sleep(self.relay_backoff_s * (2 ** attempt))
+        transfer_s = self._clock() - t0
+        if result is None:
+            self._relays_failed += 1
+            self.metrics.record_error(
+                f"relay failed: {last_exc!r}",
+                source="relay",
+                request_id=rid,
+            )
+            self.flightrecorder.record(
+                "relay_failed",
+                request_id=rid,
+                direction=direction,
+                error=repr(last_exc),
+            )
+            self._finish(entry, "error")
+            return
+        # Peer token_ids = tokens emitted before the move + everything it
+        # decoded; stream only the suffix so the caller sees no repeats.
+        all_tokens = [int(t) for t in result.get("token_ids", [])]
+        for tok in all_tokens[len(entry.tokens):]:
+            entry.tokens.append(tok)
+            entry.stream.put(tok)
+        self._relays_ok += 1
+        self._emit_migration(
+            direction=f"{direction}_relay",
+            request_id=rid,
+            bytes=len(data) if data is not None else 0,
+            transfer_s=round(transfer_s, 6),
+            total_s=round(transfer_s, 6),
+        )
+        self._finish(entry, result.get("finish_reason") or "stop")
+
+    def request_rebalance(
+        self,
+        target_url: str,
+        max_sessions: int = 1,
+        timeout_s: float = 30.0,
+    ) -> dict:
+        """Transport side of ``POST /admin/evacuate`` (controller hot
+        rebalancing): ask the worker to export up to ``max_sessions``
+        decoding sessions and relay them to ``target_url``'s
+        ``/kv/import``.  Blocks until the exports happen (the relays
+        complete asynchronously; each original caller's handle resolves
+        with the peer's tokens).  Returns ``{"moved", "request_ids",
+        "target"}``."""
+        if not self.paged:
+            raise RuntimeError("rebalancing needs a paged engine")
+        if self._worker_error is not None:
+            raise RuntimeError(
+                "serving engine worker died"
+            ) from self._worker_error
+        if not self._running:
+            raise RuntimeError("serving engine is not running")
+        done = threading.Event()
+        out: dict = {}
+        self._rebalance_queue.append(
+            (target_url.rstrip("/"), max(1, int(max_sessions)), done, out)
+        )
+        self.scheduler.notify()
+        if not done.wait(timeout_s):
+            raise TimeoutError("rebalance request not picked up by worker")
+        return out
+
+    def _rebalance_step(self) -> bool:
+        """Worker side: export the requested victim sessions and hand them
+        to relay threads.  Victims are the decoding slots with the most
+        budget remaining — the sessions that gain the most from moving to
+        a less loaded replica (and whose KV is cheapest per remaining
+        token to have shipped)."""
+        from bpe_transformer_tpu.serving.kvpool.migrate import (
+            negotiate_codec,
+        )
+
+        worked = False
+        codec = negotiate_codec(self.export_codec)
+        while self._rebalance_queue:
+            target, n, done, out = self._rebalance_queue.popleft()
+            victims = sorted(
+                self._slot_entries.items(),
+                key=lambda kv: (
+                    kv[1].request.max_new_tokens - len(kv[1].tokens)
+                ),
+                reverse=True,
+            )[:n]
+            moved = []
+            for slot, entry in victims:
+                self._slot_entries.pop(slot, None)
+                t0 = self._clock()
+                data, blocks = self._export_entry(entry, slot, codec=codec)
+                export_s = self._clock() - t0
+                entry.slot = None
+                self.metrics.on_migration("out", len(data))
+                self._span("migration_export", t0, export_s, entry.request)
+                self._emit_migration(
+                    direction="rebalance",
+                    request_id=entry.request.request_id,
+                    bytes=len(data),
+                    blocks=blocks,
+                    export_s=round(export_s, 6),
+                )
+                self._relay_entry_thread(entry, data, [target], "rebalance")
+                moved.append(entry.request.request_id)
+                self._rebalanced_out += 1
+                worked = True
+            out.update(moved=len(moved), request_ids=moved, target=target)
+            self.flightrecorder.record(
+                "rebalance", target=target, moved=len(moved)
+            )
+            done.set()
         return worked
 
     def _emit_migration(self, **fields) -> None:
@@ -1925,6 +2276,11 @@ def make_http_server(
       token-identical to an unmigrated run).  400 on a geometry/dtype
       mismatch, 503 on backpressure.
 
+    * ``POST /admin/evacuate`` (ISSUE 20) — controller-initiated hot
+      rebalancing: body ``{"target": url, "max_sessions"?}`` exports
+      victim sessions and relays them to the target's ``/kv/import``
+      (idempotency-keyed, bounded retries); the original callers' open
+      requests complete with the target's tokens.
     * ``GET /debug/flightrecorder`` — the live decision ring + retained
       black-box dumps (``bpe-tpu incident`` sweeps this across the fleet).
     * ``POST /debug/dump`` — force a black-box flush now; answers with
@@ -1933,6 +2289,7 @@ def make_http_server(
     ``port=0`` binds an ephemeral port (tests); the caller owns
     ``serve_forever()`` / ``shutdown()``.
     """
+    import socket
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -1940,6 +2297,20 @@ def make_http_server(
         # observable surface, not stderr.
         def log_message(self, *args):  # noqa: D102
             pass
+
+        def _fault_gate(self) -> bool:
+            """Chaos hook (BT_FAULTS): a blackholed path drops the
+            connection with no response — what a partitioned peer looks
+            like from the caller's side.  Delays sleep inline inside
+            ``on_http_request``."""
+            if serving.faults.on_http_request(self.path) == "blackhole":
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self.close_connection = True
+                return True
+            return False
 
         def _reply(
             self, code: int, payload: dict, request_id: str | None = None
@@ -1970,6 +2341,8 @@ def make_http_server(
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 (stdlib API)
+            if self._fault_gate():
+                return
             path = self.path.split("?", 1)[0]
             if path == "/healthz":
                 return self._reply(200, {"ok": True, **serving.stats()})
@@ -1997,8 +2370,12 @@ def make_http_server(
             self.wfile.write(data)
 
         def do_POST(self):  # noqa: N802 (stdlib API)
+            if self._fault_gate():
+                return
             if self.path == "/kv/import":
                 return self._kv_import()
+            if self.path == "/admin/evacuate":
+                return self._admin_evacuate()
             if self.path == "/debug/dump":
                 # Operator-initiated black-box flush: always dumps (force
                 # past the cooldown) and answers with the dump itself.
@@ -2041,6 +2418,12 @@ def make_http_server(
                     session=body.get("session"),
                     request_id=trace_id,
                     migrate=migrate,
+                    # Codec negotiation (ISSUE 20): the importer-to-be
+                    # says what v2 frames it can open; the export picks
+                    # the best one both sides share (absent: raw).
+                    kv_accept=(
+                        self.headers.get("X-KV-Accept") if migrate else None
+                    ),
                 )
             except (QueueFullError, DuplicateRequestError) as exc:
                 # Both are "this replica can't take THIS request right
@@ -2088,7 +2471,10 @@ def make_http_server(
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 data = self.rfile.read(length)
-                handle = serving.submit_import(data)
+                idem = (
+                    self.headers.get("X-Idempotency-Key") or ""
+                ).strip()[:128] or None
+                handle = serving.submit_import(data, idempotency_key=idem)
                 result = handle.result()
             except (QueueFullError, DuplicateRequestError) as exc:
                 return self._reply(
@@ -2121,5 +2507,30 @@ def make_http_server(
                     ids = ids[:-1]
                 payload["completion"] = serving.tokenizer.decode(ids)
             self._reply(200, payload, request_id=result.request_id)
+
+        def _admin_evacuate(self):
+            """POST /admin/evacuate: controller-initiated hot rebalancing
+            — body ``{"target": base_url, "max_sessions"?, "timeout_s"?}``
+            exports victim sessions and relays them to the target's
+            ``/kv/import``.  Answers with the moved request ids once the
+            exports happen (relays complete asynchronously)."""
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                target = body.get("target")
+                if not target or not isinstance(target, str):
+                    raise ValueError("need 'target' (peer base URL)")
+                out = serving.request_rebalance(
+                    target,
+                    max_sessions=int(body.get("max_sessions", 1)),
+                    timeout_s=float(body.get("timeout_s", 30.0)),
+                )
+            except (ValueError, TypeError, json.JSONDecodeError) as exc:
+                return self._reply(400, {"error": str(exc)})
+            except (RuntimeError, TimeoutError) as exc:
+                return self._reply(503, {"error": str(exc)})
+            return self._reply(200, out)
 
     return ThreadingHTTPServer((host, port), Handler)

@@ -132,6 +132,32 @@ def fold_records(records: list[dict], state: dict | None = None) -> dict:
             finite = [v for v in burns.values() if isinstance(v, (int, float))]
             if finite:
                 state["slo_max_burn"] = max(finite)
+        elif kind == "control":
+            # Controller decisions (serving/controller.py, ISSUE 20):
+            # count actions by outcome, keep the breaker state and the
+            # last action on the panel.  A failed action or a tripped
+            # breaker is an anomaly — the self-healing loop faltered.
+            outcome = record.get("outcome")
+            state["control_actions"] = int(
+                state.get("control_actions") or 0) + 1
+            if outcome == "failed":
+                state["control_failed"] = int(
+                    state.get("control_failed") or 0) + 1
+                state["anomalies"] += 1
+                state["last_anomaly"] = (
+                    f"control {record.get('action')} failed"
+                )
+            state["control_breaker"] = record.get("breaker")
+            if record.get("breaker") == "tripped":
+                state["last_anomaly"] = "control breaker tripped"
+            state["control_last"] = (
+                f"{record.get('action')}/{outcome}"
+                + (
+                    f" ({str(record.get('reason')).split(':')[0]})"
+                    if record.get("action") == "hold" and record.get("reason")
+                    else ""
+                )
+            )
         elif kind == "alert":
             # Watchdog transitions (telemetry/alerts.py): track the
             # currently-firing set; every new firing is an anomaly.  The
@@ -489,6 +515,17 @@ def render_frame(state: dict, source: str) -> str:
         if state.get("slo_max_burn") is not None:
             parts.append(f"burn {_num(state['slo_max_burn'], 3)}")
         lines.append("  fleet  " + "  ".join(parts))
+
+    if state.get("control_actions"):
+        parts = [
+            f"{_num(state['control_actions'])} action(s)",
+            f"{_num(state.get('control_failed') or 0)} failed",
+        ]
+        if state.get("control_last"):
+            parts.append(f"last {state['control_last']}")
+        if state.get("control_breaker"):
+            parts.append(f"breaker {state['control_breaker']}")
+        lines.append("  ctrl   " + "  ".join(parts))
 
     if state.get("alerts_firing"):
         lines.append(

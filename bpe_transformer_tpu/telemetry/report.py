@@ -438,6 +438,69 @@ def summarize(records: list[dict]) -> dict:
                 "(slo records; see == slo ==)"
             )
 
+    # Control-plane decisions (kind="control", serving/controller.py,
+    # ISSUE 20): actions by kind/outcome, the crash-loop breaker's state,
+    # the staleness-hold census, and the rebalance action-duration tail
+    # the rebalance_p99_s compare row gates.  A tripped breaker or any
+    # failed action is an anomaly — the self-healing loop itself needed
+    # healing.
+    control_records = [r for r in records if r.get("kind") == "control"]
+    control_summary = None
+    if control_records:
+        by_action: dict[str, int] = {}
+        by_outcome: dict[str, int] = {}
+        hold_reasons: dict[str, int] = {}
+        rebalance_durs: list[float] = []
+        for r in control_records:
+            action = str(r.get("action"))
+            outcome = str(r.get("outcome"))
+            by_action[action] = by_action.get(action, 0) + 1
+            key = f"{action}/{outcome}"
+            by_outcome[key] = by_outcome.get(key, 0) + 1
+            if action == "hold":
+                reason = str(r.get("reason") or "?").split(":")[0]
+                hold_reasons[reason] = hold_reasons.get(reason, 0) + 1
+            if (
+                action == "rebalance"
+                and outcome == "ok"
+                and isinstance(r.get("dur_s"), (int, float))
+            ):
+                rebalance_durs.append(float(r["dur_s"]))
+        actions_failed = sum(
+            1 for r in control_records if r.get("outcome") == "failed"
+        )
+        breaker_tripped = any(
+            r.get("breaker") == "tripped" for r in control_records
+        )
+        control_summary = {
+            "n": len(control_records),
+            "by_action": by_action,
+            "by_outcome": by_outcome,
+            "actions_ok": sum(
+                1 for r in control_records if r.get("outcome") == "ok"
+            ),
+            "actions_failed": actions_failed,
+            "observe_only": sum(
+                1 for r in control_records
+                if r.get("outcome") == "observe_only"
+            ),
+            "holds": by_action.get("hold", 0),
+            "hold_reasons": hold_reasons,
+            "breaker_last": control_records[-1].get("breaker"),
+            "breaker_tripped": breaker_tripped,
+            "rebalance_p50_s": _pctl(rebalance_durs, 0.50),
+            "rebalance_p99_s": _pctl(rebalance_durs, 0.99),
+        }
+        if breaker_tripped:
+            anomalies.append(
+                "control breaker tripped (consecutive action failures) — "
+                "the controller halted itself; see == control =="
+            )
+        if actions_failed:
+            anomalies.append(
+                f"{actions_failed} control action(s) failed after retries"
+            )
+
     # Watchdog transitions (kind="alert", telemetry/alerts.py): every
     # firing is an anomaly; the summary keeps the fire/clear timeline and
     # whatever was still firing when the stream ended.
@@ -824,6 +887,7 @@ def summarize(records: list[dict]) -> dict:
         "spec": spec_summary,
         "fleet": fleet_summary,
         "slo": slo_summary,
+        "control": control_summary,
         "alerts": alerts_summary,
         "incident": incident_summary,
         "roofline": roofline_summary,
@@ -1160,6 +1224,43 @@ def render_report(records: list[dict]) -> str:
     sl = s.get("slo")
     if sl:
         lines.extend(_slo_section_lines(sl))
+
+    ctl = s.get("control")
+    if ctl:
+        lines.append(
+            f"== control ({ctl['n']} decision(s), "
+            f"breaker {ctl['breaker_last']}) =="
+        )
+        lines.append(
+            "  actions             "
+            + "  ".join(
+                f"{k}:{n}" for k, n in sorted(ctl["by_outcome"].items())
+            )
+        )
+        lines.append(
+            f"  ok/failed/observe   {ctl['actions_ok']}"
+            f"/{ctl['actions_failed']}/{ctl['observe_only']}"
+        )
+        if ctl["holds"]:
+            lines.append(
+                f"  holds               {ctl['holds']} ("
+                + "  ".join(
+                    f"{k}:{n}"
+                    for k, n in sorted(ctl["hold_reasons"].items())
+                )
+                + ")"
+            )
+        if ctl.get("rebalance_p99_s") is not None:
+            lines.append(
+                "  rebalance dur (s)   "
+                f"p50={_fmt(ctl['rebalance_p50_s'])} "
+                f"p99={_fmt(ctl['rebalance_p99_s'])}"
+            )
+        if ctl["breaker_tripped"]:
+            lines.append(
+                "  BREAKER TRIPPED     controller halted after repeated"
+                " action failures; restart required"
+            )
 
     al = s.get("alerts")
     if al:
@@ -1575,6 +1676,15 @@ COMPARE_METRICS: dict = {
     "fleet_kv_headroom_min": (
         lambda s: ((s.get("fleet") or {}).get("kv_headroom_frac", {})
                    or {}).get("min"), "higher"),
+    # Control-plane health (kind="control", ISSUE 20): a controller whose
+    # actions start failing after retries — or whose rebalance latency
+    # tail stretches — is a self-healing loop that stopped healing; both
+    # rows gate the closed loop the same way slo_max_burn_rate gates the
+    # data plane.
+    "control_actions_failed": (
+        lambda s: (s.get("control") or {}).get("actions_failed"), "lower"),
+    "rebalance_p99_s": (
+        lambda s: (s.get("control") or {}).get("rebalance_p99_s"), "lower"),
     # Per-chip state bytes (optimizer sharding's memory win): a run whose
     # opt_state_bytes shrinks 1/N against the unsharded baseline shows up
     # as an "improved" row; growing back is a gated regression.

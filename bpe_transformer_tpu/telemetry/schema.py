@@ -175,9 +175,25 @@ RECORD_SCHEMAS: dict[str, set[str]] = {
     # (with ``projected_dry_s``), accept_rate_collapse, compile_storm,
     # replica_flap.  ``severity`` is ``page`` | ``warn``.
     "alert": {"kind", "t", "rule", "state"},
+    # Fleet control-plane decision (serving/controller.py, `bpe-tpu
+    # control`, ISSUE 20): one record per controller action or hold.
+    # ``action`` is ``rebalance`` (victim sessions moved via
+    # /kv/export -> /kv/import), ``retune`` (router --prefill-threshold
+    # adjusted to the live prompt mix), ``scale_up``/``scale_down``
+    # (replica spawned/retired through the supervisor machinery), or
+    # ``hold`` (the loop degraded to observe-only).  ``outcome`` is
+    # ``ok`` | ``failed`` (after bounded retries) | ``observe_only``
+    # (decided but not executed: --observe-only, or the named hold
+    # reason) | ``held``.  ``breaker`` is the action-budget crash-loop
+    # breaker state (``closed`` | ``tripped`` — a tripped controller
+    # stops acting until restarted).  ``reason`` says why the decision
+    # fired or why the loop is holding (``stale_evidence``,
+    # ``partial_sweep``, ``fleet_unreachable``, ``breaker_tripped``);
+    # ``target``/``params``/``attempts``/``dur_s`` ride along per action.
+    "control": {"kind", "t", "action", "outcome", "breaker"},
     # Flight-recorder black-box dump (telemetry/flightrecorder.py): the
     # always-on decision ring of one ``component`` ("serve" | "route" |
-    # "train"), flushed on a ``trigger`` — ``alert:<rule>``, ``watchdog_hang``,
+    # "train" | "control"), flushed on a ``trigger`` — ``alert:<rule>``, ``watchdog_hang``,
     # ``nonfinite``, ``preemption``, ``manual`` (POST /debug/dump), or
     # ``sweep`` (the incident tool snapshotting a live ring).  ``events`` is
     # the ring contents oldest-first (each entry: ``event`` name, run-relative

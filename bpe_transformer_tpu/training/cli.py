@@ -403,6 +403,11 @@ def cmd_serve(args) -> int:
         print(f"serve: --role {args.role} needs --paged (KV migration "
               "payloads are block chains)", file=sys.stderr)
         return 2
+    if args.evacuate_to and not args.paged:
+        print("serve: --evacuate-to needs --paged (drain evacuation "
+              "exports in-flight sessions as KV block chains)",
+              file=sys.stderr)
+        return 2
     if args.role == "prefill" and args.prompts_file:
         print("serve: --role prefill cannot run offline batch mode (it "
               "never decodes; prefixes stream out over /kv/export)",
@@ -549,9 +554,14 @@ def cmd_serve(args) -> int:
                 pass
             finally:
                 server.shutdown()
-                drained = serving.drain(timeout_s=args.drain_timeout)
+                drained = serving.drain(
+                    timeout_s=args.drain_timeout,
+                    evacuate_urls=args.evacuate_to,
+                )
                 print(
-                    "drained cleanly"
+                    ("drained cleanly"
+                     + (" (sessions evacuated over the wire)"
+                        if args.evacuate_to else ""))
                     if drained
                     else f"drain timed out after {args.drain_timeout}s; "
                     "cancelling stragglers",
@@ -581,9 +591,43 @@ def cmd_route(args) -> int:
     ]
     if args.prefill_threshold is not None:
         forwarded += ["--prefill-threshold", str(args.prefill_threshold)]
+    forwarded += ["--suspect-after", str(args.suspect_after)]
     if args.metrics_jsonl:
         forwarded += ["--metrics-jsonl", args.metrics_jsonl]
     return route_main(forwarded)
+
+
+def cmd_control(args) -> int:
+    # Jax-free self-healing control loop (serving/controller.py): polls
+    # the fleet aggregator + router and acts — hot KV rebalancing, tier
+    # retuning, elastic capacity — behind a crash-loop breaker.
+    from bpe_transformer_tpu.serving.controller import main as control_main
+
+    forwarded = ["--fleet", args.fleet]
+    if args.router:
+        forwarded += ["--router", args.router]
+    forwarded += [
+        "--host", args.host,
+        "--port", str(args.port),
+        "--interval", str(args.interval),
+        "--evidence-max-age", str(args.evidence_max_age),
+        "--cooldown", str(args.cooldown),
+        "--action-timeout", str(args.action_timeout),
+        "--action-retries", str(args.action_retries),
+        "--max-failures", str(args.max_failures),
+        "--rebalance-gap", str(args.rebalance_gap),
+        "--scale-sustain", str(args.scale_sustain),
+        "--scale-down-idle", str(args.scale_down_idle),
+    ]
+    for spec in args.spawn or []:
+        forwarded += ["--spawn", spec]
+    if args.observe_only:
+        forwarded.append("--observe-only")
+    if args.once:
+        forwarded.append("--once")
+    if args.metrics_jsonl:
+        forwarded += ["--metrics-jsonl", args.metrics_jsonl]
+    return control_main(forwarded)
 
 
 def cmd_fleet(args) -> int:
@@ -1567,6 +1611,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="on Ctrl-C/SIGTERM: stop accepting, then wait up "
                    "to this long for queued + in-flight requests to finish "
                    "before cancelling stragglers (graceful drain)")
+    p.add_argument("--evacuate-to", action="append", default=None,
+                   metavar="HOST:PORT",
+                   help="peer replica base URL for drain evacuation "
+                   "(repeatable, with --paged): on Ctrl-C/SIGTERM, "
+                   "in-flight sessions are exported over the wire to a "
+                   "peer's /kv/import and queued requests replayed on "
+                   "its /generate instead of finishing in place — the "
+                   "replica vanishes without dropping or delaying work")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="enable JAX's persistent compilation cache rooted "
                    "at DIR: restarted replicas load the prefill-bucket/"
@@ -1681,12 +1733,69 @@ def build_parser() -> argparse.ArgumentParser:
                    "decode on the least-loaded decode replica via KV "
                    "migration; shorter prompts bypass straight to decode "
                    "nodes")
+    p.add_argument("--suspect-after", type=int, default=3, metavar="N",
+                   help="consecutive connect failures before a replica "
+                   "is quarantined as suspect and probed on exponential "
+                   "backoff instead of every poll; a successful probe "
+                   "clears it (counters in /statusz)")
     p.add_argument("--metrics-jsonl", default=None,
                    help="write the router's trace stream (pick/hop/"
                    "request spans per proxied request) to this JSONL; "
                    "one X-Request-Id trace id joins it to the replicas' "
                    "streams")
     p.set_defaults(fn=cmd_route)
+
+    p = sub.add_parser(
+        "control",
+        help="self-healing fleet control loop: polls the fleet "
+        "aggregator + router and acts — hot KV rebalancing, tier "
+        "retuning, elastic capacity — with per-action retries, "
+        "hysteresis cooldowns, and a crash-loop breaker; jax-free",
+    )
+    p.add_argument("--fleet", required=True, metavar="HOST:PORT",
+                   help="fleet aggregator base URL (bpe-tpu fleet)")
+    p.add_argument("--router", default=None, metavar="HOST:PORT",
+                   help="router base URL (enables tier retuning via "
+                   "POST /admin/threshold)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8300,
+                   help="controller HTTP port (0: ephemeral)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between control ticks")
+    p.add_argument("--evidence-max-age", type=float, default=10.0,
+                   help="hold (observe-only) when the aggregator's fleet "
+                   "record is older than this")
+    p.add_argument("--cooldown", type=float, default=30.0,
+                   help="per-(action, target) hysteresis window")
+    p.add_argument("--action-timeout", type=float, default=30.0,
+                   help="per-attempt actuator timeout")
+    p.add_argument("--action-retries", type=int, default=3,
+                   help="bounded retries per action (exponential backoff)")
+    p.add_argument("--max-failures", type=int, default=5,
+                   help="consecutive action failures before the "
+                   "crash-loop breaker trips (controller halts)")
+    p.add_argument("--rebalance-gap", type=int, default=3,
+                   help="queue+slots load gap between hottest and "
+                   "coldest replica that triggers a session rebalance")
+    p.add_argument("--scale-sustain", type=float, default=10.0,
+                   help="seconds a queue_growth/block_exhaustion alert "
+                   "must persist before scaling up")
+    p.add_argument("--scale-down-idle", type=float, default=120.0,
+                   help="seconds of fleet idleness before retiring a "
+                   "controller-spawned replica")
+    p.add_argument("--spawn", action="append", default=[],
+                   metavar="URL=CMD",
+                   help="declarable replica slot for elastic capacity: "
+                   "base URL + the serve command (repeatable; declare "
+                   "the URL to the router/fleet too — it sits suspect "
+                   "until spawned)")
+    p.add_argument("--observe-only", action="store_true",
+                   help="decide and record, never act")
+    p.add_argument("--once", action="store_true",
+                   help="one control tick, print its records, exit")
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="write kind=control records to this JSONL")
+    p.set_defaults(fn=cmd_control)
 
     p = sub.add_parser(
         "fleet",

@@ -1,0 +1,816 @@
+"""Self-healing fleet control plane (ISSUE 20): the crash-loop breaker,
+stale-evidence observe-only degradation, per-(rule, target) hysteresis,
+the pure decision rules, the actuator retry ladder, the replica
+spawner/supervisor, and — behind the ``slow`` marker — the fleet chaos
+end-to-end: two supervised subprocess replicas under kill-mid-decode +
+blackholed ``/kv/import``, zero failed requests, token-identical
+evacuations, and the respawned replica rejoining and receiving load.
+
+The controller is jax-free; everything tier-1 here runs against stub
+evidence, canned stdlib HTTP servers, and tiny ``python -c`` children.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from bpe_transformer_tpu.serving.controller import (
+    ActionBudget,
+    FleetController,
+    ReplicaSpawner,
+    make_control_http_server,
+    parse_spawn_slot,
+)
+
+pytestmark = pytest.mark.serving
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+# ------------------------------------------------------------- evidence
+
+
+def _snap(url, *, online=True, queue=0, active=0, slots=2, kv_free=None,
+          kv_total=None, role="both", draining=False, error=None):
+    """One aggregator replica snapshot, shaped like FleetAggregator's."""
+    return {
+        "url": url, "online": online, "draining": draining, "role": role,
+        "queue_depth": queue, "slots": slots, "active_slots": active,
+        "kv_blocks_free": kv_free, "kv_blocks_total": kv_total,
+        "error": error,
+    }
+
+
+def _evidence(snaps=(), *, t=100.0, time_unix=1000.0, alerts=(),
+              queue_depth=0, active_slots=0, router=None):
+    """A gathered-evidence dict: the aggregator /statusz page (last fleet
+    record + per-replica sweep + alerts) plus an optional router page."""
+    return {
+        "fleet": {
+            "fleet": {
+                "kind": "fleet", "t": t, "time_unix": time_unix,
+                "queue_depth": queue_depth, "active_slots": active_slots,
+            },
+            "replicas": list(snaps),
+            "alerts": list(alerts),
+        },
+        "router": router,
+        "errors": {},
+    }
+
+
+class _StubSpawner:
+    """Duck-typed ReplicaSpawner for decide()/run_once() tests."""
+
+    def __init__(self, idle=1, active=()):
+        self._idle = idle
+        self._active = list(active)
+        self.spawned: list = []
+        self.retired: list = []
+
+    def idle(self):
+        return self._idle
+
+    def active(self):
+        return list(self._active)
+
+    def spawn(self):
+        if self._idle <= 0:
+            return None
+        self._idle -= 1
+        url = f"http://127.0.0.1:91{len(self.spawned):02d}"
+        self.spawned.append(url)
+        self._active.append(url)
+        return url
+
+    def retire(self, url=None):
+        if not self._active:
+            return None
+        out = self._active.pop()
+        self.retired.append(out)
+        return out
+
+    def snapshot(self):
+        return [{"url": u, "live": True, "retiring": False, "restarts": 0}
+                for u in self._active]
+
+    def stop_all(self, timeout_s=30.0):
+        pass
+
+
+def _controller(**kw):
+    kw.setdefault("wall_clock", lambda: 1000.0)
+    kw.setdefault("sleep", lambda s: None)
+    return FleetController("http://127.0.0.1:1", **kw)
+
+
+# ---------------------------------------------------------------- budget
+
+
+def test_action_budget_trips_and_never_auto_untrips():
+    budget = ActionBudget(3)
+    budget.note(False)
+    budget.note(False)
+    assert not budget.tripped and budget.consecutive == 2
+    budget.note(True)  # real progress forgives
+    assert budget.consecutive == 0
+    for _ in range(3):
+        budget.note(False)
+    assert budget.tripped and budget.state == "tripped"
+    budget.note(True)  # success after the trip does NOT re-arm
+    assert budget.tripped and budget.state == "tripped"
+    assert budget.total_failures == 5
+    with pytest.raises(ValueError, match=">= 1"):
+        ActionBudget(0)
+
+
+def test_parse_spawn_slot():
+    url, argv = parse_spawn_slot(
+        "http://127.0.0.1:8091=python -m bpe_transformer_tpu.training.cli "
+        "serve --port 8091 --evacuate-to 'http://a b'"
+    )
+    assert url == "http://127.0.0.1:8091"
+    assert argv[:2] == ["python", "-m"]
+    assert argv[-1] == "http://a b"  # shlex quoting survives
+    for bad in ("no-equals", "=cmd only", "http://x=", "  =  "):
+        with pytest.raises(ValueError, match="URL=CMD"):
+            parse_spawn_slot(bad)
+
+
+# ---------------------------------------------------------------- decide
+
+
+def test_decide_rebalance_on_load_gap():
+    ctl = _controller(rebalance_min_gap=3, rebalance_batch=2)
+    hot = _snap("http://h", queue=5, active=2)
+    cold = _snap("http://c", queue=0, active=1, slots=2)
+    out = ctl.decide(_evidence([hot, cold]))
+    assert [d["action"] for d in out] == ["rebalance"]
+    assert out[0]["target"] == "http://h"
+    assert out[0]["params"] == {"to": "http://c", "max_sessions": 2}
+    assert "hold" not in out[0]
+
+    # Below the gap: no decision (hysteresis against noise).
+    calm = _snap("http://h", queue=1, active=1)
+    assert ctl.decide(_evidence([calm, cold])) == []
+    # A full cold peer cannot absorb the session.
+    full = _snap("http://c", queue=0, active=2, slots=2)
+    assert ctl.decide(_evidence([hot, full])) == []
+    # Nothing in flight on the hot replica: nothing to move.
+    queued_only = _snap("http://h", queue=9, active=0)
+    assert ctl.decide(_evidence([queued_only, cold])) == []
+    # Draining / prefill-role / offline replicas are not candidates.
+    assert ctl.decide(_evidence([hot, _snap("http://c", draining=True)])) == []
+    assert ctl.decide(_evidence([hot, _snap("http://c", role="prefill")])) == []
+    assert ctl.decide(_evidence([hot])) == []
+
+
+def test_decide_rebalance_on_kv_starvation():
+    ctl = _controller(rebalance_min_gap=5, rebalance_headroom_frac=0.15)
+    hot = _snap("http://h", queue=1, active=1, kv_free=1, kv_total=32)
+    cold = _snap("http://c", queue=0, active=0, kv_free=30, kv_total=32)
+    out = ctl.decide(_evidence([hot, cold]))
+    assert len(out) == 1 and out[0]["action"] == "rebalance"
+    assert "kv headroom" in out[0]["reason"]
+    # Same load gap, but the cold peer is nearly as starved: hold off.
+    tight = _snap("http://c", queue=0, active=0, kv_free=5, kv_total=32)
+    assert ctl.decide(_evidence([hot, tight])) == []
+
+
+def test_decide_partial_sweep_holds_rebalance_but_not_scaling():
+    """An incomplete peer sweep (a declared replica the aggregator could
+    not see) must downgrade load-comparing rules to observe-only while
+    alert-driven scale-up still acts — a dead replica is exactly when
+    capacity is needed."""
+    spawner = _StubSpawner(idle=1)
+    ctl = _controller(spawner=spawner, scale_sustain_s=10.0)
+    snaps = [
+        _snap("http://h", queue=6, active=2),
+        _snap("http://c", queue=0, active=0),
+        _snap("http://gone", online=False, error="connect refused"),
+    ]
+    alerts = [{"rule": "queue_growth", "since_t": 80.0}]
+    out = ctl.decide(_evidence(snaps, t=100.0, alerts=alerts))
+    by_action = {d["action"]: d for d in out}
+    assert by_action["rebalance"]["hold"] == "partial_sweep"
+    assert "hold" not in by_action["scale_up"]
+    assert "queue_growth" in by_action["scale_up"]["reason"]
+
+
+def test_decide_retune_follows_prompt_mix_with_hysteresis():
+    ctl = _controller(retune_min_samples=16, retune_margin=0.25)
+
+    def router_page(count=20, p75=48, threshold=8, prefill_available=True):
+        return {
+            "prompt_mix": {"count": count, "p75": p75},
+            "prefill_threshold": threshold,
+            "replicas": [
+                {"role": "prefill", "available": prefill_available},
+                {"role": "both", "available": True},
+            ],
+        }
+
+    out = ctl.decide(_evidence(router=router_page()))
+    assert [d["action"] for d in out] == ["retune"]
+    assert out[0]["params"]["prefill_threshold"] == 48
+    assert out[0]["target"] == "router"
+
+    # Inside the hysteresis margin: no thrash.
+    assert ctl.decide(_evidence(router=router_page(p75=50, threshold=48))) == []
+    # Too few samples, no live prefill tier, or no router page: silent.
+    assert ctl.decide(_evidence(router=router_page(count=3))) == []
+    assert ctl.decide(
+        _evidence(router=router_page(prefill_available=False))
+    ) == []
+    assert ctl.decide(_evidence(router=None)) == []
+    # Degenerate mixes still produce a sane (>= 2) threshold.
+    out = ctl.decide(_evidence(router=router_page(p75=1, threshold=None)))
+    assert out[0]["params"]["prefill_threshold"] == 2
+
+
+def test_decide_scale_up_sustained_and_scale_down_idle():
+    clk = {"t": 0.0}
+    spawner = _StubSpawner(idle=1, active=["http://spawned"])
+    ctl = _controller(
+        spawner=spawner, scale_sustain_s=10.0, scale_down_idle_s=50.0,
+        clock=lambda: clk["t"],
+    )
+    # A young alert does not scale; a sustained one does.
+    young = [{"rule": "queue_growth", "since_t": 95.0}]
+    assert ctl.decide(_evidence(t=100.0, alerts=young, queue_depth=3)) == []
+    old = [{"rule": "block_exhaustion", "since_t": 80.0}]
+    out = ctl.decide(_evidence(t=100.0, alerts=old, queue_depth=3))
+    assert [d["action"] for d in out] == ["scale_up"]
+    # No idle slot left: nothing to spawn with.
+    ctl2 = _controller(spawner=_StubSpawner(idle=0), scale_sustain_s=10.0)
+    assert ctl2.decide(_evidence(t=100.0, alerts=old, queue_depth=3)) == []
+
+    # Scale-down needs a LONG idle fleet; any work resets the timer.
+    clk["t"] = 40.0
+    assert ctl.decide(_evidence(queue_depth=1)) == []  # busy -> reset
+    clk["t"] = 80.0
+    assert ctl.decide(_evidence()) == []  # only 40s idle
+    clk["t"] = 95.0
+    out = ctl.decide(_evidence())
+    assert [d["action"] for d in out] == ["scale_down"]
+    assert out[0]["target"] == "http://spawned"
+
+
+# -------------------------------------------------- run_once safety pins
+
+
+def test_stale_evidence_holds_observe_only_and_edge_triggers():
+    """ACCEPTANCE (ISSUE 20): stale fleet evidence degrades the
+    controller to observe-only — one kind=control record saying why per
+    hold EPISODE, not per tick — and fresh evidence re-arms it."""
+    ctl = _controller(evidence_max_age_s=10.0)
+    stale = _evidence(time_unix=900.0)  # wall clock is pinned at 1000
+    fresh = _evidence(time_unix=1000.0)
+    ctl.gather = lambda: stale
+
+    records = ctl.run_once()
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["kind"] == "control" and rec["action"] == "hold"
+    assert rec["outcome"] == "held"
+    assert rec["reason"].startswith("stale_evidence")
+    assert ctl.run_once() == []  # same episode: silent
+    assert ctl.run_once() == []
+
+    ctl.gather = lambda: fresh
+    assert ctl.run_once() == []  # healthy and quiet: no records at all
+    ctl.gather = lambda: stale
+    assert len(ctl.run_once()) == 1  # a NEW episode records again
+
+    # An unreachable aggregator is its own hold reason (new episode).
+    ctl.gather = lambda: {"fleet": None, "router": None,
+                          "errors": {"fleet": "connect refused"}}
+    records = ctl.run_once()
+    assert len(records) == 1
+    assert records[0]["reason"].startswith("fleet_unreachable")
+    assert ctl.statusz()["holds"] == 5
+
+
+def test_breaker_trips_after_consecutive_failures_and_halts():
+    """ACCEPTANCE (ISSUE 20): max_consecutive_failures failed actions
+    without one success trip the crash-loop breaker; the controller then
+    stops calling actuators entirely (observe-only until restarted)."""
+    ctl = _controller(cooldown_s=0.0, max_consecutive_failures=2)
+    ctl.gather = lambda: _evidence()
+    decision = {"action": "rebalance", "target": "http://h",
+                "reason": "load gap", "params": {"to": "http://c",
+                                                 "max_sessions": 1}}
+    ctl.decide = lambda ev: [dict(decision)]
+    calls = []
+
+    def failing_execute(d):
+        calls.append(d)
+        return {"ok": False, "attempts": 3, "detail": "HTTP 503: b'nope'"}
+
+    ctl._execute = failing_execute
+
+    first = ctl.run_once()
+    assert [r["outcome"] for r in first] == ["failed"]
+    assert first[0]["breaker"] == "closed" and not ctl.budget.tripped
+
+    second = ctl.run_once()
+    assert [r["action"] for r in second] == ["rebalance", "hold"]
+    assert second[0]["outcome"] == "failed"
+    assert second[1]["reason"].startswith("breaker_tripped")
+    assert ctl.budget.tripped
+
+    # Halted: no more actuator calls, and the hold is edge-triggered.
+    assert ctl.run_once() == []
+    assert ctl.run_once() == []
+    assert len(calls) == 2
+    page = ctl.statusz()
+    assert page["breaker"] == "tripped"
+    assert page["actions_failed"] == 2 and page["actions_ok"] == 0
+
+
+def test_cooldown_hysteresis_observe_only_and_partial_hold_records():
+    # Cooldown: the same (action, target) cannot refire inside the window.
+    clk = {"t": 0.0}
+    ctl = _controller(cooldown_s=100.0, clock=lambda: clk["t"])
+    ctl.gather = lambda: _evidence()
+    decision = {"action": "rebalance", "target": "http://h",
+                "reason": "gap", "params": {"to": "http://c",
+                                            "max_sessions": 1}}
+    ctl.decide = lambda ev: [dict(decision)]
+    ctl._execute = lambda d: {"ok": True, "attempts": 1,
+                              "detail": {"moved": 1}}
+    ok = ctl.run_once()
+    assert [r["outcome"] for r in ok] == ["ok"]
+    assert ok[0]["detail"] == {"moved": 1} and ok[0]["attempts"] == 1
+    assert ctl.run_once() == []  # cooling
+    assert ctl.statusz()["cooldown_skips"] == 1
+    clk["t"] = 101.0
+    assert [r["outcome"] for r in ctl.run_once()] == ["ok"]
+
+    # observe_only mode records the decision and never touches actuators.
+    obs = _controller(observe_only=True)
+    obs.gather = lambda: _evidence()
+    obs.decide = lambda ev: [dict(decision)]
+    obs._execute = lambda d: pytest.fail("observe-only must not act")
+    records = obs.run_once()
+    assert [r["outcome"] for r in records] == ["observe_only"]
+
+    # A rule-level hold (partial sweep) is observe-only with the cause.
+    held = _controller()
+    held.gather = lambda: _evidence()
+    held.decide = lambda ev: [dict(decision, hold="partial_sweep")]
+    held._execute = lambda d: pytest.fail("held decision must not act")
+    records = held.run_once()
+    assert records[0]["outcome"] == "observe_only"
+    assert records[0]["held_because"] == "partial_sweep"
+
+
+# ------------------------------------------------------------- actuators
+
+
+class _Actuator:
+    """A canned actuator endpoint: /admin/evacuate 503s twice then
+    succeeds; /admin/threshold always 400s (semantic refusal)."""
+
+    def __init__(self):
+        self.evacuate_hits = 0
+        self.threshold_hits = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length") or 0))
+                if self.path == "/admin/evacuate":
+                    outer.evacuate_hits += 1
+                    if outer.evacuate_hits < 3:
+                        return self.send_error(503, "draining")
+                    body = json.dumps({"moved": 1}).encode()
+                elif self.path == "/admin/threshold":
+                    outer.threshold_hits += 1
+                    return self.send_error(400, "threshold must be >= 1")
+                else:
+                    return self.send_error(404)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.thread.join(timeout=10)
+
+
+def test_execute_retries_transient_failures_and_breaks_on_4xx():
+    actuator = _Actuator()
+    try:
+        ctl = _controller(
+            router_url=actuator.url, action_retries=3,
+            action_backoff_s=0.0, action_timeout_s=10.0,
+        )
+        # Two 503s, then success: the retry ladder absorbs the transient.
+        result = ctl._execute({
+            "action": "rebalance", "target": actuator.url,
+            "reason": "gap",
+            "params": {"to": "http://c", "max_sessions": 1},
+        })
+        assert result["ok"] and result["attempts"] == 3
+        assert result["detail"] == {"moved": 1}
+        assert actuator.evacuate_hits == 3
+
+        # A 4xx is permanent: exactly one attempt, no retry hammering.
+        result = ctl._execute({
+            "action": "retune", "target": "router", "reason": "mix",
+            "params": {"prefill_threshold": 0},
+        })
+        assert not result["ok"]
+        assert result["detail"].startswith("HTTP 400")
+        assert actuator.threshold_hits == 1
+
+        # A dead actuator burns the bounded retries, then reports.
+        dead = _controller(action_retries=2, action_backoff_s=0.0,
+                           action_timeout_s=1.0)
+        result = dead._execute({
+            "action": "rebalance", "target": "http://127.0.0.1:9",
+            "reason": "gap",
+            "params": {"to": "http://c", "max_sessions": 1},
+        })
+        assert not result["ok"] and result["attempts"] == 2
+    finally:
+        actuator.close()
+
+
+# --------------------------------------------------------------- spawner
+
+
+def _wait_until(predicate, timeout_s=30.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+def test_replica_spawner_spawn_retire_and_crash_respawn_budget():
+    sleeper = [sys.executable, "-c", "import time; time.sleep(600)"]
+    crasher = [sys.executable, "-c", "import sys; sys.exit(3)"]
+    spawner = ReplicaSpawner(
+        [("http://127.0.0.1:9001", sleeper),
+         ("http://127.0.0.1:9002/", crasher)],
+        max_restarts=2, backoff_s=0.01, backoff_max_s=0.02,
+        log=lambda *a: None,
+    )
+    try:
+        assert spawner.idle() == 2 and spawner.active() == []
+        assert spawner.spawn() == "http://127.0.0.1:9001"
+        assert spawner.active() == ["http://127.0.0.1:9001"]
+        assert spawner.spawn() == "http://127.0.0.1:9002"  # URL canonical
+        assert spawner.spawn() is None  # every slot live
+
+        # The crasher is respawned with backoff until the restart budget
+        # is spent, then the slot is released (idle again, not undead).
+        assert _wait_until(lambda: spawner.idle() == 1), spawner.snapshot()
+        crashed = next(
+            s for s in spawner.snapshot()
+            if s["url"] == "http://127.0.0.1:9002"
+        )
+        assert not crashed["live"]
+        assert crashed["restarts"] == 3  # max_restarts=2 exceeded
+
+        # Retire SIGTERMs the newest live replica; supervision ends
+        # cleanly instead of respawning it.
+        assert spawner.retire() == "http://127.0.0.1:9001"
+        assert _wait_until(lambda: spawner.idle() == 2), spawner.snapshot()
+        assert spawner.retire() is None
+    finally:
+        spawner.stop_all(timeout_s=10.0)
+
+
+# ----------------------------------------------------------- HTTP front
+
+
+def test_control_http_server_statusz_and_healthz():
+    ctl = _controller(max_consecutive_failures=1)
+    server = make_control_http_server(ctl, port=0)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        page = json.loads(
+            urllib.request.urlopen(f"{base}/statusz", timeout=10).read()
+        )
+        assert page["breaker"] == "closed" and page["ticks"] == 0
+        assert page["fleet_url"] == "http://127.0.0.1:1"
+        health = json.loads(
+            urllib.request.urlopen(f"{base}/healthz", timeout=10).read()
+        )
+        assert health["ok"] is True
+        ring = json.loads(
+            urllib.request.urlopen(
+                f"{base}/debug/flightrecorder", timeout=10
+            ).read()
+        )
+        assert "events" in ring
+
+        ctl.budget.note(False)  # trips at max_consecutive_failures=1
+        health = json.loads(
+            urllib.request.urlopen(f"{base}/healthz", timeout=10).read()
+        )
+        assert health["ok"] is False and health["breaker"] == "tripped"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/nope", timeout=10)
+        assert err.value.code == 404
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=10)
+
+
+# ---------------------------------------------------- telemetry fixture
+
+
+def test_control_records_through_report_and_monitor():
+    """The kind=control stream folds into `bpe-tpu report` (== control ==
+    section + COMPARE_METRICS) and the live monitor: the pinned fixture
+    keeps the schema honest across sessions."""
+    from bpe_transformer_tpu.telemetry.monitor import (
+        fold_records,
+        render_frame,
+    )
+    from bpe_transformer_tpu.telemetry.report import (
+        extract_compare_metrics,
+        summarize,
+    )
+
+    records = [
+        json.loads(line)
+        for line in (FIXTURES / "control_tiny.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    summary = summarize(records)
+    control = summary["control"]
+    assert control["n"] == 9
+    assert control["actions_ok"] == 4
+    assert control["actions_failed"] == 2
+    assert control["observe_only"] == 1
+    assert control["holds"] == 2
+    assert control["hold_reasons"] == {"stale_evidence": 1,
+                                       "breaker_tripped": 1}
+    assert control["breaker_last"] == "tripped"
+    assert control["breaker_tripped"] is True
+    assert control["rebalance_p50_s"] == pytest.approx(0.42)
+    assert control["rebalance_p99_s"] == pytest.approx(1.85)
+    assert control["by_action"]["rebalance"] == 5
+    assert any("breaker" in a for a in summary["anomalies"])
+
+    metrics = extract_compare_metrics(summary)
+    assert metrics["control_actions_failed"] == (2, "lower")
+    assert metrics["rebalance_p99_s"] == (pytest.approx(1.85), "lower")
+
+    state = fold_records(records)
+    assert state["control_actions"] == 9
+    assert state["control_failed"] == 2
+    assert state["control_breaker"] == "tripped"
+    assert state["anomalies"] == 2
+    frame = render_frame(state, "control_tiny.jsonl")
+    assert "ctrl" in frame and "breaker tripped" in frame
+
+
+# ------------------------------------------------------- fleet chaos e2e
+
+
+def _free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def _get_json(url, timeout=10):
+    return json.loads(urllib.request.urlopen(url, timeout=timeout).read())
+
+
+@pytest.mark.slow
+def test_fleet_chaos_kill_blackhole_respawn_e2e(tmp_path):
+    """ACCEPTANCE (ISSUE 20): a two-replica controller-supervised fleet
+    under kill-mid-decode + blackholed /kv/import serves every request —
+    zero failures, the router replays the dead replica's work, the
+    supervisor respawns it and the router's suspect probe readmits it —
+    and a controller-driven rebalance whose first import is blackholed
+    retries under one idempotency key, grafting each evacuated session
+    exactly once, token-identical to the monolithic reference."""
+    import dataclasses
+    import pickle
+
+    import jax
+    import numpy as np
+
+    from bpe_transformer_tpu.checkpointing import save_checkpoint
+    from bpe_transformer_tpu.models import TS_TEST_CONFIG, init_params
+    from bpe_transformer_tpu.serving import ServingEngine
+    from bpe_transformer_tpu.serving.router import Router
+
+    cfg = dataclasses.replace(
+        TS_TEST_CONFIG, vocab_size=128, context_length=64
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(7)
+    prompts = [
+        [int(t) for t in rng.integers(0, cfg.vocab_size, size=6)]
+        for _ in range(8)
+    ]
+    # Monolithic greedy reference: what every request must produce no
+    # matter how many replicas, kills, or migrations it crossed. The serve
+    # subprocesses stop on the tokenizer's appended special token (id 127,
+    # cmd_serve's default_stop_id), so the reference must too.
+    ref = {}
+    with ServingEngine(
+        params, cfg, slots=2, paged=True, block_size=8
+    ) as mono:
+        for i, prompt in enumerate(prompts):
+            ref[i] = mono.generate(
+                prompt, max_new_tokens=48, temperature=0.0, stop_id=127
+            ).token_ids
+
+    ckpt = tmp_path / "model.ckpt"
+    save_checkpoint(
+        ckpt, params=params,
+        extra={"model_config": dataclasses.asdict(cfg)},
+    )
+    tok_dir = tmp_path / "tok"
+    tok_dir.mkdir()
+    with open(tok_dir / "vocab.pkl", "wb") as f:
+        pickle.dump({i: bytes([i]) for i in range(127)}, f)
+    with open(tok_dir / "merges.pkl", "wb") as f:
+        pickle.dump([], f)
+
+    port_a, port_b = _free_port(), _free_port()
+    url_a = f"http://127.0.0.1:{port_a}"
+    url_b = f"http://127.0.0.1:{port_b}"
+    once_dir = tmp_path / "faults"
+    faults = json.dumps({
+        "kill_at_decode_tick": 20,
+        "http_blackhole": True,
+        "http_fault_path": "/kv/import",
+        "once_dir": str(once_dir),
+    })
+
+    def serve_argv(port, *extra_env, evacuate_to):
+        return [
+            "env", f"PYTHONPATH={REPO}", "JAX_PLATFORMS=cpu", *extra_env,
+            sys.executable, "-m", "bpe_transformer_tpu.training.cli",
+            "serve",
+            "--checkpoint", str(ckpt), "--tokenizer-dir", str(tok_dir),
+            "--host", "127.0.0.1", "--port", str(port), "--slots", "2",
+            "--paged", "--block-size", "8", "--drain-timeout", "60",
+            "--evacuate-to", evacuate_to,
+        ]
+
+    spawner = ReplicaSpawner(
+        [
+            (url_a, serve_argv(port_a, f"BT_FAULTS={faults}",
+                               evacuate_to=url_b)),
+            (url_b, serve_argv(port_b, evacuate_to=url_a)),
+        ],
+        max_restarts=3, backoff_s=0.5,
+    )
+    router = Router(
+        [url_a, url_b], poll_interval_s=0.3, suspect_after=2,
+        probe_backoff_s=0.3, probe_backoff_max_s=2.0,
+    )
+    results: dict = {}
+    errors: list = []
+
+    def fire(i, base_url=None):
+        body = json.dumps({
+            "prompt_ids": prompts[i], "max_new_tokens": 48,
+            "temperature": 0.0,
+        }).encode()
+        try:
+            if base_url is None:
+                code, payload = router.handle_generate(body)
+                assert code == 200, payload
+            else:
+                req = urllib.request.Request(
+                    f"{base_url}/generate", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                payload = json.loads(
+                    urllib.request.urlopen(req, timeout=300).read()
+                )
+            results[i] = payload
+        except Exception as exc:  # noqa: BLE001 — the assertion is "none"
+            errors.append((i, repr(exc)))
+
+    try:
+        assert spawner.spawn() == url_a
+        assert spawner.spawn() == url_b
+        router.start()
+        assert _wait_until(
+            lambda: router.statusz()["available"] == 2, timeout_s=300,
+            interval_s=0.5,
+        ), "replicas never came up"
+
+        # ---- phase 1: kill replica A mid-decode under threaded load.
+        # Its 20th decode tick SIGKILLs it; the router replays the dead
+        # connections on B and quarantines A; the spawner respawns A.
+        threads = [
+            threading.Thread(target=fire, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        assert (once_dir / "kill_decode.fired").exists(), (
+            "the mid-decode kill never fired — phase 1 proved nothing"
+        )
+        for i in range(6):
+            assert tuple(results[i]["token_ids"]) == ref[i], (
+                f"request {i} diverged across the kill/replay"
+            )
+
+        # The respawned A rejoins through the suspect probe path.
+        assert _wait_until(
+            lambda: router.statusz()["available"] == 2, timeout_s=300,
+            interval_s=0.5,
+        ), f"replica A never rejoined: {router.statusz()}"
+        page = router.statusz()
+        assert page["suspected_total"] >= 1
+        assert page["recoveries_total"] >= 1
+
+        # ---- phase 2: controller-driven rebalance B -> A with the first
+        # /kv/import blackholed.  The relay must retry under ONE
+        # idempotency key; the respawned A grafts each session once.
+        imports_before = _get_json(f"{url_a}/statusz")["migrations_in"]
+        ctl = FleetController(
+            "http://127.0.0.1:1", spawner=spawner,
+            action_timeout_s=120.0, action_retries=3, action_backoff_s=0.5,
+        )
+        moved = 0
+        for _ in range(3):  # sessions must be mid-flight to move
+            # Fire each request twice (4 sessions, 2 slots): the queue
+            # keeps B's slots occupied long enough that the evacuate —
+            # triggered the moment /statusz shows a live session, not
+            # after a blind sleep — catches one mid-decode even on a
+            # warm engine where a full generation takes well under a
+            # second.
+            threads = [
+                threading.Thread(target=fire, args=(i, url_b))
+                for i in (6, 7, 6, 7)
+            ]
+            for t in threads:
+                t.start()
+            assert _wait_until(
+                lambda: _get_json(f"{url_b}/statusz")["active_slots"] > 0,
+                timeout_s=60, interval_s=0.02,
+            ), "requests never reached a decode slot on B"
+            result = ctl._execute({
+                "action": "rebalance", "target": url_b,
+                "reason": "fleet chaos e2e",
+                "params": {"to": url_a, "max_sessions": 2},
+            })
+            assert result["ok"], result
+            for t in threads:
+                t.join(timeout=300)
+            assert not errors, errors
+            for i in range(6, 8):
+                assert tuple(results[i]["token_ids"]) == ref[i], (
+                    f"request {i} diverged across the evacuation"
+                )
+            moved = result["detail"]["moved"]
+            if moved:
+                break
+        assert moved >= 1, "no session was ever mid-flight to evacuate"
+        imports_after = _get_json(f"{url_a}/statusz")["migrations_in"]
+        # Exactly once per moved session: the blackholed first attempt
+        # plus its retry graft ONE session, not two.
+        assert imports_after - imports_before == moved
+        assert (once_dir / "http_blackhole.fired").exists(), (
+            "the import blackhole never fired — the retry path was idle"
+        )
+    finally:
+        router.close()
+        spawner.stop_all(timeout_s=60.0)

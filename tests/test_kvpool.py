@@ -837,7 +837,8 @@ def test_cli_serve_flag_validation():
     from bpe_transformer_tpu.training.cli import cmd_serve
 
     base = dict(prompts_file=None, output=None, compile_cache=None,
-                paged=False, speculate=0, draft_config=None, role="both")
+                paged=False, speculate=0, draft_config=None, role="both",
+                evacuate_to=None)
     args = argparse.Namespace(kv_dtype="int8", decode_attention=None, **base)
     assert cmd_serve(args) == 2
     args = argparse.Namespace(kv_dtype="act", decode_attention="paged",
@@ -847,6 +848,12 @@ def test_cli_serve_flag_validation():
     args = argparse.Namespace(
         kv_dtype="act", decode_attention=None,
         **{**base, "role": "prefill"},
+    )
+    assert cmd_serve(args) == 2
+    # Drain evacuation ships KV block chains: --evacuate-to needs --paged.
+    args = argparse.Namespace(
+        kv_dtype="act", decode_attention=None,
+        **{**base, "evacuate_to": ["http://peer:8001"]},
     )
     assert cmd_serve(args) == 2
 
@@ -1139,6 +1146,96 @@ def test_payload_codec_roundtrip_and_corruption():
         payload_from_bytes(b"BPEKV999" + data[8:])
     with pytest.raises(ValueError, match="truncated"):
         payload_from_bytes(data[: len(data) - 64])
+
+
+def test_payload_wire_v2_compression_and_crc():
+    """ISSUE 20 wire hardening: every advertised codec round trips the
+    frame exactly; a single bit flipped in the array section is caught by
+    the CRC (the corruption no structural check can see); a corrupted
+    compressed body fails loudly instead of grafting garbage."""
+    from bpe_transformer_tpu.serving.kvpool.migrate import (
+        HAVE_ZSTD,
+        supported_codecs,
+    )
+
+    payload = synthetic_decode_payload(
+        CFG, block_size=8, kv_dtype="int8", prompt_len=9, max_new_tokens=3
+    )
+    codecs = supported_codecs()
+    assert codecs[-1] == "raw" and "zlib" in codecs
+    assert ("zstd" in codecs) == HAVE_ZSTD
+    for codec in codecs:
+        data = payload_to_bytes(payload, codec=codec)
+        assert data.startswith(b"BPEKV002")
+        back = payload_from_bytes(data)
+        assert back["meta"] == payload["meta"]
+        for a, b in zip(payload["layers"], back["layers"]):
+            for name in a:
+                np.testing.assert_array_equal(a[name], b[name])
+
+    # Bit flip in the raw array section: only the CRC can catch it.
+    raw = payload_to_bytes(payload, codec="raw")
+    buf = bytearray(raw)
+    buf[(len(buf) * 3) // 4] ^= 0xFF
+    with pytest.raises(ValueError, match="CRC"):
+        payload_from_bytes(bytes(buf))
+
+    # Bit flip inside a COMPRESSED body: either the codec or the CRC
+    # must refuse it — never a silent graft.
+    z = payload_to_bytes(payload, codec="zlib")
+    zbuf = bytearray(z)
+    zbuf[len(zbuf) - 8] ^= 0xFF
+    with pytest.raises(ValueError, match="corrupt|CRC"):
+        payload_from_bytes(bytes(zbuf))
+    with pytest.raises(ValueError, match="truncated"):
+        payload_from_bytes(z[: len(z) - 4])
+    with pytest.raises(ValueError, match="codec"):
+        payload_to_bytes(payload, codec="lz9")
+
+
+def test_payload_codec_negotiation_and_legacy_v1():
+    """Codec negotiation picks the best locally available codec from the
+    peer's accept list and degrades to raw for pre-negotiation peers;
+    legacy BPEKV001 frames (PR 14, no CRC/compression) still decode."""
+    import json as _json
+
+    from bpe_transformer_tpu.serving.kvpool.migrate import (
+        HAVE_ZSTD,
+        PAYLOAD_MAGIC,
+        PAYLOAD_MAGIC_V1,
+        negotiate_codec,
+    )
+
+    assert negotiate_codec(None) == "raw"
+    assert negotiate_codec("") == "raw"
+    assert negotiate_codec("bogus,codecs") == "raw"
+    assert negotiate_codec("zlib , raw") == "zlib"
+    assert negotiate_codec("RAW") == "raw"
+    best = negotiate_codec("zstd,zlib,raw")
+    assert best == ("zstd" if HAVE_ZSTD else "zlib")
+
+    # Rebuild a v2 raw frame as the v1 layout: v1 magic, a header with no
+    # codec/CRC fields, the uncompressed array section.
+    payload = synthetic_decode_payload(
+        CFG, block_size=8, kv_dtype="int8", prompt_len=9, max_new_tokens=3
+    )
+    v2 = payload_to_bytes(payload, codec="raw")
+    hlen = int.from_bytes(v2[8:16], "little")
+    header = _json.loads(v2[16: 16 + hlen])
+    body = v2[16 + hlen:]
+    for key in ("codec", "crc32", "raw_nbytes", "body_nbytes"):
+        header.pop(key)
+    legacy_header = _json.dumps(header, separators=(",", ":")).encode()
+    v1 = b"".join([
+        PAYLOAD_MAGIC_V1,
+        len(legacy_header).to_bytes(8, "little"), legacy_header, body,
+    ])
+    assert not v1.startswith(PAYLOAD_MAGIC)
+    back = payload_from_bytes(v1)
+    assert back["meta"] == payload["meta"]
+    for a, b in zip(payload["layers"], back["layers"]):
+        for name in a:
+            np.testing.assert_array_equal(a[name], b[name])
 
 
 def test_export_import_roundtrip_token_identical(

@@ -956,3 +956,85 @@ def test_supervisor_end_to_end_kill_and_resume(cli_workspace):
     summary = json.loads((ckpt / "summary.json").read_text())
     assert summary["history"][-1]["step"] == 16
     assert load_checkpoint(ckpt / "latest.ckpt")["iteration"] == 16
+
+
+# --------------------------------------- serving fault hooks (ISSUE 20)
+
+
+def test_serving_fault_hooks_fire_once_with_cross_process_markers(
+    tmp_path, monkeypatch
+):
+    """The fleet-chaos hooks: HTTP blackhole/delay and payload corruption
+    each fire exactly once, the path filter scopes them, and the once_dir
+    markers make "once" hold across a supervisor respawn (a fresh
+    injector in a fresh process must NOT re-fire)."""
+    plan = {
+        "http_blackhole": True,
+        "http_delay_s": 0.01,
+        "http_fault_path": "/kv/import",
+        "corrupt_payload": "truncate",
+        "once_dir": str(tmp_path / "markers"),
+    }
+    monkeypatch.setenv("BT_FAULTS", json.dumps(plan))
+    injector = FaultInjector.from_env()
+    assert injector.active
+
+    # Path filter: only the targeted endpoint is faulted.
+    assert injector.on_http_request("/generate") is None
+    assert injector.on_http_request("/kv/import") == "blackhole"
+    # Blackhole spent; the delay fires (once) on the next matching hit.
+    t0 = time.monotonic()
+    assert injector.on_http_request("/kv/import") is None
+    assert time.monotonic() - t0 >= 0.01
+    assert injector.on_http_request("/kv/import") is None
+
+    data = bytes(range(256)) * 4
+    mangled = injector.on_export_payload(data)
+    assert mangled == data[: len(data) // 2]  # truncate mode, fires once
+    assert injector.on_export_payload(data) == data
+
+    # A respawned process builds a FRESH injector from the same env: the
+    # markers on disk keep every fired fault fired.
+    respawned = FaultInjector.from_env()
+    assert respawned.on_http_request("/kv/import") is None
+    assert respawned.on_export_payload(data) == data
+    for marker in ("http_blackhole", "http_delay", "corrupt_payload"):
+        assert (tmp_path / "markers" / f"{marker}.fired").exists()
+
+
+def test_serving_fault_bitflip_and_decode_tick_kill(monkeypatch):
+    """The flip corruption lands one bit in the trailing quarter (the
+    array section — the case only the wire CRC catches), and the
+    mid-decode kill hook SIGKILLs at its tick exactly once."""
+    injector = FaultInjector(FaultPlan(corrupt_payload="flip"))
+    data = bytes(range(256))
+    flipped = injector.on_export_payload(data)
+    assert len(flipped) == len(data)
+    diffs = [i for i, (a, b) in enumerate(zip(data, flipped)) if a != b]
+    assert diffs == [(len(data) * 3) // 4]
+    assert injector.on_export_payload(data) == data  # spent
+
+    kills: list = []
+    monkeypatch.setattr(
+        "bpe_transformer_tpu.resilience.faults.os.kill",
+        lambda pid, sig: kills.append((pid, sig)),
+    )
+    injector = FaultInjector(FaultPlan(kill_at_decode_tick=5))
+    for tick in range(1, 5):
+        injector.at_decode_tick(tick)
+    assert kills == []
+    injector.at_decode_tick(5)
+    assert kills == [(os.getpid(), signal.SIGKILL)]
+    injector.at_decode_tick(6)  # fired once; a respawn survives its tick
+    assert len(kills) == 1
+
+    # An idle injector (no plan) is inert on every serving hook.
+    idle = FaultInjector(None)
+    assert not idle.active
+    idle.at_decode_tick(99)
+    assert idle.on_http_request("/kv/import") is None
+    assert idle.on_export_payload(b"x") == b"x"
+
+    # Unknown plan fields fail loudly at parse time, not mid-incident.
+    with pytest.raises(ValueError, match="unknown fault plan"):
+        FaultPlan.from_json('{"http_blackhol": true}')

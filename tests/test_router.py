@@ -40,7 +40,8 @@ class _FakeReplica:
 
     def __init__(self, *, slots=4, active=0, queue=0, kv_free=None,
                  kv_total=None, draining=False, generate_code=200,
-                 generate_delay_s=0.0, role=None, export_code=200):
+                 generate_delay_s=0.0, role=None, export_code=200,
+                 port=0):
         self.statusz = {
             "worker_alive": True,
             "draining": draining,
@@ -140,7 +141,7 @@ class _FakeReplica:
                      "request_id": rid, "timings": {}},
                 )
 
-        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
         self.thread = threading.Thread(
             target=self.server.serve_forever, daemon=True
@@ -346,6 +347,147 @@ def test_router_importable_and_runnable_without_jax():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert proc.stdout.strip() == "ok"
+
+
+def test_suspect_quarantine_probe_backoff_and_recovery():
+    """ISSUE 20 suspect quarantine: suspect_after consecutive connect
+    failures exclude a replica from routing AND from the poll sweep; it
+    is probed on an exponential-backoff schedule (doubling, capped), and
+    a successful probe readmits it — a respawned replica rejoins without
+    the fleet paying a connect timeout per poll while it was gone."""
+    clk = {"t": 0.0}
+    live = _FakeReplica()
+    ghost = _FakeReplica()
+    dead_port = ghost.server.server_address[1]
+    ghost.close()
+    url_dead = f"http://127.0.0.1:{dead_port}"
+    try:
+        router = Router(
+            [live.url, url_dead], poll_interval_s=3600.0,
+            poll_timeout_s=1.0, suspect_after=2,
+            probe_backoff_s=1.0, probe_backoff_max_s=4.0,
+            clock=lambda: clk["t"],
+        )
+        dead = next(r for r in router.replicas if r.url == url_dead)
+        router.poll_once()
+        assert not dead.suspect and dead.consecutive_failures == 1
+        router.poll_once()
+        assert dead.suspect
+        assert dead.probe_backoff_s == 1.0
+        assert dead.next_probe_t == pytest.approx(1.0)
+        page = router.statusz()
+        assert page["suspect"] == 1 and page["suspected_total"] == 1
+        assert [r.url for r in router.pick_order()] == [live.url]
+
+        # Inside the backoff window the sweep SKIPS the suspect entirely.
+        probes0 = router.probes_total
+        clk["t"] = 0.5
+        router.poll_once()
+        assert router.probes_total == probes0
+        assert dead.consecutive_failures == 2
+
+        # Each failed probe doubles the next deadline, up to the cap.
+        clk["t"] = 1.1
+        router.poll_once()
+        assert router.probes_total == probes0 + 1
+        assert dead.probe_backoff_s == 2.0
+        clk["t"] = 3.2
+        router.poll_once()
+        assert dead.probe_backoff_s == 4.0
+        clk["t"] = 7.5
+        router.poll_once()
+        assert dead.probe_backoff_s == 4.0  # capped
+
+        # Recovery: the replica returns at the same URL; one successful
+        # probe clears the quarantine and rejoins it to the rotation.
+        revived = _FakeReplica(port=dead_port)
+        try:
+            clk["t"] = 12.0
+            router.poll_once()
+            assert not dead.suspect and dead.available
+            assert dead.next_probe_t is None
+            assert router.recoveries_total == 1
+            assert {r.url for r in router.pick_order()} == {
+                live.url, url_dead
+            }
+        finally:
+            revived.close()
+    finally:
+        live.close()
+
+
+def test_prompt_mix_window_and_threshold_retune_endpoint():
+    """ISSUE 20 tier retuning evidence + actuator: the router observes
+    the live prompt-length mix even with the two-tier threshold unarmed,
+    and POST /admin/threshold retunes (or disarms) the split at runtime
+    with validation."""
+    rep = _FakeReplica()
+    try:
+        router = Router([rep.url], prompt_mix_window=64)
+        router.poll_once()
+        for n in (4, 8, 12, 100):
+            code, _ = router.handle_generate(
+                json.dumps(
+                    {"prompt_ids": [1] * n, "max_new_tokens": 2}
+                ).encode()
+            )
+            assert code == 200
+        # A text prompt is estimated at ~4 chars/token.
+        router.handle_generate(
+            json.dumps({"prompt": "x" * 40, "max_new_tokens": 2}).encode()
+        )
+        mix = router.prompt_mix_summary()
+        assert mix["count"] == 5
+        assert mix["p50"] == 10 and mix["max"] == 100
+        assert mix["long_frac"] is None  # threshold unarmed
+
+        assert router.set_prefill_threshold(12) == 12
+        assert router.prompt_mix_summary()["long_frac"] == pytest.approx(
+            2 / 5
+        )
+        with pytest.raises(ValueError, match=">= 1"):
+            router.set_prefill_threshold(0)
+
+        server = make_router_http_server(router, port=0)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+
+            def post_threshold(value):
+                req = urllib.request.Request(
+                    f"{base}/admin/threshold",
+                    data=json.dumps({"prefill_threshold": value}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return json.loads(resp.read())
+
+            assert post_threshold(48) == {"prefill_threshold": 48}
+            page = json.loads(
+                urllib.request.urlopen(
+                    f"{base}/statusz", timeout=30
+                ).read()
+            )
+            assert page["prefill_threshold"] == 48
+            assert page["threshold_updates"] == 2
+            assert page["prompt_mix"]["count"] == 5
+            # None disarms two-tier routing; garbage is a 400.
+            assert post_threshold(None) == {"prefill_threshold": None}
+            assert router.prefill_threshold is None
+            try:
+                post_threshold(0)
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as err:
+                assert err.code == 400
+                assert ">= 1" in json.loads(err.read())["error"]
+        finally:
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=10)
+    finally:
+        rep.close()
 
 
 # ------------------------------------------------------------ integration

@@ -1344,6 +1344,79 @@ def test_prefill_role_and_kv_http_endpoints(setup):
         dec.close()
 
 
+def test_kv_import_idempotency_key_grafts_exactly_once(setup):
+    """ACCEPTANCE (ISSUE 20): a retried /kv/import carrying the same
+    X-Idempotency-Key grafts exactly once — the retry attaches to the
+    original graft and resolves with ITS result (token-identical), and
+    migrations_in counts one move.  A different key is a different
+    transfer and grafts again."""
+    from bpe_transformer_tpu.serving.kvpool.migrate import (
+        payload_from_bytes,
+        payload_to_bytes,
+    )
+
+    params, prompts = setup
+    prompt = prompts[2]
+    with ServingEngine(
+        params, CFG, slots=2, min_bucket=8, paged=True, block_size=8
+    ) as mono:
+        ref = mono.generate(
+            prompt, max_new_tokens=8, temperature=0.0
+        ).token_ids
+
+    serving = ServingEngine(
+        params, CFG, slots=2, min_bucket=8, paged=True, block_size=8
+    )
+    serving.start()
+    server = make_http_server(serving, port=0)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        body = json.dumps(
+            {"prompt_ids": prompt, "max_new_tokens": 8,
+             "temperature": 0.0}
+        ).encode()
+        req = urllib.request.Request(
+            f"{base}/kv/export", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            payload = resp.read()
+
+        def kv_import(data, key):
+            headers = {"Content-Type": "application/octet-stream"}
+            if key:
+                headers["X-Idempotency-Key"] = key
+            req = urllib.request.Request(
+                f"{base}/kv/import", data=data, headers=headers
+            )
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return json.loads(resp.read())
+
+        first = kv_import(payload, "transfer-1")
+        retry = kv_import(payload, "transfer-1")  # the blackholed-retry
+        assert tuple(first["token_ids"]) == ref
+        assert retry["token_ids"] == first["token_ids"]
+        assert retry["request_id"] == first["request_id"]
+        assert serving.stats()["migrations_in"] == 1, (
+            "a retried import under one idempotency key must graft once"
+        )
+
+        # A DIFFERENT key is a new transfer: it grafts independently.
+        decoded = payload_from_bytes(payload)
+        decoded["meta"]["request_id"] = "transfer-2-rid"
+        second = kv_import(payload_to_bytes(decoded), "transfer-2")
+        assert second["token_ids"] == first["token_ids"]
+        assert serving.stats()["migrations_in"] == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=10)
+        serving.close()
+
+
 def test_role_validation_and_accepting_imports(setup):
     """Role knob guards: non-both roles need the paged engine; migrate
     requests need the paged engine; a prefill-role replica reports it
